@@ -1,0 +1,45 @@
+"""stateright_tpu: a TPU-native model-checking framework.
+
+A brand-new framework with the capabilities of stateright
+(Rust reference surveyed in SURVEY.md): exhaustive BFS/DFS/on-demand/
+simulation checking of nondeterministic models with always/sometimes/
+eventually properties, counterexample paths, symmetry reduction, an
+actor framework with pluggable network semantics and a real UDP
+runtime, linearizability/sequential-consistency testers, and a web
+Explorer — re-designed for TPUs: model states compile to fixed-width
+vectors, and the BFS frontier-expansion loop runs as vmapped/sharded
+XLA kernels with all-to-all frontier shuffles across a device mesh
+(see stateright_tpu.checkers.tpu and stateright_tpu.parallel).
+"""
+
+from .model import Model, Property, Expectation
+from .fingerprint import fingerprint, stable_hash
+from .checker import CheckerBuilder, Checker, DiscoveryClassification
+from .path import Path
+from .report import Reporter, WriteReporter, ReportData
+from .visitor import CheckerVisitor, PathRecorder, StateRecorder
+from .utils import HashableMap, HashableSet, DenseNatMap, VectorClock
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Model",
+    "Property",
+    "Expectation",
+    "fingerprint",
+    "stable_hash",
+    "CheckerBuilder",
+    "Checker",
+    "DiscoveryClassification",
+    "Path",
+    "Reporter",
+    "WriteReporter",
+    "ReportData",
+    "CheckerVisitor",
+    "PathRecorder",
+    "StateRecorder",
+    "HashableMap",
+    "HashableSet",
+    "DenseNatMap",
+    "VectorClock",
+]

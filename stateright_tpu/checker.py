@@ -1,0 +1,275 @@
+"""Checker configuration and the common checker interface.
+
+``CheckerBuilder`` mirrors the reference's fluent builder
+(stateright src/checker.rs:64-267): configure symmetry, bounded targets,
+worker count and visitors, then spawn a specific engine. ``Checker``
+mirrors the result interface (src/checker.rs:273-557): counts,
+discoveries as replayable :class:`~stateright_tpu.path.Path` objects,
+reporting, and assertion helpers for tests.
+
+Departures from the reference, by design:
+
+* Host engines run the search *synchronously* on first demand (``join``
+  or any accessor) instead of spawning OS threads — Python threads
+  cannot parallelize this CPU-bound loop. Parallelism lives in the TPU
+  engine (``spawn_tpu``), where a whole frontier wave is one device
+  program and scale-out is a sharded mesh, replacing the reference's
+  thread pool + work-stealing job market (src/job_market.rs).
+* ``threads(n)`` is accepted for API parity and ignored by host engines.
+"""
+
+from __future__ import annotations
+
+import time
+from enum import Enum
+from typing import Any, Callable, Optional, Sequence
+
+from .model import Expectation, Model, Property, State
+from .path import Path
+from .report import ReportData, Reporter
+from .visitor import CheckerVisitor, as_visitor
+
+
+class DiscoveryClassification(str, Enum):
+    """Whether a discovery proves or refutes a property (checker.rs:38-52)."""
+
+    EXAMPLE = "example"
+    COUNTEREXAMPLE = "counterexample"
+
+
+class CheckerBuilder:
+    """Fluent checker configuration (checker.rs:64-267)."""
+
+    def __init__(self, model: Model):
+        self.model = model
+        self._symmetry: Optional[Callable[[State], State]] = None
+        self._target_state_count: Optional[int] = None
+        self._target_max_depth: Optional[int] = None
+        self._threads: int = 1
+        self._visitor: Optional[CheckerVisitor] = None
+
+    def symmetry(self) -> "CheckerBuilder":
+        """Enable symmetry reduction via the state's own ``representative``
+        method (checker.rs:217-222)."""
+        return self.symmetry_fn(lambda state: state.representative())
+
+    def symmetry_fn(self, f: Callable[[State], State]) -> "CheckerBuilder":
+        """Enable symmetry reduction with an explicit representative
+        function (checker.rs:225-232)."""
+        self._symmetry = f
+        return self
+
+    def target_state_count(self, count: int) -> "CheckerBuilder":
+        """Stop after visiting approximately ``count`` unique states
+        (checker.rs:236-241)."""
+        self._target_state_count = count
+        return self
+
+    def target_max_depth(self, depth: int) -> "CheckerBuilder":
+        """Do not expand states deeper than ``depth`` (checker.rs:244-249)."""
+        self._target_max_depth = depth
+        return self
+
+    def threads(self, n: int) -> "CheckerBuilder":
+        """API parity with checker.rs:253-258; see module docstring."""
+        self._threads = n
+        return self
+
+    def visitor(self, v) -> "CheckerBuilder":
+        """Attach a visitor called with every evaluated state's path
+        (checker.rs:261-266)."""
+        self._visitor = as_visitor(v)
+        return self
+
+    # -- spawn methods (checker.rs:157-212) ------------------------------
+
+    def spawn_bfs(self) -> "Checker":
+        from .checkers.bfs import BfsChecker
+
+        return BfsChecker(self)
+
+    def spawn_dfs(self) -> "Checker":
+        from .checkers.dfs import DfsChecker
+
+        return DfsChecker(self)
+
+    def spawn_simulation(self, seed: int = 0, chooser=None) -> "Checker":
+        from .checkers.simulation import SimulationChecker, UniformChooser
+
+        return SimulationChecker(self, chooser or UniformChooser(), seed)
+
+    def spawn_on_demand(self) -> "Checker":
+        from .checkers.on_demand import OnDemandChecker
+
+        return OnDemandChecker(self)
+
+    def spawn_tpu(self, **kwargs) -> "Checker":
+        """Spawn the TPU wave engine — the reference's ``spawn_bfs``
+        re-imagined for an accelerator (see BASELINE.json north star)."""
+        from .checkers.tpu import TpuBfsChecker
+
+        return TpuBfsChecker(self, **kwargs)
+
+    def serve(self, addr: str):
+        """Serve the Explorer web UI for this model (checker.rs:139-146)."""
+        from .explorer.server import serve
+
+        return serve(self, addr)
+
+
+class Checker:
+    """Common checker result interface (checker.rs:273-557)."""
+
+    def __init__(self, builder: CheckerBuilder):
+        self.builder = builder
+        self.model = builder.model
+        self._discoveries: dict[str, Path] = {}
+        self._total_states = 0
+        self._unique_states = 0
+        self._max_depth = 0
+        self._done = False
+        self._started_at: Optional[float] = None
+        self._finished_at: Optional[float] = None
+
+    # -- engine hook -----------------------------------------------------
+
+    def _run(self, reporter: Optional[Reporter] = None) -> None:
+        """Run the search to completion. Implemented by engines."""
+        raise NotImplementedError
+
+    def _ensure_run(self, reporter: Optional[Reporter] = None) -> None:
+        if self._done:
+            return
+        self._started_at = time.monotonic()
+        self._run(reporter)
+        self._finished_at = time.monotonic()
+        self._done = True
+
+    # -- status (checker.rs:287-314) -------------------------------------
+
+    def state_count(self) -> int:
+        self._ensure_run()
+        return self._total_states
+
+    def unique_state_count(self) -> int:
+        self._ensure_run()
+        return self._unique_states
+
+    def max_depth(self) -> int:
+        self._ensure_run()
+        return self._max_depth
+
+    def is_done(self) -> bool:
+        return self._done
+
+    def join(self) -> "Checker":
+        self._ensure_run()
+        return self
+
+    def duration_sec(self) -> float:
+        if self._started_at is None:
+            return 0.0
+        end = self._finished_at if self._finished_at is not None else time.monotonic()
+        return end - self._started_at
+
+    # -- on-demand hooks (checker.rs:278-285); overridden by OnDemand ----
+
+    def check_fingerprint(self, fp: int) -> None:
+        pass
+
+    def run_to_completion(self) -> None:
+        self._ensure_run()
+
+    # -- discoveries (checker.rs:287-300) --------------------------------
+
+    def discoveries(self) -> dict[str, Path]:
+        self._ensure_run()
+        return dict(self._discoveries)
+
+    def discovery(self, name: str) -> Optional[Path]:
+        return self.discoveries().get(name)
+
+    def discovery_classification(self, name: str) -> DiscoveryClassification:
+        prop = self.model.property_by_name(name)
+        if prop.expectation == Expectation.SOMETIMES:
+            return DiscoveryClassification.EXAMPLE
+        return DiscoveryClassification.COUNTEREXAMPLE
+
+    # -- reporting (checker.rs:330-431) ----------------------------------
+
+    def report(self, reporter: Reporter) -> "Checker":
+        self._ensure_run(reporter)
+        reporter.report_checking(
+            ReportData(
+                total_states=self._total_states,
+                unique_states=self._unique_states,
+                max_depth=self._max_depth,
+                duration_sec=self.duration_sec(),
+                done=self.is_done(),
+            )
+        )
+        reporter.report_discoveries(self)
+        return self
+
+    def join_and_report(self, reporter: Reporter) -> "Checker":
+        return self.report(reporter)
+
+    # -- assertion helpers (checker.rs:447-556) --------------------------
+
+    def assert_properties(self) -> None:
+        """Assert no always/eventually counterexamples and an example for
+        every sometimes property (checker.rs:447-473)."""
+        for prop in self.model.properties():
+            if prop.expectation == Expectation.SOMETIMES:
+                self.assert_any_discovery(prop.name)
+            else:
+                self.assert_no_discovery(prop.name)
+
+    def assert_any_discovery(self, name: str) -> Path:
+        path = self.discovery(name)
+        if path is None:
+            raise AssertionError(f"expected a discovery for {name!r}")
+        return path
+
+    def assert_no_discovery(self, name: str) -> None:
+        path = self.discovery(name)
+        if path is not None:
+            raise AssertionError(
+                f"unexpected discovery for {name!r}: {path.encode()}\n{path!r}"
+            )
+
+    def assert_discovery(self, name: str, actions: Sequence[Any]) -> None:
+        """Assert a discovery exists and matches the given action sequence
+        (checker.rs:506-556)."""
+        path = self.assert_any_discovery(name)
+        if list(path.actions()) != list(actions):
+            raise AssertionError(
+                f"discovery for {name!r} has actions {path.actions()!r}, "
+                f"expected {list(actions)!r}"
+            )
+
+    # -- shared engine internals ----------------------------------------
+
+    def _eventually_bits_init(self) -> int:
+        """Bitmask with one bit per eventually property, all set.
+
+        Mirrors ``EventuallyBits`` seeding (checker.rs:559-566,
+        bfs.rs:61-73): bits clear as conditions are met along a path;
+        any bit surviving to a terminal state is a counterexample.
+        """
+        bits = 0
+        for i, prop in enumerate(self.model.properties()):
+            if prop.expectation == Expectation.EVENTUALLY:
+                bits |= 1 << i
+        return bits
+
+    def _properties(self) -> Sequence[Property]:
+        return self.model.properties()
+
+    def _all_discovered(self) -> bool:
+        """Early-exit condition: every property has a discovery
+        (bfs.rs:128-135)."""
+        props = self.model.properties()
+        return len(props) > 0 and all(
+            p.name in self._discoveries for p in props
+        )

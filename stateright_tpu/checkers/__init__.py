@@ -1,0 +1,1 @@
+"""Checker engines: host BFS/DFS/simulation/on-demand + the TPU wave engine."""

@@ -1,0 +1,132 @@
+"""Host breadth-first checker — the sequential correctness oracle.
+
+Re-implements the semantics of the reference's parallel BFS
+(stateright src/checker/bfs.rs): FIFO frontier, fingerprint-keyed
+visited map storing child→parent digests for path reconstruction
+(bfs.rs:28-29, 371-400), per-path ``EventuallyBits`` with the documented
+revisit false-negative (bfs.rs:285-303), terminal-state eventually
+counterexamples (bfs.rs:317-324), and early exit once every property
+has a discovery or the state target is reached (bfs.rs:128-145).
+
+Where the reference gets parallelism from worker threads + a
+work-stealing job market, this host engine is deliberately sequential:
+it exists to define ground truth for the vectorized TPU engine
+(:mod:`stateright_tpu.checkers.tpu`), which runs the same wave
+semantics as device kernels.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Optional
+
+from ..checker import Checker, CheckerBuilder
+from ..model import Expectation
+from ..fingerprint import fingerprint
+from ..path import Path
+from ..report import ReportData, Reporter
+from .common import ParentTraceMixin
+
+
+class BfsChecker(ParentTraceMixin, Checker):
+    def __init__(self, builder: CheckerBuilder):
+        super().__init__(builder)
+        if builder._symmetry is not None:
+            raise ValueError(
+                "symmetry reduction requires spawn_dfs or spawn_simulation "
+                "(as in the reference: dfs.rs:300-311, simulation.rs:252-256)"
+            )
+        #: child fingerprint -> parent fingerprint (None for init states);
+        #: the complete parent-pointer forest (bfs.rs:28-29).
+        self.generated: dict[int, Optional[int]] = {}
+
+    def _run(self, reporter: Optional[Reporter] = None) -> None:
+        model = self.model
+        props = list(model.properties())
+        ebits_init = self._eventually_bits_init()
+        visitor = self.builder._visitor
+        target_states = self.builder._target_state_count
+        target_depth = self.builder._target_max_depth
+
+        pending: deque[tuple[object, int, int, int]] = deque()
+        for init in model.init_states():
+            if not model.within_boundary(init):
+                continue
+            fp = fingerprint(init)
+            self._total_states += 1
+            if fp not in self.generated:
+                self.generated[fp] = None
+                pending.append((init, fp, ebits_init, 1))
+        self._unique_states = len(self.generated)
+
+        last_report = time.monotonic()
+        while pending:
+            state, fp, ebits, depth = pending.popleft()
+            self._max_depth = max(self._max_depth, depth)
+
+            if visitor is not None:
+                visitor.visit(
+                    model, Path.from_fingerprints(model, self._reconstruct_fps(fp))
+                )
+
+            # Property evaluation on the popped state (bfs.rs:223-268).
+            for i, prop in enumerate(props):
+                if prop.expectation == Expectation.ALWAYS:
+                    if not prop.condition(model, state):
+                        self._discover(prop.name, fp)
+                elif prop.expectation == Expectation.SOMETIMES:
+                    if prop.condition(model, state):
+                        self._discover(prop.name, fp)
+                else:  # EVENTUALLY
+                    if ebits & (1 << i) and prop.condition(model, state):
+                        ebits &= ~(1 << i)
+
+            if self._all_discovered():
+                break
+            if target_states is not None and self._unique_states >= target_states:
+                break
+
+            # Depth bound: do not expand further (bfs.rs:210-215); a
+            # depth-cut state is not "terminal" for eventually purposes.
+            if target_depth is not None and depth >= target_depth:
+                continue
+
+            # Expansion (bfs.rs:275-316).
+            is_terminal = True
+            for action in model.actions(state):
+                next_state = model.next_state(state, action)
+                if next_state is None:
+                    continue
+                if not model.within_boundary(next_state):
+                    continue
+                is_terminal = False
+                next_fp = fingerprint(next_state)
+                self._total_states += 1
+                if next_fp not in self.generated:
+                    self.generated[next_fp] = fp
+                    self._unique_states += 1
+                    pending.append((next_state, next_fp, ebits, depth + 1))
+                # else: ebits dropped on revisit — reproduces the
+                # documented false negative (bfs.rs:285-303).
+
+            # Terminal state: surviving eventually-bits are
+            # counterexamples (bfs.rs:317-324).
+            if is_terminal and ebits:
+                for i, prop in enumerate(props):
+                    if ebits & (1 << i):
+                        self._discover(prop.name, fp)
+
+            if reporter is not None:
+                now = time.monotonic()
+                if now - last_report >= reporter.delay():
+                    last_report = now
+                    reporter.report_checking(
+                        ReportData(
+                            total_states=self._total_states,
+                            unique_states=self._unique_states,
+                            max_depth=self._max_depth,
+                            duration_sec=self.duration_sec(),
+                            done=False,
+                        )
+                    )

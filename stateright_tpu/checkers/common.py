@@ -1,0 +1,37 @@
+"""Shared engine internals for parent-pointer-based checkers.
+
+The BFS and on-demand engines both maintain the child→parent
+fingerprint forest of the reference's BFS (bfs.rs:28-29) and
+reconstruct discovery paths by walking it (bfs.rs:371-400); the shared
+code lives here so the engines cannot drift apart.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..path import Path
+
+
+class ParentTraceMixin:
+    """Requires ``self.generated: dict[int, Optional[int]]``,
+    ``self.model`` and ``self._discoveries``."""
+
+    generated: dict[int, Optional[int]]
+
+    def _reconstruct_fps(self, fp: int) -> list[int]:
+        """Walk parent pointers back to an init state (bfs.rs:371-400)."""
+        fps = [fp]
+        while True:
+            parent = self.generated[fps[-1]]
+            if parent is None:
+                break
+            fps.append(parent)
+        fps.reverse()
+        return fps
+
+    def _discover(self, name: str, fp: int) -> None:
+        if name not in self._discoveries:
+            self._discoveries[name] = Path.from_fingerprints(
+                self.model, self._reconstruct_fps(fp)
+            )

@@ -1,0 +1,122 @@
+"""The core ``Model`` abstraction and temporal properties.
+
+TPU-native re-design of the reference's central trait
+(stateright src/lib.rs:156-255): a model describes a nondeterministic
+state machine via ``init_states`` / ``actions`` / ``next_state`` plus
+temporal ``properties``. Everything else in the framework — host
+checkers, the TPU wave engine, the actor layer, the Explorer — consumes
+this protocol.
+
+Differences from the reference, by design:
+
+* ``actions(state)`` returns a list (no out-param; idiomatic Python).
+* A model may additionally provide a *vectorized encoding*
+  (:class:`stateright_tpu.encoding.schema.EncodedModel`) which the TPU
+  checker uses; the host protocol here remains the semantic ground
+  truth and differential oracle.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+State = Any
+Action = Any
+
+
+class Expectation(Enum):
+    """How a property is expected to hold (src/lib.rs:319-326)."""
+
+    #: Holds in every reachable state; a violating state is a counterexample.
+    ALWAYS = "always"
+    #: Holds in at least one reachable state; such a state is an example.
+    SOMETIMES = "sometimes"
+    #: Holds at some point along every path; a terminal path that never
+    #: satisfied it is a counterexample.
+    EVENTUALLY = "eventually"
+
+
+@dataclass(frozen=True)
+class Property:
+    """A named temporal property over model states (src/lib.rs:262-326)."""
+
+    expectation: Expectation
+    name: str
+    condition: Callable[["Model", State], bool]
+
+    @staticmethod
+    def always(name: str, condition: Callable[["Model", State], bool]) -> "Property":
+        return Property(Expectation.ALWAYS, name, condition)
+
+    @staticmethod
+    def sometimes(name: str, condition: Callable[["Model", State], bool]) -> "Property":
+        return Property(Expectation.SOMETIMES, name, condition)
+
+    @staticmethod
+    def eventually(name: str, condition: Callable[["Model", State], bool]) -> "Property":
+        return Property(Expectation.EVENTUALLY, name, condition)
+
+
+class Model:
+    """A nondeterministic state machine with temporal properties.
+
+    Subclasses implement ``init_states``, ``actions``, ``next_state``
+    and ``properties`` (mirroring the reference trait's required and
+    provided methods, src/lib.rs:156-255).
+    """
+
+    def init_states(self) -> Sequence[State]:
+        raise NotImplementedError
+
+    def actions(self, state: State) -> Sequence[Action]:
+        raise NotImplementedError
+
+    def next_state(self, state: State, action: Action) -> Optional[State]:
+        raise NotImplementedError
+
+    def properties(self) -> Sequence[Property]:
+        return []
+
+    def within_boundary(self, state: State) -> bool:
+        """Bounded-exploration hook (src/lib.rs:243-245)."""
+        return True
+
+    # -- display hooks (src/lib.rs Model display methods) ----------------
+
+    def format_action(self, action: Action) -> str:
+        return str(action)
+
+    def format_step(self, last_state: State, action: Action) -> Optional[str]:
+        next_state = self.next_state(last_state, action)
+        return None if next_state is None else repr(next_state)
+
+    def as_svg(self, path: Any) -> Optional[str]:
+        """Optional visualization of a path for the Explorer."""
+        return None
+
+    # -- provided helpers (src/lib.rs next_steps/next_states) ------------
+
+    def next_steps(self, state: State) -> list[tuple[Action, State]]:
+        steps = []
+        for action in self.actions(state):
+            next_state = self.next_state(state, action)
+            if next_state is not None:
+                steps.append((action, next_state))
+        return steps
+
+    def next_states(self, state: State) -> list[State]:
+        return [s for _, s in self.next_steps(state)]
+
+    def property_by_name(self, name: str) -> Property:
+        for prop in self.properties():
+            if prop.name == name:
+                return prop
+        raise KeyError(f"no property named {name!r}")
+
+    def checker(self) -> "CheckerBuilder":
+        """Entry point to model checking (src/lib.rs:248-254)."""
+        from .checker import CheckerBuilder
+
+        return CheckerBuilder(self)

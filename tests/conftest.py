@@ -1,0 +1,15 @@
+"""Test configuration.
+
+TPU-engine tests run on a virtual 8-device CPU mesh so multi-chip
+sharding (shard_map + all_to_all frontier shuffles) is exercised
+without TPU hardware. Must be set before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

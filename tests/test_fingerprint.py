@@ -1,0 +1,74 @@
+"""Stable fingerprinting: determinism, type separation, order independence."""
+
+import subprocess
+import sys
+from dataclasses import dataclass
+from enum import Enum
+
+from stateright_tpu import fingerprint, stable_hash
+
+
+def test_deterministic_across_processes():
+    # The whole point (reference src/lib.rs:357-375): digests must be
+    # stable across runs so state counts and encoded paths reproduce.
+    code = (
+        "from stateright_tpu import stable_hash;"
+        "print(stable_hash(('abc', 42, frozenset([1, 2, 3]))))"
+    )
+    out1 = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, check=True
+    ).stdout.strip()
+    assert out1 == str(stable_hash(("abc", 42, frozenset([1, 2, 3]))))
+
+
+def test_type_separation():
+    values = [1, "1", (1,), [1], frozenset([1]), {1: 1}, 1.0, b"1", True, None]
+    digests = [stable_hash(v) for v in values]
+    assert len(set(digests)) == len(digests)
+
+
+def test_int_edge_cases():
+    vals = [0, 1, -1, 2**63, 2**64 - 1, 2**64, -(2**64), 2**130, -(2**130)]
+    digests = [stable_hash(v) for v in vals]
+    assert len(set(digests)) == len(digests)
+
+
+def test_unordered_collections_order_independent():
+    assert stable_hash(frozenset([1, 2, 3])) == stable_hash(frozenset([3, 1, 2]))
+    assert stable_hash({"a": 1, "b": 2}) == stable_hash({"b": 2, "a": 1})
+    # set == frozenset with same elements
+    assert stable_hash({1, 2}) == stable_hash(frozenset([2, 1]))
+
+
+def test_ordered_collections_order_dependent():
+    assert stable_hash((1, 2)) != stable_hash((2, 1))
+    assert stable_hash([1, 2]) != stable_hash([2, 1])
+
+
+def test_dataclass_and_enum():
+    @dataclass(frozen=True)
+    class P:
+        x: int
+        y: int
+
+    class Color(Enum):
+        RED = 1
+        BLUE = 2
+
+    assert stable_hash(P(1, 2)) == stable_hash(P(1, 2))
+    assert stable_hash(P(1, 2)) != stable_hash(P(2, 1))
+    assert stable_hash(Color.RED) != stable_hash(Color.BLUE)
+
+
+def test_fingerprint_nonzero():
+    for v in range(200):
+        assert fingerprint((v, v + 1)) != 0
+
+
+def test_numpy_arrays():
+    import numpy as np
+
+    a = np.arange(8, dtype=np.uint32)
+    b = np.arange(8, dtype=np.uint32)
+    assert stable_hash(a) == stable_hash(b)
+    assert stable_hash(a) != stable_hash(a.astype(np.int64))

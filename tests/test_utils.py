@@ -1,0 +1,63 @@
+"""Utility collections: hashable set/map, DenseNatMap, VectorClock."""
+
+import pytest
+
+from stateright_tpu import (
+    DenseNatMap,
+    HashableMap,
+    HashableSet,
+    VectorClock,
+    stable_hash,
+)
+
+
+def test_hashable_set_order_independent_digest():
+    a = HashableSet([1, 2, 3])
+    b = HashableSet([3, 2, 1])
+    assert a == b
+    assert stable_hash(a) == stable_hash(b)
+    assert hash(a) == hash(b)
+
+
+def test_hashable_set_immutability():
+    a = HashableSet([1])
+    b = a.add(2)
+    assert 2 not in a and 2 in b
+    assert a.add(1) is a
+    assert b.remove(2) == a
+
+
+def test_hashable_map_digest_and_updates():
+    a = HashableMap({"x": 1, "y": 2})
+    b = HashableMap({"y": 2, "x": 1})
+    assert a == b and stable_hash(a) == stable_hash(b)
+    c = a.set("z", 3)
+    assert "z" not in a and c["z"] == 3
+    assert c.remove("z") == a
+    assert a.set("x", 1) is a
+
+
+def test_dense_nat_map():
+    m = DenseNatMap([10, 20])
+    assert m[0] == 10 and m[1] == 20
+    m2 = m.set(2, 30)  # append at end: dense
+    assert len(m2) == 3 and m2[2] == 30
+    m3 = m2.set(0, 99)
+    assert m3[0] == 99 and m2[0] == 10
+    with pytest.raises(IndexError):
+        m.set(5, 1)  # gap insert (densenatmap.rs:98-113)
+
+
+def test_vector_clock_ordering():
+    a = VectorClock().incremented(0)  # [1]
+    b = a.incremented(1)  # [1,1]
+    assert a < b and a <= b and not (b <= a)
+    c = VectorClock().incremented(1)  # [0,1]
+    assert a.partial_cmp(c) is None  # concurrent
+    assert a.merge_max(c) == VectorClock([1, 1])
+
+
+def test_vector_clock_trailing_zeros_ignored():
+    assert VectorClock([1, 0, 0]) == VectorClock([1])
+    assert stable_hash(VectorClock([1, 0])) == stable_hash(VectorClock([1]))
+    assert VectorClock([1]).get(5) == 0

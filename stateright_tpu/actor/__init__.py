@@ -1,0 +1,54 @@
+"""Actor framework: model-checkable message-driven state machines.
+
+Counterpart of stateright src/actor.rs and src/actor/*: the ``Actor``
+protocol, the ``ActorModel`` bridge into the checkable ``Model``
+protocol, pluggable network semantics, timers, crash/loss fault
+injection, and a real UDP runtime (``spawn``) for the same actor code.
+"""
+
+from .base import (
+    Actor,
+    CancelTimer,
+    Command,
+    Cow,
+    Id,
+    Out,
+    Send,
+    SetTimer,
+    is_no_op,
+    is_no_op_with_timer,
+    majority,
+    model_peers,
+    model_timeout,
+)
+from .network import Envelope, Network, Ordered, UnorderedDuplicating, UnorderedNonDuplicating
+from .model import ActorModel, ActorModelAction, Crash, Deliver, Drop, Timeout
+from .model_state import ActorModelState
+
+__all__ = [
+    "Actor",
+    "ActorModel",
+    "ActorModelAction",
+    "ActorModelState",
+    "CancelTimer",
+    "Command",
+    "Cow",
+    "Crash",
+    "Deliver",
+    "Drop",
+    "Envelope",
+    "Id",
+    "Network",
+    "Ordered",
+    "Out",
+    "Send",
+    "SetTimer",
+    "Timeout",
+    "UnorderedDuplicating",
+    "UnorderedNonDuplicating",
+    "is_no_op",
+    "is_no_op_with_timer",
+    "majority",
+    "model_peers",
+    "model_timeout",
+]

@@ -140,6 +140,29 @@ def _hash_value(h: int, obj: Any) -> int:
     stable = getattr(obj, "_stable_hash_", None)
     if stable is not None:
         return _fold(h, _T_OBJECT, (stable() & _M64,))
+    # Subclass fallbacks (e.g. actor Id subclasses int and must digest
+    # identically to the plain int it equals). Conversions bypass
+    # overridable __int__/__str__ so the digest matches the value the
+    # subclass *equals*, then recurse through the exact-type paths.
+    if isinstance(obj, int):
+        return _hash_value(h, int.__index__(obj))
+    if isinstance(obj, str):
+        return _hash_value(h, str.__str__(obj))
+    if isinstance(obj, tuple):
+        return _hash_value(h, tuple(obj))
+    if isinstance(obj, (frozenset, set)):
+        return _hash_value(h, frozenset(obj))
+    if isinstance(obj, dict):
+        import collections
+
+        if isinstance(obj, collections.OrderedDict):
+            # OrderedDict equality is order-sensitive; hashing it as an
+            # unordered dict would alias unequal states.
+            raise TypeError(
+                "cannot stably hash OrderedDict (order-sensitive equality); "
+                "use a tuple of items or a plain dict"
+            )
+        return _hash_value(h, dict(obj))
     if hasattr(obj, "__array_interface__") or type(obj).__module__ == "numpy":
         import numpy as np
 

@@ -1,0 +1,2 @@
+"""Example model-checked systems (counterparts of the reference's
+examples/ and actor test fixtures)."""

@@ -1,0 +1,230 @@
+"""ABD replicated atomic register (Attiya, Bar-Noy, Dolev).
+
+Counterpart of stateright examples/linearizable-register.rs: a
+query/record two-phase quorum protocol providing a linearizable
+read/write register without consensus. Reference-pinned: 2 clients /
+2 servers = 544 unique states (linearizable-register.rs:286, 313).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any, Optional, Tuple
+
+from ..model import Expectation
+from ..actor import (
+    Actor,
+    ActorModel,
+    Cow,
+    Id,
+    Network,
+    Out,
+    majority,
+    model_peers,
+)
+from ..actor.register import (
+    DEFAULT_VALUE,
+    Get,
+    GetOk,
+    Internal,
+    Put,
+    PutOk,
+    RegisterClient,
+    RegisterServer,
+    record_invocations,
+    record_returns,
+)
+from ..semantics import LinearizabilityTester, Register
+from ..utils import HashableMap, HashableSet
+
+# Seq = (logical_clock, writer_id): totally ordered, writer id breaks ties.
+
+
+@dataclass(frozen=True)
+class Query:
+    req_id: int
+
+
+@dataclass(frozen=True)
+class AckQuery:
+    req_id: int
+    seq: Tuple
+    value: Any
+
+
+@dataclass(frozen=True)
+class Record:
+    req_id: int
+    seq: Tuple
+    value: Any
+
+
+@dataclass(frozen=True)
+class AckRecord:
+    req_id: int
+
+
+@dataclass(frozen=True)
+class Phase1:
+    request_id: int
+    requester_id: Id
+    write: Optional[Any]  # Some(value) for Put, None for Get
+    responses: HashableMap  # Id -> (seq, value)
+
+
+@dataclass(frozen=True)
+class Phase2:
+    request_id: int
+    requester_id: Id
+    read: Optional[Any]  # Some(value) for Get, None for Put
+    acks: HashableSet
+
+
+@dataclass(frozen=True)
+class AbdState:
+    seq: Tuple
+    val: Any
+    phase: Optional[Any]  # None | Phase1 | Phase2
+
+
+class AbdActor(Actor):
+    def __init__(self, peers: list[Id]):
+        self.peers = peers
+
+    def name(self) -> str:
+        return "AbdServer"
+
+    def on_start(self, id: Id, out: Out) -> AbdState:
+        return AbdState(seq=(0, id), val=DEFAULT_VALUE, phase=None)
+
+    def on_msg(self, id: Id, cow: Cow, src: Id, msg: Any, out: Out) -> None:
+        state: AbdState = cow.value
+
+        if isinstance(msg, (Put, Get)) and state.phase is None:
+            write = msg.value if isinstance(msg, Put) else None
+            out.broadcast(self.peers, Internal(Query(msg.req_id)))
+            cow.set(
+                replace(
+                    state,
+                    phase=Phase1(
+                        request_id=msg.req_id,
+                        requester_id=src,
+                        write=write,
+                        responses=HashableMap({id: (state.seq, state.val)}),
+                    ),
+                )
+            )
+
+        elif isinstance(msg, Internal) and isinstance(msg.msg, Query):
+            out.send(
+                src, Internal(AckQuery(msg.msg.req_id, state.seq, state.val))
+            )
+
+        elif (
+            isinstance(msg, Internal)
+            and isinstance(msg.msg, AckQuery)
+            and isinstance(state.phase, Phase1)
+            and state.phase.request_id == msg.msg.req_id
+        ):
+            phase = state.phase
+            responses = phase.responses.set(src, (msg.msg.seq, msg.msg.value))
+            if len(responses) == majority(len(self.peers) + 1):
+                # Quorum: adopt the max (seq, value), bump for writes,
+                # move to the record phase (linearizable-register.rs:
+                # 123-170).
+                seq, val = max(responses.values(), key=lambda sv: sv[0])
+                read = None
+                if phase.write is not None:
+                    seq = (seq[0] + 1, id)
+                    val = phase.write
+                else:
+                    read = val
+                out.broadcast(
+                    self.peers, Internal(Record(phase.request_id, seq, val))
+                )
+                new_state = state
+                if seq > state.seq:
+                    new_state = replace(new_state, seq=seq, val=val)
+                cow.set(
+                    replace(
+                        new_state,
+                        phase=Phase2(
+                            request_id=phase.request_id,
+                            requester_id=phase.requester_id,
+                            read=read,
+                            acks=HashableSet([id]),
+                        ),
+                    )
+                )
+            else:
+                cow.set(
+                    replace(state, phase=replace(phase, responses=responses))
+                )
+
+        elif isinstance(msg, Internal) and isinstance(msg.msg, Record):
+            out.send(src, Internal(AckRecord(msg.msg.req_id)))
+            if msg.msg.seq > state.seq:
+                cow.set(replace(state, seq=msg.msg.seq, val=msg.msg.value))
+
+        elif (
+            isinstance(msg, Internal)
+            and isinstance(msg.msg, AckRecord)
+            and isinstance(state.phase, Phase2)
+            and state.phase.request_id == msg.msg.req_id
+            and src not in state.phase.acks
+        ):
+            phase = state.phase
+            acks = phase.acks.add(src)
+            if len(acks) == majority(len(self.peers) + 1):
+                if phase.read is not None:
+                    out.send(
+                        phase.requester_id,
+                        GetOk(phase.request_id, phase.read),
+                    )
+                else:
+                    out.send(phase.requester_id, PutOk(phase.request_id))
+                cow.set(replace(state, phase=None))
+            else:
+                cow.set(replace(state, phase=replace(phase, acks=acks)))
+        # else: ignored → no-op → pruned
+
+
+@dataclass(frozen=True)
+class AbdModelCfg:
+    client_count: int = 2
+    server_count: int = 2
+    put_count: int = 1
+
+
+def abd_model(cfg: AbdModelCfg, network: Network | None = None) -> ActorModel:
+    if network is None:
+        network = Network.new_unordered_nonduplicating()
+
+    def value_chosen(model: ActorModel, state) -> bool:
+        for env in state.network.iter_deliverable():
+            if isinstance(env.msg, GetOk) and env.msg.value != DEFAULT_VALUE:
+                return True
+        return False
+
+    model = ActorModel(
+        cfg=cfg, init_history=LinearizabilityTester(Register(DEFAULT_VALUE))
+    )
+    model.add_actors(
+        RegisterServer(AbdActor(model_peers(i, cfg.server_count)))
+        for i in range(cfg.server_count)
+    )
+    model.add_actors(
+        RegisterClient(put_count=cfg.put_count, server_count=cfg.server_count)
+        for _ in range(cfg.client_count)
+    )
+    return (
+        model.init_network(network)
+        .property(
+            Expectation.ALWAYS,
+            "linearizable",
+            lambda m, s: s.history.serialized_history() is not None,
+        )
+        .property(Expectation.SOMETIMES, "value chosen", value_chosen)
+        .record_msg_in(record_returns)
+        .record_msg_out(record_invocations)
+    )

@@ -1,0 +1,41 @@
+"""Consistency semantics: sequential specs and concurrent-history testers.
+
+Counterpart of stateright src/semantics.rs and src/semantics/*:
+reference objects (:class:`~stateright_tpu.semantics.register.Register`,
+write-once register, vector) define *sequential* semantics via
+:class:`SequentialSpec`; the linearizability / sequential-consistency
+testers record a concurrent operation history and decide whether some
+legal total order explains it.
+
+Unlike the reference's mutable testers, these are **immutable**: in
+actor models the tester is the auxiliary history and therefore part of
+the fingerprinted model state (see SURVEY.md §2.3), so recording
+returns a new tester value.
+"""
+
+from .spec import SequentialSpec
+from .linearizability import LinearizabilityTester
+from .sequential_consistency import SequentialConsistencyTester
+from .register import Register, ReadOp, ReadOk, WriteOp, WriteOk
+from .write_once_register import WORegister, WriteFail
+from .vec import Vec, Push, Pop, Len, PushOk, PopOk, LenOk
+
+__all__ = [
+    "SequentialSpec",
+    "LinearizabilityTester",
+    "SequentialConsistencyTester",
+    "Register",
+    "ReadOp",
+    "ReadOk",
+    "WriteOp",
+    "WriteOk",
+    "WORegister",
+    "WriteFail",
+    "Vec",
+    "Push",
+    "Pop",
+    "Len",
+    "PushOk",
+    "PopOk",
+    "LenOk",
+]

@@ -1,0 +1,197 @@
+"""Actor framework: networks, ActorModel semantics, pinned state counts.
+
+Ground-truth counts come from the reference's own tests (BASELINE.md):
+ping-pong lossy-dup max1 = 14, lossy-dup max5 = 4,094, lossless
+non-dup max5 = 11 (actor/model.rs:688, 847, 887); the no-op/network
+interaction test (actor/model.rs no_op_depends_on_network) pins 2/2/3.
+"""
+
+import pytest
+
+from stateright_tpu import Expectation
+from stateright_tpu.actor import (
+    Actor,
+    ActorModel,
+    Cow,
+    Deliver,
+    Drop,
+    Envelope,
+    Id,
+    Network,
+    Out,
+)
+from stateright_tpu.models.ping_pong import PingPongCfg, Ping, ping_pong_model
+
+
+def test_ping_pong_lossy_dup_max1_visits_14_states():
+    model = ping_pong_model(PingPongCfg(max_nat=1)).set_lossy_network(True)
+    checker = model.checker().spawn_bfs().join()
+    assert checker.unique_state_count() == 14
+
+
+def test_ping_pong_lossy_dup_max5_visits_4094_states():
+    model = ping_pong_model(PingPongCfg(max_nat=5)).set_lossy_network(True)
+    checker = model.checker().spawn_bfs().join()
+    assert checker.unique_state_count() == 4094
+    checker.assert_no_discovery("delta within 1")
+    # Can lose the first message and get stuck (actor/model.rs:847+).
+    path = checker.assert_any_discovery("must reach max")
+    assert path.actions() == [Drop(Envelope(Id(0), Id(1), Ping(0)))]
+
+
+def test_ping_pong_lossless_nondup_max5_visits_11_states():
+    model = ping_pong_model(PingPongCfg(max_nat=5)).init_network(
+        Network.new_unordered_nonduplicating()
+    )
+    checker = model.checker().spawn_bfs().join()
+    assert checker.unique_state_count() == 11
+    checker.assert_no_discovery("must reach max")
+
+
+def test_ping_pong_history_properties():
+    model = ping_pong_model(
+        PingPongCfg(max_nat=3, maintains_history=True)
+    ).init_network(Network.new_unordered_nonduplicating())
+    checker = model.checker().spawn_bfs().join()
+    checker.assert_no_discovery("#in <= #out")
+
+
+def test_no_op_depends_on_network():
+    # actor/model.rs no_op_depends_on_network: ignored messages are
+    # pruned on unordered networks but must drain ordered channels.
+    class Ignored:
+        pass
+
+    class MyActor(Actor):
+        def __init__(self, server: Id | None):
+            self.server = server
+
+        def on_start(self, id, out):
+            if self.server is not None:
+                out.send(self.server, "ignored")
+                out.send(self.server, "interesting")
+            return "awaiting"
+
+        def on_msg(self, id, state, src, msg, out):
+            if msg == "interesting":
+                state.set("got it")
+
+    def build(network):
+        return (
+            ActorModel()
+            .actor(MyActor(server=Id(1)))
+            .actor(MyActor(server=None))
+            .init_network(network)
+            .property(Expectation.ALWAYS, "check everything", lambda m, s: True)
+        )
+
+    assert (
+        build(Network.new_unordered_duplicating())
+        .checker().spawn_bfs().join().unique_state_count()
+    ) == 2
+    assert (
+        build(Network.new_unordered_nonduplicating())
+        .checker().spawn_bfs().join().unique_state_count()
+    ) == 2
+    assert (
+        build(Network.new_ordered())
+        .checker().spawn_bfs().join().unique_state_count()
+    ) == 3
+
+
+def test_crash_fault_injection():
+    # With one allowed crash, the receiver can die before delivery:
+    # the ping is then undeliverable and counts stay at (0, 0).
+    model = ping_pong_model(PingPongCfg(max_nat=2)).init_network(
+        Network.new_unordered_nonduplicating()
+    ).set_max_crashes(1)
+    checker = model.checker().spawn_bfs().join()
+    path = checker.assert_any_discovery("must reach max")
+    assert any("Crash" in type(a).__name__ for a in path.actions())
+
+
+def test_timers_fire_and_clear():
+    class TimerActor(Actor):
+        def on_start(self, id, out):
+            out.set_timer("tick", (0.0, 0.0))
+            return 0
+
+        def on_timeout(self, id, state, timer, out):
+            if state.value < 2:
+                state.set(state.value + 1)
+                out.set_timer("tick", (0.0, 0.0))
+
+    model = (
+        ActorModel()
+        .actor(TimerActor())
+        .property(
+            Expectation.SOMETIMES, "reaches 2", lambda m, s: s.actor_states[0] == 2
+        )
+        .property(
+            # The final timeout is a pure timer-removal (NOT a no-op:
+            # is_no_op_with_timer only prunes same-timer renewals).
+            Expectation.EVENTUALLY,
+            "timer drained",
+            lambda m, s: s.actor_states[0] == 2 and not s.timers_set[0],
+        )
+    )
+    checker = model.checker().spawn_bfs().join()
+    checker.assert_properties()
+    # (0,T) -> (1,T) -> (2,T) -> (2,∅)
+    assert checker.unique_state_count() == 4
+
+
+def test_ordered_network_fifo():
+    # Sender emits A then B over an ordered network; receiver must see
+    # A before B in every interleaving.
+    class Sender(Actor):
+        def on_start(self, id, out):
+            out.send(Id(1), "A")
+            out.send(Id(1), "B")
+            return ()
+
+    class Receiver(Actor):
+        def on_start(self, id, out):
+            return ()
+
+        def on_msg(self, id, state, src, msg, out):
+            state.set(state.value + (msg,))
+
+    model = (
+        ActorModel()
+        .actor(Sender())
+        .actor(Receiver())
+        .init_network(Network.new_ordered())
+        .property(
+            Expectation.ALWAYS,
+            "fifo",
+            lambda m, s: s.actor_states[1] in ((), ("A",), ("A", "B")),
+        )
+    )
+    checker = model.checker().spawn_bfs().join()
+    checker.assert_no_discovery("fifo")
+    assert checker.unique_state_count() == 3
+
+
+def test_envelope_iteration_deterministic():
+    n = Network.new_unordered_nonduplicating()
+    for env in [
+        Envelope(Id(0), Id(1), "x"),
+        Envelope(Id(1), Id(0), "y"),
+        Envelope(Id(0), Id(1), "x"),
+    ]:
+        n = n.send(env)
+    assert len(n) == 3
+    assert list(n.iter_deliverable()) == list(n.iter_deliverable())
+    assert len(list(n.iter_all())) == 3
+    n2 = n.on_deliver(Envelope(Id(0), Id(1), "x"))
+    assert len(n2) == 2
+    with pytest.raises(KeyError):
+        n2.on_deliver(Envelope(Id(5), Id(6), "zzz"))
+
+
+def test_network_from_name_roundtrip():
+    for name in Network.names():
+        assert Network.from_name(name) is not None
+    with pytest.raises(ValueError):
+        Network.from_name("carrier pigeon")

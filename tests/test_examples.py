@@ -1,0 +1,96 @@
+"""Example workloads with reference-pinned unique-state counts.
+
+All counts are implementation-independent ground truth from the
+reference's own tests (BASELINE.md): 2pc 3 RMs = 288, 5 RMs = 8,832
+(665 with symmetry); paxos 2c/3s = 16,668 (BFS and DFS agree);
+ABD 2c/2s = 544; increment 2 threads = 13 (8 with symmetry).
+"""
+
+import pytest
+
+from stateright_tpu.models.increment import Increment, IncrementLock
+from stateright_tpu.models.linearizable_register import AbdModelCfg, abd_model
+from stateright_tpu.models.paxos import PaxosModelCfg, paxos_model
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+
+def test_2pc_3rms_288_states():
+    checker = TwoPhaseSys(rm_count=3).checker().spawn_bfs().join()
+    assert checker.unique_state_count() == 288
+    checker.assert_properties()
+
+
+def test_2pc_5rms_8832_states():
+    checker = TwoPhaseSys(rm_count=5).checker().spawn_dfs().join()
+    assert checker.unique_state_count() == 8832
+    checker.assert_properties()
+
+
+def test_2pc_5rms_symmetry_665_states():
+    checker = (
+        TwoPhaseSys(rm_count=5).checker().symmetry().spawn_dfs().join()
+    )
+    assert checker.unique_state_count() == 665
+    checker.assert_properties()
+
+
+def test_increment_race_found():
+    checker = Increment(thread_count=2).checker().spawn_bfs().join()
+    assert checker.unique_state_count() == 13
+    # The lost update is discovered.
+    path = checker.assert_any_discovery("fin")
+    final = path.last_state()
+    assert final.i < sum(1 for p in final.s if p.pc >= 3)
+
+
+def test_increment_symmetry_reduces_and_still_finds_race():
+    # The doc-stated 8 equivalence classes (increment.rs module docs)
+    # bound the reduced space; the checker early-exits once the "fin"
+    # violation is discovered, so the visited count is <= 8 and < 13.
+    checker = (
+        Increment(thread_count=2).checker().symmetry().spawn_dfs().join()
+    )
+    assert checker.unique_state_count() <= 8
+    checker.assert_any_discovery("fin")
+
+
+def test_increment_lock_holds():
+    checker = IncrementLock(thread_count=2).checker().spawn_bfs().join()
+    checker.assert_properties()  # both "fin" and "mutex" hold
+
+
+def test_increment_lock_symmetry_agrees():
+    plain = IncrementLock(thread_count=3).checker().spawn_dfs().join()
+    sym = IncrementLock(thread_count=3).checker().symmetry().spawn_dfs().join()
+    assert sym.unique_state_count() < plain.unique_state_count()
+    sym.assert_properties()
+
+
+def test_abd_2c2s_544_states():
+    checker = abd_model(AbdModelCfg(client_count=2, server_count=2)).checker().spawn_bfs().join()
+    checker.assert_properties()
+    assert checker.unique_state_count() == 544
+
+
+@pytest.mark.slow
+def test_paxos_2c3s_16668_states_bfs():
+    checker = (
+        paxos_model(PaxosModelCfg(client_count=2, server_count=3))
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    checker.assert_properties()
+    assert checker.unique_state_count() == 16668
+
+
+@pytest.mark.slow
+def test_paxos_2c3s_16668_states_dfs():
+    checker = (
+        paxos_model(PaxosModelCfg(client_count=2, server_count=3))
+        .checker()
+        .spawn_dfs()
+        .join()
+    )
+    checker.assert_properties()
+    assert checker.unique_state_count() == 16668

@@ -1,0 +1,167 @@
+"""Consistency semantics: specs, testers, and the single-copy example.
+
+Pinned ground truth: single-copy register 2 clients / 1 server = 93
+unique states (reference examples/single-copy-register.rs:110);
+2 clients / 2 servers is not linearizable.
+"""
+
+from stateright_tpu.semantics import (
+    Len,
+    LenOk,
+    LinearizabilityTester,
+    Pop,
+    PopOk,
+    Push,
+    PushOk,
+    ReadOk,
+    ReadOp,
+    Register,
+    SequentialConsistencyTester,
+    Vec,
+    WORegister,
+    WriteFail,
+    WriteOk,
+    WriteOp,
+)
+from stateright_tpu.models.single_copy_register import (
+    SingleCopyRegisterCfg,
+    single_copy_register_model,
+)
+
+
+# -- reference objects --------------------------------------------------
+
+
+def test_register_spec():
+    reg = Register(0)
+    reg2, ret = reg.invoke(WriteOp(5))
+    assert ret == WriteOk() and reg2.value == 5
+    _, ret = reg2.invoke(ReadOp())
+    assert ret == ReadOk(5)
+    assert reg.is_valid_history([(WriteOp(1), WriteOk()), (ReadOp(), ReadOk(1))])
+    assert not reg.is_valid_history([(WriteOp(1), WriteOk()), (ReadOp(), ReadOk(2))])
+
+
+def test_write_once_register_spec():
+    wo = WORegister()
+    wo2, ret = wo.invoke(WriteOp("a"))
+    assert ret == WriteOk()
+    _, ret = wo2.invoke(WriteOp("b"))
+    assert ret == WriteFail()
+    _, ret = wo2.invoke(ReadOp())
+    assert ret == ReadOk("a")
+
+
+def test_vec_spec():
+    v = Vec()
+    assert v.is_valid_history(
+        [
+            (Push(1), PushOk()),
+            (Push(2), PushOk()),
+            (Len(), LenOk(2)),
+            (Pop(), PopOk(2)),
+            (Pop(), PopOk(1)),
+            (Pop(), PopOk(None)),
+        ]
+    )
+    assert not v.is_valid_history([(Pop(), PopOk(7))])
+
+
+# -- linearizability ----------------------------------------------------
+
+
+def test_linearizable_sequential_history():
+    t = LinearizabilityTester(Register(0))
+    t = t.on_invoke(1, WriteOp(5)).on_return(1, WriteOk())
+    t = t.on_invoke(2, ReadOp()).on_return(2, ReadOk(5))
+    assert t.is_consistent()
+    assert t.serialized_history() == [
+        (WriteOp(5), WriteOk()),
+        (ReadOp(), ReadOk(5)),
+    ]
+
+
+def test_linearizability_rejects_stale_read_after_write():
+    # Thread 2's read starts after thread 1's write completed, so it
+    # must observe the new value (the real-time constraint).
+    t = LinearizabilityTester(Register(0))
+    t = t.on_invoke(1, WriteOp(5)).on_return(1, WriteOk())
+    t = t.on_invoke(2, ReadOp()).on_return(2, ReadOk(0))
+    assert not t.is_consistent()
+
+
+def test_sequential_consistency_allows_stale_read():
+    # The same history IS sequentially consistent: the read may be
+    # ordered before the write.
+    t = SequentialConsistencyTester(Register(0))
+    t = t.on_invoke(1, WriteOp(5)).on_return(1, WriteOk())
+    t = t.on_invoke(2, ReadOp()).on_return(2, ReadOk(0))
+    assert t.is_consistent()
+
+
+def test_concurrent_ops_may_linearize_either_way():
+    t = LinearizabilityTester(Register(0))
+    t = t.on_invoke(1, WriteOp(5))  # still in flight
+    t = t.on_invoke(2, ReadOp()).on_return(2, ReadOk(5))  # sees it anyway
+    assert t.is_consistent()
+
+    t2 = LinearizabilityTester(Register(0))
+    t2 = t2.on_invoke(1, WriteOp(5))
+    t2 = t2.on_invoke(2, ReadOp()).on_return(2, ReadOk(0))  # or not
+    assert t2.is_consistent()
+
+
+def test_in_flight_op_may_stay_unlinearized():
+    t = LinearizabilityTester(Register(0))
+    t = t.on_invoke(1, WriteOp(5))  # never returns
+    t = t.on_invoke(2, ReadOp()).on_return(2, ReadOk(0))
+    assert t.is_consistent()
+
+
+def test_double_invoke_invalidates_history():
+    t = LinearizabilityTester(Register(0))
+    t = t.on_invoke(1, WriteOp(1)).on_invoke(1, WriteOp(2))
+    assert not t.is_consistent()
+
+
+def test_return_without_invoke_invalidates_history():
+    t = LinearizabilityTester(Register(0)).on_return(9, WriteOk())
+    assert not t.is_consistent()
+
+
+def test_program_order_enforced():
+    # One thread's ops must linearize in program order.
+    t = LinearizabilityTester(Register(0))
+    t = t.on_invoke(1, WriteOp(1)).on_return(1, WriteOk())
+    t = t.on_invoke(1, WriteOp(2)).on_return(1, WriteOk())
+    t = t.on_invoke(1, ReadOp()).on_return(1, ReadOk(1))
+    assert not t.is_consistent()
+
+
+# -- end-to-end: single-copy register example ---------------------------
+
+
+def test_single_copy_register_one_server_linearizable_93_states():
+    checker = (
+        single_copy_register_model(
+            SingleCopyRegisterCfg(client_count=2, server_count=1)
+        )
+        .checker()
+        .spawn_dfs()
+        .join()
+    )
+    checker.assert_properties()
+    assert checker.unique_state_count() == 93
+
+
+def test_single_copy_register_two_servers_not_linearizable():
+    checker = (
+        single_copy_register_model(
+            SingleCopyRegisterCfg(client_count=2, server_count=2)
+        )
+        .checker()
+        .spawn_bfs()
+        .join()
+    )
+    checker.assert_any_discovery("linearizable")
+    checker.assert_any_discovery("value chosen")

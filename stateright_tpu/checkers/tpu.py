@@ -1,0 +1,483 @@
+"""The TPU wave engine: breadth-first search as vectorized XLA programs.
+
+This is the performance core of the framework — the re-design of the
+reference's thread-pool BFS (src/checker/bfs.rs + src/job_market.rs)
+for accelerators. One *wave* processes the entire frontier as a single
+jitted device program:
+
+    frontier ──vmap step──▶ padded successors ──fingerprint──▶
+    sort+unique ──▶ table insert-if-absent ──▶ compact new frontier
+
+Property predicates are evaluated as bitmaps over the frontier;
+``EventuallyBits`` ride along each frontier row exactly as in the
+reference (checker.rs:559-566, including the documented revisit
+false-negative, bfs.rs:285-303). The host keeps only what the
+reference keeps on the host side too: the child→parent fingerprint
+forest for counterexample reconstruction (bfs.rs:28-29, 371-400) and
+discovery bookkeeping. Path recovery replays the *host* model and
+matches device fingerprints of encoded successors — which doubles as a
+continuous differential check that the encoding agrees with the host
+semantics.
+
+Multi-chip scale-out (sharded frontier + all-to-all shuffle by
+fingerprint, replacing job_market.rs work stealing) lives in
+:mod:`stateright_tpu.parallel` and wraps this same wave body in
+``shard_map``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from ..checker import Checker, CheckerBuilder
+from ..encoding import EncodedModel
+from ..model import Expectation
+from ..ops.fingerprint import fingerprint_u32v
+from ..ops.hashset import DeviceHashSet, insert, sort_unique
+from ..path import Path
+from ..report import ReportData, Reporter
+
+_SENTINEL = 0xFFFFFFFF  # sort key for invalid successor rows
+
+# Wave programs are expensive to compile (the K-successor builder and
+# probe loop unroll into a large XLA graph) and identical across
+# checker instances with the same encoding and shapes — cache them.
+_WAVE_CACHE: dict = {}
+_PERSISTENT_CACHE_SET = False
+
+
+def _enable_persistent_cache() -> None:
+    """Route XLA compilations through a disk cache so repeated runs
+    (tests, CLI re-invocations) skip the multi-second compile."""
+    global _PERSISTENT_CACHE_SET
+    if _PERSISTENT_CACHE_SET:
+        return
+    _PERSISTENT_CACHE_SET = True
+    import os
+
+    import jax
+
+    if jax.config.jax_compilation_cache_dir is None:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.expanduser("~/.cache/stateright_tpu_xla"),
+        )
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def _fp_int(lo, hi) -> int:
+    return (int(hi) << 32) | int(lo)
+
+
+def _combine64(lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
+    return (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
+
+
+class TpuBfsChecker(Checker):
+    """``CheckerBuilder.spawn_tpu()`` — the reference's ``spawn_bfs``
+    offloaded to a device (BASELINE.json north star)."""
+
+    def __init__(
+        self,
+        builder: CheckerBuilder,
+        encoded: Optional[EncodedModel] = None,
+        capacity: int = 1 << 16,
+        frontier_capacity: Optional[int] = None,
+        track_paths: bool = True,
+    ):
+        super().__init__(builder)
+        if builder._symmetry is not None:
+            raise ValueError("symmetry reduction requires spawn_dfs")
+        if encoded is None:
+            to_encoded = getattr(builder.model, "to_encoded", None)
+            if to_encoded is None:
+                raise ValueError(
+                    "spawn_tpu requires an EncodedModel: pass encoded=... or "
+                    "implement Model.to_encoded()"
+                )
+            encoded = to_encoded()
+        self.encoded = encoded
+        self.capacity = capacity
+        self.frontier_capacity = frontier_capacity or capacity
+        self.track_paths = track_paths
+        #: child vec-fingerprint -> parent vec-fingerprint (None = init)
+        self.generated: dict[int, Optional[int]] = {}
+        #: property name -> fingerprint of the discovery state; always
+        #: populated (drives early exit) even when track_paths=False
+        #: suppresses Path materialization.
+        self._discovered_fps: dict[str, int] = {}
+        self._wave_fn = None
+
+    def _all_discovered(self) -> bool:
+        props = self.model.properties()
+        return len(props) > 0 and all(
+            p.name in self._discovered_fps for p in props
+        )
+
+    def discovered_property_names(self) -> set:
+        """Names with a discovery — available even with
+        ``track_paths=False`` (where full paths are not)."""
+        self._ensure_run()
+        return set(self._discovered_fps)
+
+    def discoveries(self):
+        if not self.track_paths and self._discovered_fps:
+            raise RuntimeError(
+                "paths unavailable with track_paths=False; use "
+                "discovered_property_names(), or re-run with "
+                "track_paths=True for counterexample traces"
+            )
+        return super().discoveries()
+
+    # -- device program --------------------------------------------------
+
+    def _build_wave(self):
+        import jax
+        import jax.numpy as jnp
+
+        enc = self.encoded
+        props = list(self.model.properties())
+        n_props = len(props)
+        evt_idx = [
+            i for i, p in enumerate(props)
+            if p.expectation == Expectation.EVENTUALLY
+        ]
+        if evt_idx and max(evt_idx) >= 32:
+            # ebits ride in a uint32 lane; 1 << 32 would silently wrap.
+            raise ValueError(
+                "the TPU engine supports eventually properties only at "
+                "property indices < 32; reorder properties() so eventually "
+                f"properties come first (got index {max(evt_idx)})"
+            )
+        K, W, F = enc.max_actions, enc.width, self.frontier_capacity
+
+        def wave(table: DeviceHashSet, frontier, fval, ebits, expand: bool):
+            # Frontier digests (for parent pointers and discoveries).
+            f_lo, f_hi = fingerprint_u32v(frontier, jnp)
+
+            # Property bitmap over the frontier (bfs.rs:223-268).
+            if n_props:
+                cond = jax.vmap(enc.property_conditions_vec)(frontier)
+                cond = cond & fval[:, None]
+            else:
+                cond = jnp.zeros((F, 0), dtype=bool)
+            # Clear satisfied eventually-bits (checker.rs:559-566).
+            for i in evt_idx:
+                ebits = jnp.where(cond[:, i], ebits & ~jnp.uint32(1 << i), ebits)
+
+            if expand:
+                succs, valid = jax.vmap(enc.step_vec)(frontier)
+                valid = valid & fval[:, None]
+                bound = jax.vmap(
+                    lambda row: jax.vmap(enc.within_boundary_vec)(row)
+                )(succs)
+                valid = valid & bound
+            else:
+                succs = jnp.zeros((F, K, W), dtype=jnp.uint32)
+                valid = jnp.zeros((F, K), dtype=bool)
+
+            # Terminal rows: no successors at all → surviving
+            # eventually-bits are counterexamples (bfs.rs:317-324).
+            # Depth-cut waves (expand=False) are not terminal.
+            if expand:
+                terminal = fval & ~jnp.any(valid, axis=1)
+            else:
+                terminal = jnp.zeros(F, dtype=bool)
+            evt_cex = terminal & (ebits != 0)
+
+            flat = succs.reshape(F * K, W)
+            v = valid.reshape(F * K)
+            c_lo, c_hi = fingerprint_u32v(flat, jnp)
+            c_lo = jnp.where(v, c_lo, jnp.uint32(_SENTINEL))
+            c_hi = jnp.where(v, c_hi, jnp.uint32(_SENTINEL))
+            p_lo = jnp.repeat(f_lo, K)
+            p_hi = jnp.repeat(f_hi, K)
+            child_ebits = jnp.repeat(ebits, K)
+
+            (s_lo, s_hi, order), first = sort_unique(c_lo, c_hi, jnp)
+            v_sorted = v[order]
+            active = first & v_sorted
+            table, is_new, overflow = insert(table, s_lo, s_hi, active, jnp)
+
+            # Compact new states into the next frontier. Non-new rows
+            # scatter to index F*K, which is out of range for every
+            # output buffer and dropped.
+            new_count = jnp.sum(is_new)
+            pos = jnp.cumsum(is_new) - 1
+            scatter_pos = jnp.where(is_new, pos, F * K)
+            next_frontier = jnp.zeros((F, W), dtype=jnp.uint32).at[
+                scatter_pos
+            ].set(flat[order], mode="drop")
+            next_ebits = jnp.zeros(F, dtype=jnp.uint32).at[scatter_pos].set(
+                child_ebits[order], mode="drop"
+            )
+            next_fval = jnp.arange(F) < new_count
+
+            # Per-wave host transfer: new fingerprints + their parents.
+            out_lo = jnp.zeros(F * K, dtype=jnp.uint32).at[scatter_pos].set(
+                s_lo, mode="drop"
+            )
+            out_hi = jnp.zeros(F * K, dtype=jnp.uint32).at[scatter_pos].set(
+                s_hi, mode="drop"
+            )
+            out_plo = jnp.zeros(F * K, dtype=jnp.uint32).at[scatter_pos].set(
+                p_lo[order], mode="drop"
+            )
+            out_phi = jnp.zeros(F * K, dtype=jnp.uint32).at[scatter_pos].set(
+                p_hi[order], mode="drop"
+            )
+
+            # Discovery summaries: one candidate fingerprint per property.
+            def first_fp(mask):
+                any_hit = jnp.any(mask)
+                row = jnp.argmax(mask)
+                return any_hit, f_lo[row], f_hi[row]
+
+            disc_found = []
+            disc_lo = []
+            disc_hi = []
+            for i, p in enumerate(props):
+                if p.expectation == Expectation.ALWAYS:
+                    mask = fval & ~cond[:, i]
+                elif p.expectation == Expectation.SOMETIMES:
+                    mask = cond[:, i]
+                else:
+                    mask = evt_cex & ((ebits & jnp.uint32(1 << i)) != 0)
+                hit, lo_, hi_ = first_fp(mask)
+                disc_found.append(hit)
+                disc_lo.append(lo_)
+                disc_hi.append(hi_)
+            disc_found = (
+                jnp.stack(disc_found) if disc_found else jnp.zeros(0, bool)
+            )
+            disc_lo = (
+                jnp.stack(disc_lo) if disc_lo else jnp.zeros(0, jnp.uint32)
+            )
+            disc_hi = (
+                jnp.stack(disc_hi) if disc_hi else jnp.zeros(0, jnp.uint32)
+            )
+
+            total_generated = jnp.sum(v)
+            return dict(
+                table=table,
+                frontier=next_frontier,
+                fval=next_fval,
+                ebits=next_ebits,
+                new_count=new_count,
+                total_generated=total_generated,
+                overflow=jnp.any(overflow),
+                new_lo=out_lo,
+                new_hi=out_hi,
+                par_lo=out_plo,
+                par_hi=out_phi,
+                disc_found=disc_found,
+                disc_lo=disc_lo,
+                disc_hi=disc_hi,
+            )
+
+        return jax.jit(wave, static_argnames=("expand",))
+
+    # -- host orchestration ----------------------------------------------
+
+    def _run(self, reporter: Optional[Reporter] = None) -> None:
+        import jax.numpy as jnp
+
+        enc = self.encoded
+        props = list(self.model.properties())
+        F, W = self.frontier_capacity, enc.width
+        target_states = self.builder._target_state_count
+        target_depth = self.builder._target_max_depth
+        if self.builder._visitor is not None:
+            raise ValueError(
+                "visitors require a host checker (spawn_bfs/spawn_dfs); the "
+                "TPU engine keeps full states on device only"
+            )
+
+        if self._wave_fn is None:
+            _enable_persistent_cache()
+            # Share compiled waves between checkers only when the
+            # encoding declares an identity (cache_key): shapes alone
+            # can't distinguish different transition functions.
+            key_fn = getattr(enc, "cache_key", None)
+            if key_fn is not None:
+                cache_key = (
+                    type(enc),
+                    key_fn(),
+                    enc.width,
+                    enc.max_actions,
+                    F,
+                    self.capacity,
+                    tuple((p.name, p.expectation) for p in props),
+                )
+                if cache_key not in _WAVE_CACHE:
+                    _WAVE_CACHE[cache_key] = self._build_wave()
+                self._wave_fn = _WAVE_CACHE[cache_key]
+            else:
+                self._wave_fn = self._build_wave()
+
+        # Seed: encoded init states, deduped, inserted into the table.
+        # (Init states are assumed within the boundary, as is true of
+        # every reference workload; successors are boundary-filtered on
+        # device each wave.)
+        init = np.asarray(enc.init_vecs(), dtype=np.uint32).reshape(-1, W)
+        seen = set()
+        rows = []
+        for row in init:
+            fp = self._vec_fp(row)
+            if fp not in seen:
+                seen.add(fp)
+                rows.append(row)
+                self.generated[fp] = None
+        init = np.stack(rows) if rows else np.zeros((0, W), np.uint32)
+        n0 = init.shape[0]
+        if n0 > F:
+            raise ValueError(f"frontier capacity {F} < {n0} init states")
+        self._total_states += n0
+        self._unique_states += n0
+
+        frontier = jnp.zeros((F, W), dtype=jnp.uint32).at[:n0].set(init)
+        fval = jnp.arange(F) < n0
+        ebits = jnp.where(
+            fval, jnp.uint32(self._eventually_bits_init()), jnp.uint32(0)
+        )
+        # Seed the table host-side, then transfer once.
+        lo0, hi0 = fingerprint_u32v(init, np)
+        (slo, shi, _), first = sort_unique(
+            np.asarray(lo0, np.uint32), np.asarray(hi0, np.uint32), np
+        )
+        table_np = DeviceHashSet.empty(self.capacity, np)
+        table_np, _, seed_overflow = insert(table_np, slo, shi, first, np)
+        if bool(np.any(seed_overflow)):
+            raise RuntimeError(
+                f"visited table overflow while seeding {n0} init states "
+                f"(capacity={self.capacity}); re-run with a larger capacity"
+            )
+        table = DeviceHashSet(jnp.asarray(table_np.lo), jnp.asarray(table_np.hi))
+
+        depth = 1
+        while True:
+            self._max_depth = max(self._max_depth, depth)
+            expand = not (target_depth is not None and depth >= target_depth)
+            out = self._wave_fn(table, frontier, fval, ebits, expand=expand)
+            table = out["table"]
+
+            if bool(out["overflow"]):
+                raise RuntimeError(
+                    f"visited table overflow (capacity={self.capacity}); "
+                    "re-run with a larger capacity"
+                )
+
+            new_count = int(out["new_count"])
+            self._total_states += int(out["total_generated"])
+            self._unique_states += new_count
+
+            if self.track_paths and new_count:
+                # Vectorized parent-map update: table-new keys cannot
+                # already be present (the table mirrors `generated`).
+                child = _combine64(
+                    np.asarray(out["new_lo"][:new_count]),
+                    np.asarray(out["new_hi"][:new_count]),
+                )
+                parent = _combine64(
+                    np.asarray(out["par_lo"][:new_count]),
+                    np.asarray(out["par_hi"][:new_count]),
+                )
+                self.generated.update(zip(child.tolist(), parent.tolist()))
+
+            # Discoveries (host side, mirrors bfs.rs discovery
+            # recording) — after the parent map grew this wave.
+            disc_found = np.asarray(out["disc_found"])
+            disc_lo = np.asarray(out["disc_lo"])
+            disc_hi = np.asarray(out["disc_hi"])
+            for i, prop in enumerate(props):
+                if disc_found[i] and prop.name not in self._discovered_fps:
+                    fp = _fp_int(disc_lo[i], disc_hi[i])
+                    self._discovered_fps[prop.name] = fp
+                    if self.track_paths:
+                        self._discoveries[prop.name] = self._reconstruct(fp)
+
+            if self._all_discovered():
+                break
+            if target_states is not None and self._unique_states >= target_states:
+                break
+            if new_count == 0:
+                break
+            if new_count > F:
+                raise RuntimeError(
+                    f"frontier overflow: wave produced {new_count} > {F} "
+                    "states; re-run with a larger frontier_capacity"
+                )
+
+            frontier = out["frontier"]
+            fval = out["fval"]
+            ebits = out["ebits"]
+            depth += 1
+
+            if reporter is not None:
+                reporter.report_checking(
+                    ReportData(
+                        total_states=self._total_states,
+                        unique_states=self._unique_states,
+                        max_depth=self._max_depth,
+                        duration_sec=self.duration_sec(),
+                        done=False,
+                    )
+                )
+
+    # -- reconstruction ---------------------------------------------------
+
+    def _vec_fp(self, row: np.ndarray) -> int:
+        lo, hi = fingerprint_u32v(row.reshape(1, -1), np)
+        return _fp_int(lo[0], hi[0])
+
+    def _reconstruct(self, fp: int) -> Path:
+        """Walk the parent forest, then replay the HOST model matching
+        device fingerprints of encoded successors (bfs.rs:371-400 +
+        path.rs:20-97, with the encoder as the bridge)."""
+        if not self.track_paths:
+            raise RuntimeError(
+                "path reconstruction requires track_paths=True"
+            )
+        fps = [fp]
+        while True:
+            parent = self.generated.get(fps[-1])
+            if parent is None:
+                break
+            fps.append(parent)
+        fps.reverse()
+
+        model = self.model
+        enc = self.encoded
+        state = None
+        for init_state in model.init_states():
+            if self._vec_fp(np.asarray(enc.encode(init_state), np.uint32)) == fps[0]:
+                state = init_state
+                break
+        if state is None:
+            raise RuntimeError(
+                f"no init state encodes to fingerprint {fps[0]:#x}; "
+                "encode()/init_vecs() disagree"
+            )
+        steps = []
+        for next_fp in fps[1:]:
+            found = False
+            for action in model.actions(state):
+                next_state = model.next_state(state, action)
+                if next_state is None:
+                    continue
+                vec = np.asarray(enc.encode(next_state), np.uint32)
+                if self._vec_fp(vec) == next_fp:
+                    steps.append((state, action))
+                    state = next_state
+                    found = True
+                    break
+            if not found:
+                raise RuntimeError(
+                    f"no host successor encodes to {next_fp:#x}: the "
+                    "vectorized step_vec disagrees with the host model"
+                )
+        steps.append((state, None))
+        return Path(steps)

@@ -1,0 +1,76 @@
+"""Fixed-width state encodings: the bridge from models to the TPU engine.
+
+The reference's north star calls for a ``#[derive(TpuState)]``-style
+mapping from model states to fixed-width vectors so successor
+generation runs as a vmapped pure function (BASELINE.json). This module
+defines that contract: an :class:`EncodedModel` pairs a host
+:class:`~stateright_tpu.model.Model` (the semantic ground truth and
+replay oracle) with
+
+* a ``uint32[width]`` state layout,
+* a pure, jax-traceable ``step_vec`` producing all (padded) successors
+  of one state at once, and
+* vectorized property / boundary predicates aligned index-for-index
+  with the host model's ``properties()``.
+
+Dynamic host structures map to bounded canonical device forms
+(SURVEY.md §7 step 2): message multisets become count-lane rows or
+bitmasks kept in sorted order, FIFO channels become fixed rings, timer
+sets become bitmasks — so that equal host states encode to equal
+vectors and fingerprint identically.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol, Sequence, runtime_checkable
+
+import numpy as np
+
+from .model import Model
+
+
+@runtime_checkable
+class EncodedModel(Protocol):
+    #: uint32 lanes per state
+    width: int
+    #: padded successor count per state (static K)
+    max_actions: int
+    #: the semantic ground truth; also supplies properties() and replay
+    host_model: Model
+
+    def init_vecs(self) -> np.ndarray:
+        """uint32[N0, width] — encoded init states (host-side numpy)."""
+        ...
+
+    def step_vec(self, vec: Any) -> tuple[Any, Any]:
+        """Pure jax function on ONE encoded state:
+        ``uint32[width] -> (uint32[max_actions, width], bool[max_actions])``.
+        The engine vmaps this over the frontier."""
+        ...
+
+    def property_conditions_vec(self, vec: Any) -> Any:
+        """Pure jax function: ``uint32[width] -> bool[P]`` — the truth of
+        each host property's condition at this state, in
+        ``host_model.properties()`` order."""
+        ...
+
+    def within_boundary_vec(self, vec: Any) -> Any:
+        """Pure jax function: ``uint32[width] -> bool``."""
+        ...
+
+    def encode(self, state: Any) -> np.ndarray:
+        """Host state -> uint32[width]; must be canonical (equal states
+        encode equal) and consistent with ``step_vec`` — the engine
+        replays counterexample traces through the host model and
+        matches fingerprints of encoded successors."""
+        ...
+
+
+class EncodedModelBase:
+    """Convenience defaults."""
+
+    def within_boundary_vec(self, vec):
+        return True
+
+    def decode(self, vec) -> Any:
+        raise NotImplementedError

@@ -1,0 +1,177 @@
+"""Vectorized two-phase commit: the TPU-engine proving ground.
+
+Encodes :class:`~stateright_tpu.models.two_phase_commit.TwoPhaseSys`
+(reference examples/2pc.rs) as fixed-width uint32 vectors, with the
+whole action set generated branchlessly per state — the
+``#[derive(TpuState)]`` pattern from the north star, done by hand
+(SURVEY.md §7 step 2 names 2pc as the proving ground).
+
+Layout (``width = rm_count + 3`` lanes):
+  [0 .. N-1]  rm_state enum (0=Working 1=Prepared 2=Committed 3=Aborted)
+  [N]         tm_state enum (0=Init 1=Committed 2=Aborted)
+  [N+1]       tm_prepared bitmask
+  [N+2]       message-set bitmask: bit0=commit, bit1=abort,
+              bit (2+rm)=prepared(rm)
+
+Every dynamic host structure (the message *set*) is a bitmask here, so
+equal host states encode to identical vectors canonically.
+
+Actions (``max_actions = 2 + 5*N``), mirroring 2pc.rs actions():
+  0: tm_commit        1: tm_abort
+  per rm: tm_rcv_prepared, rm_prepare, rm_choose_abort,
+          rm_rcv_commit, rm_rcv_abort
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..encoding import EncodedModelBase
+from .two_phase_commit import RmState, TmState, TwoPhaseState, TwoPhaseSys
+
+_WORKING, _PREPARED, _COMMITTED, _ABORTED = 0, 1, 2, 3
+_INIT, _TM_COMMITTED, _TM_ABORTED = 0, 1, 2
+
+
+class TwoPhaseSysEncoded(EncodedModelBase):
+    def __init__(self, rm_count: int):
+        self.rm_count = rm_count
+        self.width = rm_count + 3
+        self.max_actions = 2 + 5 * rm_count
+        self.host_model = TwoPhaseSys(rm_count=rm_count)
+
+    def cache_key(self):
+        """Compiled-wave sharing identity (see checkers/tpu.py)."""
+        return self.rm_count
+
+    # -- host side -------------------------------------------------------
+
+    def encode(self, state: TwoPhaseState) -> np.ndarray:
+        n = self.rm_count
+        vec = np.zeros(self.width, dtype=np.uint32)
+        for i, rm in enumerate(state.rm_state):
+            vec[i] = rm.value
+        vec[n] = state.tm_state.value
+        prep = 0
+        for i, p in enumerate(state.tm_prepared):
+            if p:
+                prep |= 1 << i
+        vec[n + 1] = prep
+        msgs = 0
+        for m in state.msgs:
+            if m == ("commit",):
+                msgs |= 1
+            elif m == ("abort",):
+                msgs |= 2
+            else:
+                msgs |= 1 << (2 + m[1])
+        vec[n + 2] = msgs
+        return vec
+
+    def decode(self, vec: np.ndarray) -> TwoPhaseState:
+        n = self.rm_count
+        vec = np.asarray(vec)
+        msgs = set()
+        m = int(vec[n + 2])
+        if m & 1:
+            msgs.add(("commit",))
+        if m & 2:
+            msgs.add(("abort",))
+        for i in range(n):
+            if m & (1 << (2 + i)):
+                msgs.add(("prepared", i))
+        return TwoPhaseState(
+            rm_state=tuple(RmState(int(vec[i])) for i in range(n)),
+            tm_state=TmState(int(vec[n])),
+            tm_prepared=tuple(
+                bool(int(vec[n + 1]) & (1 << i)) for i in range(n)
+            ),
+            msgs=frozenset(msgs),
+        )
+
+    def init_vecs(self) -> np.ndarray:
+        return np.stack(
+            [self.encode(s) for s in self.host_model.init_states()]
+        )
+
+    # -- device side -----------------------------------------------------
+
+    def step_vec(self, vec):
+        """uint32[W] -> (uint32[K, W], bool[K]); mirrors 2pc.rs
+        actions()/next_state() as branchless lane updates."""
+        import jax.numpy as jnp
+
+        n = self.rm_count
+        tm = vec[n]
+        prep = vec[n + 1]
+        msgs = vec[n + 2]
+        full_prep = jnp.uint32((1 << n) - 1)
+
+        def set_lane(v, lane, value):
+            return v.at[lane].set(jnp.uint32(value))
+
+        succs = []
+        valids = []
+
+        # tm_commit: all prepared & TM still deciding.
+        s = set_lane(vec, n, _TM_COMMITTED)
+        s = s.at[n + 2].set(msgs | jnp.uint32(1))
+        succs.append(s)
+        valids.append((tm == _INIT) & (prep == full_prep))
+
+        # tm_abort
+        s = set_lane(vec, n, _TM_ABORTED)
+        s = s.at[n + 2].set(msgs | jnp.uint32(2))
+        succs.append(s)
+        valids.append(tm == _INIT)
+
+        for rm in range(n):
+            rm_working = vec[rm] == _WORKING
+            prepared_bit = jnp.uint32(1 << (2 + rm))
+
+            # tm_rcv_prepared(rm)
+            s = vec.at[n + 1].set(prep | jnp.uint32(1 << rm))
+            succs.append(s)
+            valids.append((tm == _INIT) & ((msgs & prepared_bit) != 0))
+
+            # rm_prepare(rm)
+            s = set_lane(vec, rm, _PREPARED)
+            s = s.at[n + 2].set(msgs | prepared_bit)
+            succs.append(s)
+            valids.append(rm_working)
+
+            # rm_choose_abort(rm)
+            succs.append(set_lane(vec, rm, _ABORTED))
+            valids.append(rm_working)
+
+            # rm_rcv_commit(rm)
+            succs.append(set_lane(vec, rm, _COMMITTED))
+            valids.append((msgs & jnp.uint32(1)) != 0)
+
+            # rm_rcv_abort(rm)
+            succs.append(set_lane(vec, rm, _ABORTED))
+            valids.append((msgs & jnp.uint32(2)) != 0)
+
+        return jnp.stack(succs), jnp.stack(valids)
+
+    def property_conditions_vec(self, vec):
+        """[sometimes abort agreement, sometimes commit agreement,
+        always consistent] — order matches TwoPhaseSys.properties()."""
+        import jax.numpy as jnp
+
+        n = self.rm_count
+        rms = vec[:n]
+        all_aborted = jnp.all(rms == _ABORTED)
+        all_committed = jnp.all(rms == _COMMITTED)
+        consistent = ~(
+            jnp.any(rms == _ABORTED) & jnp.any(rms == _COMMITTED)
+        )
+        return jnp.stack([all_aborted, all_committed, consistent])
+
+
+def _to_encoded(self: TwoPhaseSys) -> TwoPhaseSysEncoded:
+    return TwoPhaseSysEncoded(self.rm_count)
+
+
+# spawn_tpu() discovers encodings via Model.to_encoded().
+TwoPhaseSys.to_encoded = _to_encoded

@@ -1,0 +1,25 @@
+"""Device kernels for the TPU wave engine.
+
+The perf-critical inner ops of the reference's checkers — state
+fingerprinting (src/lib.rs:329-375), the concurrent visited set
+(bfs.rs:28-29 DashMap), and frontier queue management
+(job_market.rs) — re-designed as vectorized XLA ops over ``uint32``
+lanes: limb-based 64-bit hashing, a device-resident open-addressing
+hash set with batched scatter-claim insertion, and mask/scan
+compaction.
+"""
+
+from .u64 import U64, u64_add, u64_mul, u64_shr, u64_xor
+from .fingerprint import fingerprint_u32v, splitmix64
+from .hashset import DeviceHashSet
+
+__all__ = [
+    "U64",
+    "u64_add",
+    "u64_mul",
+    "u64_shr",
+    "u64_xor",
+    "fingerprint_u32v",
+    "splitmix64",
+    "DeviceHashSet",
+]

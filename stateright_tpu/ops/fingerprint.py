@@ -1,0 +1,65 @@
+"""Vectorized 64-bit state fingerprinting over uint32 lanes.
+
+The device counterpart of the reference's stable fixed-key hasher
+(src/lib.rs:329-375): encoded states are fixed-width ``uint32``
+vectors; their digest is a splitmix64-style fold over the lanes with
+hard-coded keys, built from the limb arithmetic in
+:mod:`stateright_tpu.ops.u64` so jax.numpy (device) and numpy (host)
+produce bit-identical results. Zero is reserved as the empty-slot
+marker in the visited table, so a zero digest maps to 1 (the
+``NonZeroU64`` convention, src/lib.rs:329-337).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import numpy as np
+
+from .u64 import U64, u64_add, u64_const, u64_mul_const, u64_shr, u64_xor
+
+_SEED = 0x51A7E12D_0BADC0DE
+_GOLDEN = 0x9E3779B97F4A7C15
+_MIX1 = 0xBF58476D1CE4E5B9
+_MIX2 = 0x94D049BB133111EB
+
+
+def splitmix64(z: U64, xp=np) -> U64:
+    """The splitmix64 finalizer (Steele et al.), elementwise."""
+    z = u64_xor(z, u64_shr(z, 30))
+    z = u64_mul_const(z, _MIX1, xp)
+    z = u64_xor(z, u64_shr(z, 27))
+    z = u64_mul_const(z, _MIX2, xp)
+    z = u64_xor(z, u64_shr(z, 31))
+    return z
+
+
+def fingerprint_u32v(vec: Any, xp=np) -> Tuple[Any, Any]:
+    """Digest uint32 state vectors along the last axis.
+
+    ``vec``: uint32[..., W] → ``(lo, hi)``: uint32[...] each, never
+    both zero. The fold is sequential over the W lanes (W is small and
+    static; XLA unrolls it) and vectorized over every leading axis.
+    """
+    vec = xp.asarray(vec, dtype=xp.uint32)
+    w = vec.shape[-1]
+    zero = xp.zeros(vec.shape[:-1], dtype=xp.uint32)
+    h = U64(zero + xp.uint32(_SEED & 0xFFFFFFFF), zero + xp.uint32(_SEED >> 32))
+    for i in range(w):
+        lane = u64_add(
+            U64(vec[..., i], zero),
+            u64_const(_GOLDEN * (i + 1) & 0xFFFFFFFFFFFFFFFF, xp),
+        )
+        h = splitmix64(u64_xor(h, lane), xp)
+    # Reserve 0 as "empty" (NonZeroU64 convention).
+    both_zero = (h.lo == 0) & (h.hi == 0)
+    lo = xp.where(both_zero, xp.uint32(1), h.lo)
+    return lo, h.hi
+
+
+def fingerprint_u32v_int(vec: Any) -> Any:
+    """Host helper: digests as Python-friendly uint64 numpy array."""
+    lo, hi = fingerprint_u32v(np.asarray(vec, dtype=np.uint32), np)
+    return (np.asarray(hi, dtype=np.uint64) << np.uint64(32)) | np.asarray(
+        lo, dtype=np.uint64
+    )

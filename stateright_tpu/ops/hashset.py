@@ -1,0 +1,166 @@
+"""Device-resident visited set: open addressing over uint32 limb pairs.
+
+The TPU counterpart of the reference BFS's sharded concurrent
+``DashMap`` visited set (bfs.rs:28-29): a fixed-capacity power-of-two
+table of 64-bit fingerprints stored as two ``uint32`` arrays (0,0 =
+empty — fingerprints are never zero), with batched insert-if-absent.
+
+Batched insertion resolves conflicts without atomics:
+
+1. The caller pre-deduplicates the batch (sort + neighbor-compare, see
+   :func:`sort_unique`), so all competing keys are distinct.
+2. K probe rounds: each still-active key reads its slot; on empty it
+   *claims* via ``scatter-max`` of its row index into a claim array,
+   then re-reads to learn the winner; losers and occupied-by-other
+   keys re-probe triangularly. This is the classic GPU model-checker
+   table insert (cf. GPUexplore), expressed as XLA scatter/gather.
+
+Everything is functional: ``insert`` returns the new table arrays.
+The probe loop is a static Python loop (PROBE_ROUNDS is small) so XLA
+unrolls and fuses it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+PROBE_ROUNDS = 24
+
+
+class DeviceHashSet(NamedTuple):
+    """Table state (a pytree — pass through jit freely)."""
+
+    lo: Any  # uint32[capacity]
+    hi: Any  # uint32[capacity]
+
+    @staticmethod
+    def empty(capacity: int, xp) -> "DeviceHashSet":
+        if capacity & (capacity - 1):
+            raise ValueError(f"capacity must be a power of two: {capacity}")
+        return DeviceHashSet(
+            xp.zeros(capacity, dtype=xp.uint32),
+            xp.zeros(capacity, dtype=xp.uint32),
+        )
+
+    @property
+    def capacity(self) -> int:
+        return self.lo.shape[0]
+
+
+def _slot_hash(key_lo, key_hi, mask, xp):
+    # Cheap avalanche of the already-mixed fingerprint into a slot.
+    x = key_lo ^ (key_hi * xp.uint32(0x9E3779B9))
+    x = x ^ (x >> xp.uint32(16))
+    return x & mask
+
+
+def sort_unique(key_lo, key_hi, xp):
+    """Sort keys by (hi, lo) and mark the first occurrence of each.
+
+    Returns ``((sorted_lo, sorted_hi, order), unique_mask)``: the keys
+    in sorted order, the permutation ``order`` that produced them
+    (gather other per-key arrays with it), and ``unique_mask[i]`` True
+    iff sorted position i is the first of its key. Invalid entries
+    should be pre-set to the all-ones key so they sort last (and
+    collapse into one dup run).
+    """
+    n = key_lo.shape[0]
+    idx = xp.arange(n, dtype=xp.uint32)
+    if xp.__name__.startswith("jax"):
+        import jax
+
+        sorted_hi, sorted_lo, order = jax.lax.sort(
+            (key_hi, key_lo, idx), num_keys=2
+        )
+    else:
+        perm = xp.lexsort((key_lo, key_hi))
+        sorted_hi, sorted_lo, order = key_hi[perm], key_lo[perm], idx[perm]
+    prev_same = xp.zeros(n, dtype=bool)
+    if n > 1:
+        same = (sorted_hi[1:] == sorted_hi[:-1]) & (
+            sorted_lo[1:] == sorted_lo[:-1]
+        )
+        prev_same = xp.concatenate([xp.zeros(1, dtype=bool), same])
+    return (sorted_lo, sorted_hi, order), ~prev_same
+
+
+def insert(
+    table: DeviceHashSet,
+    key_lo: Any,
+    key_hi: Any,
+    active: Any,
+    xp,
+) -> Tuple[DeviceHashSet, Any, Any]:
+    """Insert distinct keys where ``active``; return
+    ``(new_table, is_new, overflow)``.
+
+    ``is_new[i]`` — key i was inserted (absent before); ``overflow[i]``
+    — probing exhausted without a slot (caller must grow + retry).
+    Keys in the batch MUST be distinct where active (use
+    :func:`sort_unique` first); inactive rows are ignored.
+    """
+    n = key_lo.shape[0]
+    mask = xp.uint32(table.capacity - 1)
+    row_ids = xp.arange(n, dtype=xp.uint32)
+    idx = _slot_hash(key_lo, key_hi, mask, xp)
+    lo, hi = table.lo, table.hi
+    if not xp.__name__.startswith("jax"):
+        lo, hi = lo.copy(), hi.copy()  # keep numpy path functional too
+    inserted = xp.zeros(n, dtype=bool)
+    found = xp.zeros(n, dtype=bool)
+    pending = active
+    for r in range(PROBE_ROUNDS):
+        slot_lo = lo[idx]
+        slot_hi = hi[idx]
+        is_empty = (slot_lo == 0) & (slot_hi == 0)
+        is_match = (slot_lo == key_lo) & (slot_hi == key_hi)
+        found = found | (pending & is_match)
+        pending = pending & ~is_match
+        # Claim empty slots: scatter-max row ids, winners re-read.
+        want = pending & is_empty
+        claims = xp.zeros(table.capacity, dtype=xp.uint32)
+        if xp.__name__.startswith("jax"):
+            claims = claims.at[idx].max(
+                xp.where(want, row_ids + 1, xp.uint32(0))
+            )
+        else:
+            import numpy as np
+
+            np.maximum.at(
+                claims, idx, xp.where(want, row_ids + 1, xp.uint32(0))
+            )
+        won = want & (claims[idx] == row_ids + 1)
+        if xp.__name__.startswith("jax"):
+            # Only winners write; losers scatter out of range (dropped).
+            # A plain at[idx].set with stale values for losers would
+            # race the winner's write at duplicate indices.
+            write_idx = xp.where(won, idx, xp.uint32(table.capacity))
+            lo = lo.at[write_idx].set(key_lo, mode="drop")
+            hi = hi.at[write_idx].set(key_hi, mode="drop")
+        else:
+            lo[idx[won]] = key_lo[won]
+            hi[idx[won]] = key_hi[won]
+        inserted = inserted | won
+        pending = pending & ~won
+        # Triangular re-probe for losers/occupied.
+        idx = (idx + xp.uint32(r + 1)) & mask
+    return DeviceHashSet(lo, hi), inserted, pending
+
+
+def contains(table: DeviceHashSet, key_lo: Any, key_hi: Any, xp) -> Any:
+    """Membership probe (no mutation)."""
+    mask = xp.uint32(table.capacity - 1)
+    idx = _slot_hash(key_lo, key_hi, mask, xp)
+    found = xp.zeros(key_lo.shape, dtype=bool)
+    missing = xp.zeros(key_lo.shape, dtype=bool)
+    done = xp.zeros(key_lo.shape, dtype=bool)
+    for r in range(PROBE_ROUNDS):
+        slot_lo = table.lo[idx]
+        slot_hi = table.hi[idx]
+        is_empty = (slot_lo == 0) & (slot_hi == 0)
+        is_match = (slot_lo == key_lo) & (slot_hi == key_hi)
+        found = found | (~done & is_match)
+        missing = missing | (~done & is_empty)
+        done = done | is_match | is_empty
+        idx = (idx + xp.uint32(r + 1)) & mask
+    return found

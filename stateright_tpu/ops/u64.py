@@ -1,0 +1,83 @@
+"""64-bit arithmetic as ``uint32`` limb pairs.
+
+TPUs have no native 64-bit integers (and jax defaults to x64-disabled
+everywhere), so the device fingerprint path represents a ``u64`` as a
+``(lo, hi)`` pair of ``uint32`` arrays and implements the mixing
+arithmetic (add-with-carry, 32x32→64 multiply via 16-bit half-words)
+directly. All functions are elementwise on uint32 *arrays* (any
+shape) and dtype-polymorphic between numpy and jax.numpy — the
+identical code runs on device and host, so host and device compute
+bit-identical digests. That property is what makes host-side trace
+reconstruction possible, mirroring how the reference relies on one
+stable hasher everywhere (src/lib.rs:357-375).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import numpy as np
+
+_MASK16 = np.uint32(0xFFFF)
+
+
+class U64(NamedTuple):
+    """A 64-bit value as two uint32 limbs (elementwise arrays)."""
+
+    lo: Any
+    hi: Any
+
+
+def u64_const(value: int, xp=np) -> U64:
+    return U64(
+        xp.uint32(value & 0xFFFFFFFF), xp.uint32((value >> 32) & 0xFFFFFFFF)
+    )
+
+
+def u64_xor(a: U64, b: U64) -> U64:
+    return U64(a.lo ^ b.lo, a.hi ^ b.hi)
+
+
+def u64_add(a: U64, b: U64) -> U64:
+    lo = a.lo + b.lo  # uint32 arrays wrap
+    carry = (lo < a.lo).astype(np.uint32)
+    return U64(lo, a.hi + b.hi + carry)
+
+
+def u64_shr(a: U64, n: int) -> U64:
+    """Logical right shift by a static amount 0 < n < 64."""
+    if n >= 32:
+        zero = a.hi ^ a.hi
+        return U64(a.hi >> np.uint32(n - 32), zero)
+    return U64(
+        (a.lo >> np.uint32(n)) | (a.hi << np.uint32(32 - n)),
+        a.hi >> np.uint32(n),
+    )
+
+
+def _mul32x32(a, b) -> Tuple[Any, Any]:
+    """Full 64-bit product of two uint32 arrays, as (lo, hi) limbs."""
+    a0 = a & _MASK16
+    a1 = a >> np.uint32(16)
+    b0 = b & _MASK16
+    b1 = b >> np.uint32(16)
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    p11 = a1 * b1
+    mid = (p00 >> np.uint32(16)) + (p01 & _MASK16) + (p10 & _MASK16)
+    lo = (p00 & _MASK16) | ((mid & _MASK16) << np.uint32(16))
+    hi = p11 + (p01 >> np.uint32(16)) + (p10 >> np.uint32(16)) + (mid >> np.uint32(16))
+    return lo, hi
+
+
+def u64_mul(a: U64, b: U64) -> U64:
+    """Low 64 bits of the product:
+    ``a.lo*b.lo + ((a.lo*b.hi + a.hi*b.lo) << 32)``."""
+    lo, hi = _mul32x32(a.lo, b.lo)
+    hi = hi + a.lo * b.hi + a.hi * b.lo
+    return U64(lo, hi)
+
+
+def u64_mul_const(a: U64, value: int, xp=np) -> U64:
+    return u64_mul(a, u64_const(value, xp))

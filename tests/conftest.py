@@ -7,7 +7,11 @@ without TPU hardware. Must be set before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the environment provides a TPU backend (the
+# driver's axon tunnel sets JAX_PLATFORMS=axon): tests must be fast,
+# deterministic, and able to fake an 8-device mesh. Real-TPU runs go
+# through bench.py, which leaves the environment alone.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,193 @@
+"""The TPU wave engine, differentially validated against the host BFS.
+
+Runs on the virtual CPU backend (conftest sets JAX_PLATFORMS=cpu with
+an 8-device mesh); identical code runs on real TPU. Ground truth:
+2pc 3 RMs = 288 unique states (reference examples/2pc.rs:153-154) and
+identical discovered-property sets vs the host oracle — the north-star
+acceptance criterion (BASELINE.json).
+"""
+
+import numpy as np
+import pytest
+
+from stateright_tpu.fixtures import DGraph
+from stateright_tpu.model import Property
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+from stateright_tpu.models.two_phase_commit_tpu import TwoPhaseSysEncoded
+from stateright_tpu.ops.fingerprint import fingerprint_u32v, fingerprint_u32v_int
+from stateright_tpu.ops.hashset import DeviceHashSet, contains, insert, sort_unique
+
+
+# -- ops ----------------------------------------------------------------
+
+
+def test_fingerprint_host_device_bit_identical():
+    import jax.numpy as jnp
+
+    vecs = np.random.default_rng(0).integers(
+        0, 2**32, size=(64, 7), dtype=np.uint32
+    )
+    np_lo, np_hi = fingerprint_u32v(vecs, np)
+    j_lo, j_hi = fingerprint_u32v(jnp.asarray(vecs), jnp)
+    assert np.array_equal(np_lo, np.asarray(j_lo))
+    assert np.array_equal(np_hi, np.asarray(j_hi))
+
+
+def test_fingerprint_distinguishes_and_nonzero():
+    vecs = np.array(
+        [[0, 0, 0], [0, 0, 1], [1, 0, 0], [0, 1, 0]], dtype=np.uint32
+    )
+    fps = fingerprint_u32v_int(vecs)
+    assert len(set(fps.tolist())) == 4
+    assert all(fp != 0 for fp in fps.tolist())
+
+
+def test_fingerprint_avalanche():
+    # One-bit input changes flip ~half the output bits.
+    rng = np.random.default_rng(1)
+    base = rng.integers(0, 2**32, size=(100, 8), dtype=np.uint32)
+    flipped = base.copy()
+    flipped[:, 3] ^= 1
+    d = fingerprint_u32v_int(base) ^ fingerprint_u32v_int(flipped)
+    popcount = np.array([bin(x).count("1") for x in d.tolist()])
+    assert 20 < popcount.mean() < 44
+
+
+def test_hashset_insert_and_dedup():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(2)
+    keys = rng.integers(1, 2**32, size=(500, 2), dtype=np.uint32)
+    lo, hi = jnp.asarray(keys[:, 0]), jnp.asarray(keys[:, 1])
+    table = DeviceHashSet.empty(2048, jnp)
+    (slo, shi, order), first = sort_unique(lo, hi, jnp)
+    table, is_new, overflow = insert(table, slo, shi, first, jnp)
+    assert not bool(jnp.any(overflow))
+    n_unique = len({(int(a), int(b)) for a, b in keys})
+    assert int(jnp.sum(is_new)) == n_unique
+    # Second insert of the same keys: nothing new.
+    table, is_new2, _ = insert(table, slo, shi, first, jnp)
+    assert int(jnp.sum(is_new2)) == 0
+    assert bool(jnp.all(contains(table, slo, shi, jnp) | ~first))
+
+
+def test_hashset_numpy_matches_jax():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    keys = rng.integers(1, 2**32, size=(300, 2), dtype=np.uint32)
+    t_np = DeviceHashSet.empty(1024, np)
+    t_j = DeviceHashSet.empty(1024, jnp)
+    (slo, shi, _), first = sort_unique(keys[:, 0], keys[:, 1], np)
+    t_np, new_np, _ = insert(t_np, slo, shi, first, np)
+    t_j, new_j, _ = insert(
+        t_j, jnp.asarray(slo), jnp.asarray(shi), jnp.asarray(first), jnp
+    )
+    assert np.array_equal(np.asarray(t_j.lo), t_np.lo)
+    assert np.array_equal(np.asarray(new_j), new_np)
+
+
+# -- engine vs host oracle ----------------------------------------------
+
+
+def test_tpu_2pc_matches_host_288_states():
+    host = TwoPhaseSys(rm_count=3).checker().spawn_bfs().join()
+    tpu = (
+        TwoPhaseSys(rm_count=3)
+        .checker()
+        .spawn_tpu(capacity=1 << 12)
+        .join()
+    )
+    assert tpu.unique_state_count() == 288
+    assert tpu.unique_state_count() == host.unique_state_count()
+    # Identical discovered-property sets (the north-star criterion).
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries())
+    tpu.assert_properties()
+
+
+def test_tpu_2pc_counterexample_paths_replay():
+    tpu = TwoPhaseSys(rm_count=3).checker().spawn_tpu(capacity=1 << 12).join()
+    for name, path in tpu.discoveries().items():
+        # Replay through the host model: raises if encoding diverges.
+        assert len(path) >= 1
+        prop = tpu.model.property_by_name(name)
+        assert prop.condition(tpu.model, path.last_state())
+
+
+def test_tpu_2pc_5rms_matches_host():
+    tpu = (
+        TwoPhaseSys(rm_count=5)
+        .checker()
+        .spawn_tpu(capacity=1 << 15, frontier_capacity=1 << 12)
+        .join()
+    )
+    assert tpu.unique_state_count() == 8832
+
+
+def test_tpu_encode_decode_roundtrip():
+    enc = TwoPhaseSysEncoded(3)
+    model = enc.host_model
+    frontier = list(model.init_states())
+    seen = 0
+    while frontier and seen < 50:
+        state = frontier.pop()
+        seen += 1
+        vec = enc.encode(state)
+        assert enc.decode(vec) == state
+        frontier.extend(model.next_states(state))
+
+
+def test_tpu_eventually_property():
+    # DGraph 1->2->3 plus dead-end 1->4; "reaches 3" fails via 4.
+    class DGraphEncoded:
+        width = 1
+        max_actions = 2
+
+        def __init__(self, model):
+            self.host_model = model
+
+        def init_vecs(self):
+            return np.array([[1]], dtype=np.uint32)
+
+        def encode(self, state):
+            return np.array([state], dtype=np.uint32)
+
+        def step_vec(self, vec):
+            import jax.numpy as jnp
+
+            node = vec[0]
+            # successors: 1 -> {2, 4}; 2 -> {3}
+            s1 = jnp.where(node == 1, jnp.uint32(2), jnp.uint32(3))
+            v1 = (node == 1) | (node == 2)
+            s2 = jnp.uint32(4)
+            v2 = node == 1
+            return (
+                jnp.stack([vec.at[0].set(s1), vec.at[0].set(s2)]),
+                jnp.stack([v1, v2]),
+            )
+
+        def property_conditions_vec(self, vec):
+            import jax.numpy as jnp
+
+            return jnp.stack([vec[0] == 3])
+
+        def within_boundary_vec(self, vec):
+            return True
+
+    model = (
+        DGraph.with_path([1, 2, 3])
+        .path([1, 4])
+        .property(Property.eventually("reaches 3", lambda m, s: s == 3))
+    )
+    checker = model.checker().spawn_tpu(
+        encoded=DGraphEncoded(model), capacity=64, frontier_capacity=8
+    ).join()
+    path = checker.assert_any_discovery("reaches 3")
+    assert path.states() == [1, 4]
+
+
+def test_tpu_rejects_model_without_encoding():
+    from stateright_tpu.fixtures import BinaryClock
+
+    with pytest.raises(ValueError):
+        BinaryClock().checker().spawn_tpu()

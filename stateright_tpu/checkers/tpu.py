@@ -3,26 +3,38 @@
 This is the performance core of the framework — the re-design of the
 reference's thread-pool BFS (src/checker/bfs.rs + src/job_market.rs)
 for accelerators. One *wave* processes the entire frontier as a single
-jitted device program:
+device program:
 
     frontier ──vmap step──▶ padded successors ──fingerprint──▶
     sort+unique ──▶ table insert-if-absent ──▶ compact new frontier
 
-Property predicates are evaluated as bitmaps over the frontier;
-``EventuallyBits`` ride along each frontier row exactly as in the
-reference (checker.rs:559-566, including the documented revisit
-false-negative, bfs.rs:285-303). The host keeps only what the
-reference keeps on the host side too: the child→parent fingerprint
-forest for counterexample reconstruction (bfs.rs:28-29, 371-400) and
-discovery bookkeeping. Path recovery replays the *host* model and
-matches device fingerprints of encoded successors — which doubles as a
-continuous differential check that the encoding agrees with the host
-semantics.
+and the wave loop itself runs **on device** inside a jitted
+``lax.while_loop``: the host synchronizes only once per chunk of waves
+(default 64) or at termination, instead of once per wave. All search
+state is device-resident between syncs:
+
+* the visited table (open-addressing fingerprint set, ops/hashset.py),
+* the parent forest — for every visited state, the fingerprint of the
+  state that first generated it, stored in side arrays indexed by the
+  state's table slot (the device equivalent of the reference's
+  ``generated: DashMap<Fingerprint, Option<Fingerprint>>``,
+  bfs.rs:28-29) — transferred to the host *once*, lazily, only when a
+  counterexample path is reconstructed,
+* per-property discovery flags and fingerprints,
+* the frontier, its validity mask, and per-row ``EventuallyBits``
+  (checker.rs:559-566, including the documented revisit
+  false-negative, bfs.rs:285-303).
+
+Property predicates are evaluated as bitmaps over the frontier each
+wave. Path recovery replays the *host* model and matches device
+fingerprints of encoded successors — which doubles as a continuous
+differential check that the encoding agrees with the host semantics
+(bfs.rs:371-400 + path.rs:20-97).
 
 Multi-chip scale-out (sharded frontier + all-to-all shuffle by
-fingerprint, replacing job_market.rs work stealing) lives in
-:mod:`stateright_tpu.parallel` and wraps this same wave body in
-``shard_map``.
+fingerprint ownership, replacing job_market.rs work stealing) lives in
+:mod:`stateright_tpu.parallel` and reuses this module's wave pieces
+inside ``shard_map``.
 """
 
 from __future__ import annotations
@@ -36,15 +48,16 @@ from ..encoding import EncodedModel
 from ..model import Expectation
 from ..ops.fingerprint import fingerprint_u32v
 from ..ops.hashset import DeviceHashSet, insert, sort_unique
+from ..ops.u64 import U64, u64_add
 from ..path import Path
 from ..report import ReportData, Reporter
 
 _SENTINEL = 0xFFFFFFFF  # sort key for invalid successor rows
 
-# Wave programs are expensive to compile (the K-successor builder and
+# Chunk programs are expensive to compile (the K-successor builder and
 # probe loop unroll into a large XLA graph) and identical across
-# checker instances with the same encoding and shapes — cache them.
-_WAVE_CACHE: dict = {}
+# checker instances with the same encoding, shapes and targets — cache.
+_CHUNK_CACHE: dict = {}
 _PERSISTENT_CACHE_SET = False
 
 
@@ -76,6 +89,103 @@ def _combine64(lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
     return (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
 
 
+def expand_frontier(enc, props, evt_idx, frontier, fval, ebits, expand):
+    """The shared first half of a wave (single-chip and sharded): from a
+    frontier block to property verdicts + flattened candidate successors.
+
+    Candidate fingerprints are deliberately NOT computed here — callers
+    compact the valid candidates into a smaller buffer first and
+    fingerprint only that (fingerprinting is per-lane splitmix64 in
+    uint32 limb arithmetic, one of the wave's larger elementwise costs).
+
+    Returns a dict with:
+      ``cond``       bool[F, P]   property truth over valid frontier rows
+      ``ebits``      uint32[F]    eventually-bits after clearing satisfied
+      ``evt_cex``    bool[F]      terminal rows with surviving ebits
+      ``f_lo/f_hi``  uint32[F]    frontier fingerprints
+      ``flat``       uint32[F*K, W] candidate successors
+      ``v``          bool[F*K]    candidate validity
+      ``p_lo/p_hi``  uint32[F*K]  parent (frontier) fingerprints per candidate
+      ``child_ebits`` uint32[F*K] ebits each candidate inherits
+    """
+    import jax
+    import jax.numpy as jnp
+
+    F = frontier.shape[0]
+    K, W = enc.max_actions, enc.width
+    n_props = len(props)
+
+    f_lo, f_hi = fingerprint_u32v(frontier, jnp)
+
+    # Property bitmap over the frontier (bfs.rs:223-268).
+    if n_props:
+        cond = jax.vmap(enc.property_conditions_vec)(frontier)
+        cond = cond & fval[:, None]
+    else:
+        cond = jnp.zeros((F, 0), dtype=bool)
+    # Clear satisfied eventually-bits (checker.rs:559-566).
+    for i in evt_idx:
+        ebits = jnp.where(cond[:, i], ebits & ~jnp.uint32(1 << i), ebits)
+
+    succs, valid = jax.vmap(enc.step_vec)(frontier)
+    valid = valid & fval[:, None] & expand
+    bound = jax.vmap(lambda row: jax.vmap(enc.within_boundary_vec)(row))(succs)
+    valid = valid & bound
+
+    # Terminal rows: no successors at all → surviving eventually-bits
+    # are counterexamples (bfs.rs:317-324). Depth-cut waves
+    # (expand=False) are not terminal.
+    terminal = fval & ~jnp.any(valid, axis=1) & expand
+    evt_cex = terminal & (ebits != 0)
+
+    return dict(
+        cond=cond,
+        ebits=ebits,
+        evt_cex=evt_cex,
+        f_lo=f_lo,
+        f_hi=f_hi,
+        flat=succs.reshape(F * K, W),
+        v=valid.reshape(F * K),
+        p_lo=jnp.repeat(f_lo, K),
+        p_hi=jnp.repeat(f_hi, K),
+        child_ebits=jnp.repeat(ebits, K),
+    )
+
+
+def discovery_update(props, ex, fval, disc_found, disc_lo, disc_hi):
+    """Fold this wave's property verdicts into the carried per-property
+    discovery flags/fingerprints, keeping the first (shallowest) hit —
+    mirrors bfs.rs discovery recording."""
+    import jax.numpy as jnp
+
+    cond, evt_cex, ebits = ex["cond"], ex["evt_cex"], ex["ebits"]
+    f_lo, f_hi = ex["f_lo"], ex["f_hi"]
+    hits, los, his = [], [], []
+    for i, p in enumerate(props):
+        if p.expectation == Expectation.ALWAYS:
+            mask = fval & ~cond[:, i]
+        elif p.expectation == Expectation.SOMETIMES:
+            mask = cond[:, i]
+        else:
+            mask = evt_cex & ((ebits & jnp.uint32(1 << i)) != 0)
+        hit = jnp.any(mask)
+        row = jnp.argmax(mask)
+        hits.append(hit)
+        los.append(f_lo[row])
+        his.append(f_hi[row])
+    if not props:
+        return disc_found, disc_lo, disc_hi
+    hits = jnp.stack(hits)
+    los = jnp.stack(los)
+    his = jnp.stack(his)
+    fresh = hits & ~disc_found
+    return (
+        disc_found | hits,
+        jnp.where(fresh, los, disc_lo),
+        jnp.where(fresh, his, disc_hi),
+    )
+
+
 class TpuBfsChecker(Checker):
     """``CheckerBuilder.spawn_tpu()`` — the reference's ``spawn_bfs``
     offloaded to a device (BASELINE.json north star)."""
@@ -87,6 +197,9 @@ class TpuBfsChecker(Checker):
         capacity: int = 1 << 16,
         frontier_capacity: Optional[int] = None,
         track_paths: bool = True,
+        waves_per_sync: int = 64,
+        cand_capacity: Optional[int] = None,
+        probe_rounds: int = 16,
     ):
         super().__init__(builder)
         if builder._symmetry is not None:
@@ -103,19 +216,35 @@ class TpuBfsChecker(Checker):
         self.capacity = capacity
         self.frontier_capacity = frontier_capacity or capacity
         self.track_paths = track_paths
-        #: child vec-fingerprint -> parent vec-fingerprint (None = init)
-        self.generated: dict[int, Optional[int]] = {}
+        self.waves_per_sync = waves_per_sync
+        #: candidate-buffer rows per wave. The frontier is padded to F
+        #: rows × K actions but most candidate rows are padding;
+        #: compacting the valid ones into a smaller buffer before the
+        #: sort/dedup/probe stages cuts the dominant per-wave costs.
+        #: None = F*K (no compaction, can never overflow).
+        self.cand_capacity = cand_capacity
+        self.probe_rounds = probe_rounds
+        if waves_per_sync < 1:
+            raise ValueError(f"waves_per_sync must be >= 1: {waves_per_sync}")
+        if probe_rounds < 1:
+            raise ValueError(f"probe_rounds must be >= 1: {probe_rounds}")
+        if cand_capacity is not None and cand_capacity < 1:
+            raise ValueError(f"cand_capacity must be >= 1: {cand_capacity}")
+        #: child vec-fingerprint -> parent vec-fingerprint (None = init);
+        #: built lazily from the device-side parent forest (see
+        #: _build_generated) only when a path is reconstructed.
+        self.generated: Optional[dict[int, Optional[int]]] = None
         #: property name -> fingerprint of the discovery state; always
         #: populated (drives early exit) even when track_paths=False
         #: suppresses Path materialization.
         self._discovered_fps: dict[str, int] = {}
-        self._wave_fn = None
+        self._programs = None  # (seed_fn, chunk_fn)
+        self._final_tables: Optional[tuple] = None
+        #: per-run wave metrics for observability (SURVEY §5): updated
+        #: at each host sync point.
+        self.metrics: dict[str, float] = {}
 
-    def _all_discovered(self) -> bool:
-        props = self.model.properties()
-        return len(props) > 0 and all(
-            p.name in self._discovered_fps for p in props
-        )
+    # -- results ----------------------------------------------------------
 
     def discovered_property_names(self) -> set:
         """Names with a discovery — available even with
@@ -123,20 +252,51 @@ class TpuBfsChecker(Checker):
         self._ensure_run()
         return set(self._discovered_fps)
 
+    def discovery_fingerprints(self) -> dict[str, int]:
+        """Property name -> discovery-state fingerprint. The fast-mode
+        (track_paths=False) substitute for :meth:`discoveries`."""
+        self._ensure_run()
+        return dict(self._discovered_fps)
+
     def discoveries(self):
         if not self.track_paths and self._discovered_fps:
             raise RuntimeError(
                 "paths unavailable with track_paths=False; use "
-                "discovered_property_names(), or re-run with "
-                "track_paths=True for counterexample traces"
+                "discovered_property_names()/discovery_fingerprints(), or "
+                "re-run with track_paths=True for counterexample traces"
             )
         return super().discoveries()
 
-    # -- device program --------------------------------------------------
+    def assert_properties(self) -> None:
+        """Works in fast mode too: existence checks need only the
+        discovery fingerprints, not materialized paths."""
+        self._ensure_run()
+        for prop in self.model.properties():
+            has = prop.name in self._discovered_fps
+            if prop.expectation == Expectation.SOMETIMES and not has:
+                raise AssertionError(f"expected a discovery for {prop.name!r}")
+            if prop.expectation != Expectation.SOMETIMES and has:
+                raise AssertionError(
+                    f"unexpected discovery for {prop.name!r}: "
+                    f"{self._discovered_fps[prop.name]:#018x}"
+                )
 
-    def _build_wave(self):
+    # -- device program ----------------------------------------------------
+    #
+    # The axon-tunneled TPU makes every host<->device transfer cost
+    # hundreds of milliseconds regardless of size (latency, not
+    # bandwidth). The whole run therefore touches the host exactly:
+    #   1 upload   — the deduped init states (seed_fn builds the rest
+    #                of the carry on device),
+    #   1 dispatch + 1 small packed-stats readback per chunk of
+    #                ``waves_per_sync`` waves,
+    #   0 downloads of the tables unless a counterexample path is
+    #                actually reconstructed (lazy, _build_generated).
+
+    def _build_programs(self, n0: int):
         import jax
         import jax.numpy as jnp
+        from jax import lax
 
         enc = self.encoded
         props = list(self.model.properties())
@@ -153,152 +313,253 @@ class TpuBfsChecker(Checker):
                 f"properties come first (got index {max(evt_idx)})"
             )
         K, W, F = enc.max_actions, enc.width, self.frontier_capacity
+        capacity = self.capacity
+        B = min(self.cand_capacity or F * K, F * K)
+        probe_rounds = self.probe_rounds
+        target_states = self.builder._target_state_count
+        target_depth = self.builder._target_max_depth
+        waves_per_sync = self.waves_per_sync
+        ebits_init = self._eventually_bits_init()
+        track_paths = self.track_paths
+        # Candidate payload lanes: state + (parent fp if tracked) + ebits.
+        E = W + 3 if track_paths else W + 1
+        EB = E - 1  # ebits lane index
 
-        def wave(table: DeviceHashSet, frontier, fval, ebits, expand: bool):
-            # Frontier digests (for parent pointers and discoveries).
-            f_lo, f_hi = fingerprint_u32v(frontier, jnp)
+        def seed(init_rows):
+            """Build the entire device carry from the init states."""
+            frontier = jnp.zeros((F, W), dtype=jnp.uint32).at[:n0].set(
+                init_rows
+            )
+            fval = jnp.arange(F) < n0
+            ebits = jnp.where(fval, jnp.uint32(ebits_init), jnp.uint32(0))
+            lo0, hi0 = fingerprint_u32v(init_rows, jnp)
+            (slo, shi, _), first = sort_unique(lo0, hi0, jnp)
+            table = DeviceHashSet.empty(capacity, jnp)
+            table, _, pending, _ = insert(table, slo, shi, first, jnp)
+            return dict(
+                t_lo=table.lo,
+                t_hi=table.hi,
+                # Parent 0 means "init/root": fingerprints are never 0.
+                # Untracked runs carry empty side tables (no per-wave
+                # parent scatters, no memory).
+                p_lo_t=jnp.zeros(capacity if track_paths else 0, jnp.uint32),
+                p_hi_t=jnp.zeros(capacity if track_paths else 0, jnp.uint32),
+                frontier=frontier,
+                fval=fval,
+                ebits=ebits,
+                depth=jnp.int32(1),
+                wchunk=jnp.int32(0),
+                waves=jnp.uint32(0),
+                gen_lo=jnp.uint32(n0),
+                gen_hi=jnp.uint32(0),
+                new=jnp.uint32(n0),
+                disc_found=jnp.zeros(n_props, dtype=bool),
+                disc_lo=jnp.zeros(n_props, dtype=jnp.uint32),
+                disc_hi=jnp.zeros(n_props, dtype=jnp.uint32),
+                overflow=jnp.any(pending),
+                f_overflow=jnp.bool_(False),
+                c_overflow=jnp.bool_(False),
+                done=jnp.bool_(n0 == 0) | jnp.any(pending),
+            )
 
-            # Property bitmap over the frontier (bfs.rs:223-268).
-            if n_props:
-                cond = jax.vmap(enc.property_conditions_vec)(frontier)
-                cond = cond & fval[:, None]
+        def body(c):
+            table = DeviceHashSet(c["t_lo"], c["t_hi"])
+            ebits = c["ebits"]
+            fval = c["fval"]
+
+            if target_depth is None:
+                expand = jnp.bool_(True)
             else:
-                cond = jnp.zeros((F, 0), dtype=bool)
-            # Clear satisfied eventually-bits (checker.rs:559-566).
-            for i in evt_idx:
-                ebits = jnp.where(cond[:, i], ebits & ~jnp.uint32(1 << i), ebits)
+                # States at the depth cut are evaluated, not expanded
+                # (bfs.rs:210-215 semantics).
+                expand = c["depth"] < target_depth
 
-            if expand:
-                succs, valid = jax.vmap(enc.step_vec)(frontier)
-                valid = valid & fval[:, None]
-                bound = jax.vmap(
-                    lambda row: jax.vmap(enc.within_boundary_vec)(row)
-                )(succs)
-                valid = valid & bound
+            ex = expand_frontier(
+                enc, props, evt_idx, c["frontier"], fval, ebits, expand
+            )
+
+            disc_found, disc_lo, disc_hi = discovery_update(
+                props, ex, fval, c["disc_found"], c["disc_lo"], c["disc_hi"]
+            )
+
+            n_cand = jnp.sum(ex["v"])
+            # Candidate payload: state lanes (+ parent fp when paths are
+            # tracked) + ebits packed into one [*, E] array so
+            # compaction/reorder is one scatter/gather instead of five.
+            parts = [ex["flat"]]
+            if track_paths:
+                parts += [ex["p_lo"][:, None], ex["p_hi"][:, None]]
+            parts.append(ex["child_ebits"][:, None])
+            ext = jnp.concatenate(parts, axis=1)
+            if B < F * K:
+                # Compact the valid candidates into a B-row buffer:
+                # typically only a small fraction of the F*K padded
+                # candidate rows is valid, and every downstream op
+                # (fingerprint, sort, probe, scatter) then runs on B
+                # rows.
+                cpos = jnp.cumsum(ex["v"]) - 1
+                csp = jnp.where(ex["v"], cpos, B)
+                b_ext = jnp.zeros((B, E), jnp.uint32).at[csp].set(
+                    ext, mode="drop"
+                )
+                b_val = jnp.arange(B) < n_cand
+                c_overflow = c["c_overflow"] | (n_cand > B)
             else:
-                succs = jnp.zeros((F, K, W), dtype=jnp.uint32)
-                valid = jnp.zeros((F, K), dtype=bool)
+                b_ext = ext
+                b_val = ex["v"]
+                c_overflow = c["c_overflow"]
+            b_lo, b_hi = fingerprint_u32v(b_ext[:, :W], jnp)
+            b_lo = jnp.where(b_val, b_lo, jnp.uint32(_SENTINEL))
+            b_hi = jnp.where(b_val, b_hi, jnp.uint32(_SENTINEL))
 
-            # Terminal rows: no successors at all → surviving
-            # eventually-bits are counterexamples (bfs.rs:317-324).
-            # Depth-cut waves (expand=False) are not terminal.
-            if expand:
-                terminal = fval & ~jnp.any(valid, axis=1)
+            # Dedup within the wave, then insert-if-absent.
+            (s_lo, s_hi, order), first = sort_unique(b_lo, b_hi, jnp)
+            active = first & b_val[order]
+            table, is_new, pending, slots = insert(
+                table, s_lo, s_hi, active, jnp, rounds=probe_rounds
+            )
+            overflow = c["overflow"] | jnp.any(pending)
+            s_ext = b_ext[order]
+
+            if track_paths:
+                # Parent forest: write each new state's parent
+                # fingerprint at its table slot (device-resident
+                # bfs.rs:28-29).
+                par_idx = jnp.where(is_new, slots, jnp.uint32(capacity))
+                p_lo_t = c["p_lo_t"].at[par_idx].set(
+                    s_ext[:, W], mode="drop"
+                )
+                p_hi_t = c["p_hi_t"].at[par_idx].set(
+                    s_ext[:, W + 1], mode="drop"
+                )
             else:
-                terminal = jnp.zeros(F, dtype=bool)
-            evt_cex = terminal & (ebits != 0)
-
-            flat = succs.reshape(F * K, W)
-            v = valid.reshape(F * K)
-            c_lo, c_hi = fingerprint_u32v(flat, jnp)
-            c_lo = jnp.where(v, c_lo, jnp.uint32(_SENTINEL))
-            c_hi = jnp.where(v, c_hi, jnp.uint32(_SENTINEL))
-            p_lo = jnp.repeat(f_lo, K)
-            p_hi = jnp.repeat(f_hi, K)
-            child_ebits = jnp.repeat(ebits, K)
-
-            (s_lo, s_hi, order), first = sort_unique(c_lo, c_hi, jnp)
-            v_sorted = v[order]
-            active = first & v_sorted
-            table, is_new, overflow = insert(table, s_lo, s_hi, active, jnp)
+                p_lo_t, p_hi_t = c["p_lo_t"], c["p_hi_t"]
 
             # Compact new states into the next frontier. Non-new rows
-            # scatter to index F*K, which is out of range for every
-            # output buffer and dropped.
+            # scatter to index F, out of range for every [F]-sized
+            # output buffer — dropped.
             new_count = jnp.sum(is_new)
             pos = jnp.cumsum(is_new) - 1
-            scatter_pos = jnp.where(is_new, pos, F * K)
-            next_frontier = jnp.zeros((F, W), dtype=jnp.uint32).at[
+            scatter_pos = jnp.where(is_new, pos, F)
+            next_fe = jnp.zeros((F, E), dtype=jnp.uint32).at[
                 scatter_pos
-            ].set(flat[order], mode="drop")
-            next_ebits = jnp.zeros(F, dtype=jnp.uint32).at[scatter_pos].set(
-                child_ebits[order], mode="drop"
-            )
+            ].set(s_ext, mode="drop")
+            next_frontier = next_fe[:, :W]
+            next_ebits = next_fe[:, EB]
             next_fval = jnp.arange(F) < new_count
+            f_overflow = c["f_overflow"] | (new_count > F)
 
-            # Per-wave host transfer: new fingerprints + their parents.
-            out_lo = jnp.zeros(F * K, dtype=jnp.uint32).at[scatter_pos].set(
-                s_lo, mode="drop"
+            g = u64_add(
+                U64(c["gen_lo"], c["gen_hi"]),
+                U64(n_cand.astype(jnp.uint32), jnp.uint32(0)),
             )
-            out_hi = jnp.zeros(F * K, dtype=jnp.uint32).at[scatter_pos].set(
-                s_hi, mode="drop"
-            )
-            out_plo = jnp.zeros(F * K, dtype=jnp.uint32).at[scatter_pos].set(
-                p_lo[order], mode="drop"
-            )
-            out_phi = jnp.zeros(F * K, dtype=jnp.uint32).at[scatter_pos].set(
-                p_hi[order], mode="drop"
-            )
+            new = c["new"] + new_count.astype(jnp.uint32)
 
-            # Discovery summaries: one candidate fingerprint per property.
-            def first_fp(mask):
-                any_hit = jnp.any(mask)
-                row = jnp.argmax(mask)
-                return any_hit, f_lo[row], f_hi[row]
-
-            disc_found = []
-            disc_lo = []
-            disc_hi = []
-            for i, p in enumerate(props):
-                if p.expectation == Expectation.ALWAYS:
-                    mask = fval & ~cond[:, i]
-                elif p.expectation == Expectation.SOMETIMES:
-                    mask = cond[:, i]
-                else:
-                    mask = evt_cex & ((ebits & jnp.uint32(1 << i)) != 0)
-                hit, lo_, hi_ = first_fp(mask)
-                disc_found.append(hit)
-                disc_lo.append(lo_)
-                disc_hi.append(hi_)
-            disc_found = (
-                jnp.stack(disc_found) if disc_found else jnp.zeros(0, bool)
+            all_disc = (
+                jnp.all(disc_found) if n_props else jnp.bool_(False)
             )
-            disc_lo = (
-                jnp.stack(disc_lo) if disc_lo else jnp.zeros(0, jnp.uint32)
+            if target_states is None:
+                target_hit = jnp.bool_(False)
+            else:
+                target_hit = new >= jnp.uint32(target_states)
+            cont = (
+                (new_count > 0)
+                & ~all_disc
+                & ~target_hit
+                & ~overflow
+                & ~f_overflow
+                & ~c_overflow
             )
-            disc_hi = (
-                jnp.stack(disc_hi) if disc_hi else jnp.zeros(0, jnp.uint32)
-            )
-
-            total_generated = jnp.sum(v)
             return dict(
-                table=table,
+                t_lo=table.lo,
+                t_hi=table.hi,
+                p_lo_t=p_lo_t,
+                p_hi_t=p_hi_t,
                 frontier=next_frontier,
-                fval=next_fval,
+                fval=next_fval & cont,
                 ebits=next_ebits,
-                new_count=new_count,
-                total_generated=total_generated,
-                overflow=jnp.any(overflow),
-                new_lo=out_lo,
-                new_hi=out_hi,
-                par_lo=out_plo,
-                par_hi=out_phi,
+                depth=jnp.where(cont, c["depth"] + 1, c["depth"]),
+                wchunk=c["wchunk"] + 1,
+                waves=c["waves"] + 1,
+                gen_lo=g.lo,
+                gen_hi=g.hi,
+                new=new,
                 disc_found=disc_found,
                 disc_lo=disc_lo,
                 disc_hi=disc_hi,
+                overflow=overflow,
+                f_overflow=f_overflow,
+                c_overflow=c_overflow,
+                done=~cont,
             )
 
-        return jax.jit(wave, static_argnames=("expand",))
+        def cond(c):
+            return ~c["done"] & (c["wchunk"] < waves_per_sync)
 
-    # -- host orchestration ----------------------------------------------
+        def chunk(carry):
+            c = dict(carry, wchunk=jnp.int32(0))
+            c = lax.while_loop(cond, body, c)
+            # Everything the host polls, packed into ONE small array so
+            # each chunk costs exactly one readback over the tunnel.
+            scalars = jnp.stack(
+                [
+                    c["done"].astype(jnp.uint32),
+                    c["overflow"].astype(jnp.uint32),
+                    c["f_overflow"].astype(jnp.uint32),
+                    c["depth"].astype(jnp.uint32),
+                    c["waves"],
+                    jnp.sum(c["fval"]).astype(jnp.uint32),
+                    c["gen_lo"],
+                    c["gen_hi"],
+                    c["new"],
+                    c["c_overflow"].astype(jnp.uint32),
+                ]
+            )
+            stats = jnp.concatenate(
+                [
+                    scalars,
+                    c["disc_found"].astype(jnp.uint32),
+                    c["disc_lo"],
+                    c["disc_hi"],
+                ]
+            )
+            return c, stats
+
+        return jax.jit(seed), jax.jit(chunk, donate_argnums=0)
+
+    # -- host orchestration ------------------------------------------------
 
     def _run(self, reporter: Optional[Reporter] = None) -> None:
         import jax.numpy as jnp
 
         enc = self.encoded
         props = list(self.model.properties())
+        n_props = len(props)
         F, W = self.frontier_capacity, enc.width
-        target_states = self.builder._target_state_count
-        target_depth = self.builder._target_max_depth
         if self.builder._visitor is not None:
             raise ValueError(
                 "visitors require a host checker (spawn_bfs/spawn_dfs); the "
                 "TPU engine keeps full states on device only"
             )
 
-        if self._wave_fn is None:
+        # Dedup init states host-side (they are few) so the device seed
+        # can assume distinct rows.
+        init = np.asarray(enc.init_vecs(), dtype=np.uint32).reshape(-1, W)
+        seen = set()
+        rows = []
+        for row in init:
+            fp = self._vec_fp(row)
+            if fp not in seen:
+                seen.add(fp)
+                rows.append(row)
+        init = np.stack(rows) if rows else np.zeros((0, W), np.uint32)
+        n0 = init.shape[0]
+        if n0 > F:
+            raise ValueError(f"frontier capacity {F} < {n0} init states")
+
+        if self._programs is None:
             _enable_persistent_cache()
-            # Share compiled waves between checkers only when the
+            # Share compiled programs between checkers only when the
             # encoding declares an identity (cache_key): shapes alone
             # can't distinguish different transition functions.
             key_fn = getattr(enc, "cache_key", None)
@@ -310,112 +571,70 @@ class TpuBfsChecker(Checker):
                     enc.max_actions,
                     F,
                     self.capacity,
+                    self.cand_capacity,
+                    self.probe_rounds,
+                    self.waves_per_sync,
+                    self.track_paths,
+                    n0,
+                    self.builder._target_state_count,
+                    self.builder._target_max_depth,
                     tuple((p.name, p.expectation) for p in props),
                 )
-                if cache_key not in _WAVE_CACHE:
-                    _WAVE_CACHE[cache_key] = self._build_wave()
-                self._wave_fn = _WAVE_CACHE[cache_key]
+                if cache_key not in _CHUNK_CACHE:
+                    _CHUNK_CACHE[cache_key] = self._build_programs(n0)
+                self._programs = _CHUNK_CACHE[cache_key]
             else:
-                self._wave_fn = self._build_wave()
+                self._programs = self._build_programs(n0)
+        seed_fn, chunk_fn = self._programs
 
-        # Seed: encoded init states, deduped, inserted into the table.
-        # (Init states are assumed within the boundary, as is true of
-        # every reference workload; successors are boundary-filtered on
-        # device each wave.)
-        init = np.asarray(enc.init_vecs(), dtype=np.uint32).reshape(-1, W)
-        seen = set()
-        rows = []
-        for row in init:
-            fp = self._vec_fp(row)
-            if fp not in seen:
-                seen.add(fp)
-                rows.append(row)
-                self.generated[fp] = None
-        init = np.stack(rows) if rows else np.zeros((0, W), np.uint32)
-        n0 = init.shape[0]
-        if n0 > F:
-            raise ValueError(f"frontier capacity {F} < {n0} init states")
-        self._total_states += n0
-        self._unique_states += n0
+        carry = seed_fn(jnp.asarray(init))  # the run's one upload
 
-        frontier = jnp.zeros((F, W), dtype=jnp.uint32).at[:n0].set(init)
-        fval = jnp.arange(F) < n0
-        ebits = jnp.where(
-            fval, jnp.uint32(self._eventually_bits_init()), jnp.uint32(0)
-        )
-        # Seed the table host-side, then transfer once.
-        lo0, hi0 = fingerprint_u32v(init, np)
-        (slo, shi, _), first = sort_unique(
-            np.asarray(lo0, np.uint32), np.asarray(hi0, np.uint32), np
-        )
-        table_np = DeviceHashSet.empty(self.capacity, np)
-        table_np, _, seed_overflow = insert(table_np, slo, shi, first, np)
-        if bool(np.any(seed_overflow)):
-            raise RuntimeError(
-                f"visited table overflow while seeding {n0} init states "
-                f"(capacity={self.capacity}); re-run with a larger capacity"
-            )
-        table = DeviceHashSet(jnp.asarray(table_np.lo), jnp.asarray(table_np.hi))
-
-        depth = 1
         while True:
-            self._max_depth = max(self._max_depth, depth)
-            expand = not (target_depth is not None and depth >= target_depth)
-            out = self._wave_fn(table, frontier, fval, ebits, expand=expand)
-            table = out["table"]
-
-            if bool(out["overflow"]):
+            carry, stats = chunk_fn(carry)
+            s = np.asarray(stats)  # the chunk's one readback
+            done = bool(s[0])
+            self._total_states = int(s[6]) | (int(s[7]) << 32)
+            self._unique_states = int(s[8])
+            self._max_depth = max(self._max_depth, int(s[3]))
+            self.metrics = dict(
+                frontier_size=int(s[5]),
+                occupancy=self._unique_states / self.capacity,
+                dedup_ratio=(
+                    1.0 - self._unique_states / self._total_states
+                    if self._total_states
+                    else 0.0
+                ),
+                waves=int(s[4]),
+            )
+            if bool(s[1]):
                 raise RuntimeError(
                     f"visited table overflow (capacity={self.capacity}); "
                     "re-run with a larger capacity"
                 )
-
-            new_count = int(out["new_count"])
-            self._total_states += int(out["total_generated"])
-            self._unique_states += new_count
-
-            if self.track_paths and new_count:
-                # Vectorized parent-map update: table-new keys cannot
-                # already be present (the table mirrors `generated`).
-                child = _combine64(
-                    np.asarray(out["new_lo"][:new_count]),
-                    np.asarray(out["new_hi"][:new_count]),
-                )
-                parent = _combine64(
-                    np.asarray(out["par_lo"][:new_count]),
-                    np.asarray(out["par_hi"][:new_count]),
-                )
-                self.generated.update(zip(child.tolist(), parent.tolist()))
-
-            # Discoveries (host side, mirrors bfs.rs discovery
-            # recording) — after the parent map grew this wave.
-            disc_found = np.asarray(out["disc_found"])
-            disc_lo = np.asarray(out["disc_lo"])
-            disc_hi = np.asarray(out["disc_hi"])
-            for i, prop in enumerate(props):
-                if disc_found[i] and prop.name not in self._discovered_fps:
-                    fp = _fp_int(disc_lo[i], disc_hi[i])
-                    self._discovered_fps[prop.name] = fp
-                    if self.track_paths:
-                        self._discoveries[prop.name] = self._reconstruct(fp)
-
-            if self._all_discovered():
-                break
-            if target_states is not None and self._unique_states >= target_states:
-                break
-            if new_count == 0:
-                break
-            if new_count > F:
+            if bool(s[2]):
                 raise RuntimeError(
-                    f"frontier overflow: wave produced {new_count} > {F} "
-                    "states; re-run with a larger frontier_capacity"
+                    f"frontier overflow: a wave produced more than "
+                    f"{F} new states; re-run with a larger frontier_capacity"
                 )
+            if bool(s[9]):
+                raise RuntimeError(
+                    f"candidate-buffer overflow: a wave generated more than "
+                    f"{self.cand_capacity} valid successors; re-run with a "
+                    "larger cand_capacity (or None to disable compaction)"
+                )
+            if not done and self.metrics["occupancy"] > 0.7:
+                import warnings
 
-            frontier = out["frontier"]
-            fval = out["fval"]
-            ebits = out["ebits"]
-            depth += 1
-
+                warnings.warn(
+                    f"visited table {self.metrics['occupancy']:.0%} full "
+                    f"({self._unique_states}/{self.capacity}); "
+                    "probe failures become likely past ~85% — consider a "
+                    "larger capacity",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+            if done:
+                break
             if reporter is not None:
                 reporter.report_checking(
                     ReportData(
@@ -427,7 +646,42 @@ class TpuBfsChecker(Checker):
                     )
                 )
 
-    # -- reconstruction ---------------------------------------------------
+        # Keep device handles; download lazily only if a path is
+        # reconstructed (_build_generated).
+        self._final_tables = (
+            carry["t_lo"],
+            carry["t_hi"],
+            carry["p_lo_t"],
+            carry["p_hi_t"],
+        )
+        disc_found = s[10 : 10 + n_props]
+        disc_lo = s[10 + n_props : 10 + 2 * n_props]
+        disc_hi = s[10 + 2 * n_props :]
+        for i, prop in enumerate(props):
+            if disc_found[i]:
+                fp = _fp_int(disc_lo[i], disc_hi[i])
+                self._discovered_fps[prop.name] = fp
+                if self.track_paths:
+                    self._discoveries[prop.name] = self._reconstruct(fp)
+
+    # -- reconstruction ----------------------------------------------------
+
+    def _build_generated(self) -> dict[int, Optional[int]]:
+        """Materialize the child→parent fingerprint map from the final
+        device tables (one transfer already done; host-side unpack)."""
+        if self.generated is None:
+            # The one (lazy) table download.
+            t_lo, t_hi, p_lo, p_hi = (
+                np.asarray(a) for a in self._final_tables
+            )
+            occupied = (t_lo != 0) | (t_hi != 0)
+            child = _combine64(t_lo[occupied], t_hi[occupied])
+            parent = _combine64(p_lo[occupied], p_hi[occupied])
+            self.generated = {
+                int(c): (int(p) if p else None)
+                for c, p in zip(child.tolist(), parent.tolist())
+            }
+        return self.generated
 
     def _vec_fp(self, row: np.ndarray) -> int:
         lo, hi = fingerprint_u32v(row.reshape(1, -1), np)
@@ -437,13 +691,10 @@ class TpuBfsChecker(Checker):
         """Walk the parent forest, then replay the HOST model matching
         device fingerprints of encoded successors (bfs.rs:371-400 +
         path.rs:20-97, with the encoder as the bridge)."""
-        if not self.track_paths:
-            raise RuntimeError(
-                "path reconstruction requires track_paths=True"
-            )
+        generated = self._build_generated()
         fps = [fp]
         while True:
-            parent = self.generated.get(fps[-1])
+            parent = generated.get(fps[-1])
             if parent is None:
                 break
             fps.append(parent)

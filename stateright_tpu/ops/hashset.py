@@ -90,71 +90,146 @@ def insert(
     key_hi: Any,
     active: Any,
     xp,
-) -> Tuple[DeviceHashSet, Any, Any]:
+    rounds: int = PROBE_ROUNDS,
+) -> Tuple[DeviceHashSet, Any, Any, Any]:
     """Insert distinct keys where ``active``; return
-    ``(new_table, is_new, overflow)``.
+    ``(new_table, is_new, overflow, slot)``.
 
     ``is_new[i]`` — key i was inserted (absent before); ``overflow[i]``
-    — probing exhausted without a slot (caller must grow + retry).
+    — probing exhausted without a slot (caller must grow + retry);
+    ``slot[i]`` — the table index key i landed at (inserted or already
+    present; undefined for inactive/overflowed rows). Slots let callers
+    keep side tables indexed by table position — the engine stores the
+    parent fingerprint of each visited state this way, so the whole
+    parent forest stays device-resident (bfs.rs:28-29 equivalent).
     Keys in the batch MUST be distinct where active (use
     :func:`sort_unique` first); inactive rows are ignored.
     """
+    if xp.__name__.startswith("jax"):
+        return _insert_jax(table, key_lo, key_hi, active, rounds)
     n = key_lo.shape[0]
     mask = xp.uint32(table.capacity - 1)
     row_ids = xp.arange(n, dtype=xp.uint32)
     idx = _slot_hash(key_lo, key_hi, mask, xp)
     lo, hi = table.lo, table.hi
-    if not xp.__name__.startswith("jax"):
-        lo, hi = lo.copy(), hi.copy()  # keep numpy path functional too
+    lo, hi = lo.copy(), hi.copy()  # keep numpy path functional too
     inserted = xp.zeros(n, dtype=bool)
     found = xp.zeros(n, dtype=bool)
+    slot = xp.zeros(n, dtype=xp.uint32)
     pending = active
-    for r in range(PROBE_ROUNDS):
+    for r in range(rounds):
+        if not pending.any():
+            break
         slot_lo = lo[idx]
         slot_hi = hi[idx]
         is_empty = (slot_lo == 0) & (slot_hi == 0)
         is_match = (slot_lo == key_lo) & (slot_hi == key_hi)
-        found = found | (pending & is_match)
+        newly_found = pending & is_match
+        found = found | newly_found
+        slot = xp.where(newly_found, idx, slot)
         pending = pending & ~is_match
         # Claim empty slots: scatter-max row ids, winners re-read.
         want = pending & is_empty
         claims = xp.zeros(table.capacity, dtype=xp.uint32)
-        if xp.__name__.startswith("jax"):
-            claims = claims.at[idx].max(
-                xp.where(want, row_ids + 1, xp.uint32(0))
-            )
-        else:
-            import numpy as np
+        import numpy as np
 
-            np.maximum.at(
-                claims, idx, xp.where(want, row_ids + 1, xp.uint32(0))
-            )
+        np.maximum.at(
+            claims, idx, xp.where(want, row_ids + 1, xp.uint32(0))
+        )
         won = want & (claims[idx] == row_ids + 1)
-        if xp.__name__.startswith("jax"):
-            # Only winners write; losers scatter out of range (dropped).
-            # A plain at[idx].set with stale values for losers would
-            # race the winner's write at duplicate indices.
-            write_idx = xp.where(won, idx, xp.uint32(table.capacity))
-            lo = lo.at[write_idx].set(key_lo, mode="drop")
-            hi = hi.at[write_idx].set(key_hi, mode="drop")
-        else:
-            lo[idx[won]] = key_lo[won]
-            hi[idx[won]] = key_hi[won]
+        lo[idx[won]] = key_lo[won]
+        hi[idx[won]] = key_hi[won]
         inserted = inserted | won
+        slot = xp.where(won, idx, slot)
         pending = pending & ~won
         # Triangular re-probe for losers/occupied.
         idx = (idx + xp.uint32(r + 1)) & mask
-    return DeviceHashSet(lo, hi), inserted, pending
+    return DeviceHashSet(lo, hi), inserted, pending, slot
 
 
-def contains(table: DeviceHashSet, key_lo: Any, key_hi: Any, xp) -> Any:
+def _insert_jax(
+    table: DeviceHashSet, key_lo: Any, key_hi: Any, active: Any, rounds: int
+) -> Tuple[DeviceHashSet, Any, Any, Any]:
+    """Device insert: the probe rounds run in a ``lax.while_loop`` that
+    exits as soon as no key is pending. At sane load factors (<50%)
+    nearly every batch resolves within 2-4 rounds, so this costs a
+    fraction of a fixed ``rounds``-times-unrolled loop; ``rounds`` is
+    the safety bound whose exhaustion reports overflow."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = key_lo.shape[0]
+    cap = table.capacity
+    mask = jnp.uint32(cap - 1)
+    row_ids = jnp.arange(n, dtype=jnp.uint32)
+
+    def cond(c):
+        return (c["r"] < rounds) & jnp.any(c["pending"])
+
+    def body(c):
+        lo, hi, idx, pending = c["lo"], c["hi"], c["idx"], c["pending"]
+        slot_lo = lo[idx]
+        slot_hi = hi[idx]
+        is_empty = (slot_lo == 0) & (slot_hi == 0)
+        is_match = (slot_lo == key_lo) & (slot_hi == key_hi)
+        newly_found = pending & is_match
+        slot = jnp.where(newly_found, idx, c["slot"])
+        pending = pending & ~is_match
+        # Claim empty slots: scatter-max row ids, winners re-read.
+        want = pending & is_empty
+        claims = jnp.zeros(cap, dtype=jnp.uint32).at[idx].max(
+            jnp.where(want, row_ids + 1, jnp.uint32(0))
+        )
+        won = want & (claims[idx] == row_ids + 1)
+        # Only winners write; losers scatter out of range (dropped).
+        # A plain at[idx].set with stale values for losers would race
+        # the winner's write at duplicate indices.
+        write_idx = jnp.where(won, idx, jnp.uint32(cap))
+        lo = lo.at[write_idx].set(key_lo, mode="drop")
+        hi = hi.at[write_idx].set(key_hi, mode="drop")
+        return dict(
+            lo=lo,
+            hi=hi,
+            # Triangular re-probe for losers/occupied.
+            idx=(idx + c["r"].astype(jnp.uint32) + 1) & mask,
+            pending=pending & ~won,
+            inserted=c["inserted"] | won,
+            slot=jnp.where(won, idx, slot),
+            r=c["r"] + 1,
+        )
+
+    out = lax.while_loop(
+        cond,
+        body,
+        dict(
+            lo=table.lo,
+            hi=table.hi,
+            idx=_slot_hash(key_lo, key_hi, mask, jnp),
+            pending=active,
+            inserted=jnp.zeros(n, dtype=bool),
+            slot=jnp.zeros(n, dtype=jnp.uint32),
+            r=jnp.int32(0),
+        ),
+    )
+    return (
+        DeviceHashSet(out["lo"], out["hi"]),
+        out["inserted"],
+        out["pending"],
+        out["slot"],
+    )
+
+
+def contains(
+    table: DeviceHashSet, key_lo: Any, key_hi: Any, xp,
+    rounds: int = PROBE_ROUNDS,
+) -> Any:
     """Membership probe (no mutation)."""
     mask = xp.uint32(table.capacity - 1)
     idx = _slot_hash(key_lo, key_hi, mask, xp)
     found = xp.zeros(key_lo.shape, dtype=bool)
     missing = xp.zeros(key_lo.shape, dtype=bool)
     done = xp.zeros(key_lo.shape, dtype=bool)
-    for r in range(PROBE_ROUNDS):
+    for r in range(rounds):
         slot_lo = table.lo[idx]
         slot_hi = table.hi[idx]
         is_empty = (slot_lo == 0) & (slot_hi == 0)

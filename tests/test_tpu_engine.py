@@ -61,13 +61,19 @@ def test_hashset_insert_and_dedup():
     lo, hi = jnp.asarray(keys[:, 0]), jnp.asarray(keys[:, 1])
     table = DeviceHashSet.empty(2048, jnp)
     (slo, shi, order), first = sort_unique(lo, hi, jnp)
-    table, is_new, overflow = insert(table, slo, shi, first, jnp)
+    table, is_new, overflow, slots = insert(table, slo, shi, first, jnp)
     assert not bool(jnp.any(overflow))
     n_unique = len({(int(a), int(b)) for a, b in keys})
     assert int(jnp.sum(is_new)) == n_unique
-    # Second insert of the same keys: nothing new.
-    table, is_new2, _ = insert(table, slo, shi, first, jnp)
+    # Slots point at the inserted keys.
+    ins = np.asarray(is_new)
+    s = np.asarray(slots)[ins]
+    assert np.array_equal(np.asarray(table.lo)[s], np.asarray(slo)[ins])
+    assert np.array_equal(np.asarray(table.hi)[s], np.asarray(shi)[ins])
+    # Second insert of the same keys: nothing new, same slots found.
+    table, is_new2, _, slots2 = insert(table, slo, shi, first, jnp)
     assert int(jnp.sum(is_new2)) == 0
+    assert np.array_equal(np.asarray(slots2)[ins], s)
     assert bool(jnp.all(contains(table, slo, shi, jnp) | ~first))
 
 
@@ -79,8 +85,8 @@ def test_hashset_numpy_matches_jax():
     t_np = DeviceHashSet.empty(1024, np)
     t_j = DeviceHashSet.empty(1024, jnp)
     (slo, shi, _), first = sort_unique(keys[:, 0], keys[:, 1], np)
-    t_np, new_np, _ = insert(t_np, slo, shi, first, np)
-    t_j, new_j, _ = insert(
+    t_np, new_np, _, _ = insert(t_np, slo, shi, first, np)
+    t_j, new_j, _, _ = insert(
         t_j, jnp.asarray(slo), jnp.asarray(shi), jnp.asarray(first), jnp
     )
     assert np.array_equal(np.asarray(t_j.lo), t_np.lo)

@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Benchmark harness — the replacement for the reference's bench.sh
+(/root/reference/bench.sh:18-33, which greps `sec=` out of 3 reporter
+runs per workload).
+
+Runs the encoded workloads on the real chip (the environment's default
+JAX backend — the axon-tunneled TPU under the driver; CPU elsewhere)
+and prints exactly ONE JSON line on stdout:
+
+    {"metric": ..., "value": N, "unit": "states/sec",
+     "vs_baseline": N, "detail": {...}}
+
+``value`` is unique-states/sec on the headline workload (largest
+encoded state space), timed warm (second run; the XLA compile cache
+makes re-runs and CLI invocations warm too). ``vs_baseline`` is the
+speedup over the sequential host BFS oracle measured live on this same
+machine — the reference publishes no numbers (BASELINE.md) and its
+Rust toolchain isn't in this image, so the host oracle is the honest
+stand-in for the reference's single-thread CPU search.
+
+Per-workload details go to stderr; ``--verbose`` adds per-run wave
+metrics (frontier size, occupancy, dedup ratio, shuffle volume).
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def _stderr(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+def time_checker(spawn, runs=2):
+    """Spawn+join ``runs`` times; return (checker, best_seconds).
+
+    The first run pays any residual compile cost (the persistent XLA
+    cache usually absorbs it); the best run is reported, mirroring
+    bench.sh's min-of-3 convention.
+    """
+    best = float("inf")
+    checker = None
+    for _ in range(runs):
+        c = spawn()
+        t0 = time.monotonic()
+        c.join()
+        dt = time.monotonic() - t0
+        best = min(best, dt)
+        checker = c
+    return checker, best
+
+
+def bench_host_oracle():
+    """Sequential host BFS on 2pc rm=5 — the vs_baseline denominator."""
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    c = TwoPhaseSys(rm_count=5).checker().spawn_bfs()
+    t0 = time.monotonic()
+    c.join()
+    dt = time.monotonic() - t0
+    sps = c.unique_state_count() / dt
+    _stderr(
+        f"host-oracle  2pc rm=5: unique={c.unique_state_count()} "
+        f"sec={dt:.2f} states/sec={sps:,.0f}"
+    )
+    return sps
+
+
+def tpu_workloads(quick=False):
+    """(name, spawn, expected_unique) for every encoded workload; the
+    LAST entry is the headline."""
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    def twopc(rm, **kw):
+        def spawn():
+            return (
+                TwoPhaseSys(rm_count=rm)
+                .checker()
+                .spawn_tpu(track_paths=False, **kw)
+            )
+
+        return spawn
+
+    from stateright_tpu.models.paxos import PaxosModelCfg, paxos_model
+
+    def paxos(clients, **kw):
+        def spawn():
+            return (
+                paxos_model(
+                    PaxosModelCfg(client_count=clients, server_count=3)
+                )
+                .checker()
+                .spawn_tpu(track_paths=False, **kw)
+            )
+
+        return spawn
+
+    loads = [
+        (
+            "2pc rm=5",
+            twopc(5, capacity=1 << 15, frontier_capacity=1 << 12),
+            8832,
+        ),
+        (
+            "paxos 2c/3s",
+            paxos(
+                2,
+                capacity=1 << 16,
+                frontier_capacity=1 << 12,
+                cand_capacity=1 << 14,
+            ),
+            16668,
+        ),
+        (
+            "2pc rm=6",
+            twopc(
+                6,
+                capacity=1 << 17,
+                frontier_capacity=1 << 14,
+                cand_capacity=1 << 16,
+            ),
+            50816,
+        ),
+    ]
+    if not quick:
+        loads.append(
+            (
+                "2pc rm=7",
+                twopc(
+                    7,
+                    capacity=1 << 20,
+                    frontier_capacity=1 << 16,
+                    cand_capacity=1 << 18,
+                ),
+                296448,
+            )
+        )
+    return loads
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="skip the largest workload")
+    ap.add_argument("--verbose", action="store_true", help="per-run wave metrics")
+    ap.add_argument("--runs", type=int, default=2)
+    args = ap.parse_args()
+
+    import jax
+
+    _stderr(f"backend: {jax.devices()}")
+
+    host_sps = bench_host_oracle()
+
+    detail = {}
+    headline_name, headline_sps = None, 0.0
+    for name, spawn, expected in tpu_workloads(quick=args.quick):
+        checker, sec = time_checker(spawn, runs=args.runs)
+        unique = checker.unique_state_count()
+        if unique != expected:
+            _stderr(f"ERROR {name}: unique={unique} != expected {expected}")
+            sys.exit(1)
+        checker.assert_properties()
+        sps = unique / sec
+        detail[name] = {
+            "unique": unique,
+            "sec": round(sec, 4),
+            "states_per_sec": round(sps),
+        }
+        _stderr(
+            f"tpu  {name}: unique={unique} sec={sec:.3f} "
+            f"states/sec={sps:,.0f}"
+        )
+        if args.verbose:
+            _stderr(f"     metrics: {checker.metrics}")
+        headline_name, headline_sps = name, sps
+
+    print(
+        json.dumps(
+            {
+                "metric": f"unique states/sec ({headline_name}, 1 chip)",
+                "value": round(headline_sps),
+                "unit": "states/sec",
+                "vs_baseline": round(headline_sps / host_sps, 2),
+                "detail": detail,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

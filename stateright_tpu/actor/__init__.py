@@ -24,6 +24,8 @@ from .base import (
 from .network import Envelope, Network, Ordered, UnorderedDuplicating, UnorderedNonDuplicating
 from .model import ActorModel, ActorModelAction, Crash, Deliver, Drop, Timeout
 from .model_state import ActorModelState
+from .choice import Choice, ScriptedActor
+from .ordered_reliable_link import OrderedReliableLink
 
 __all__ = [
     "Actor",
@@ -31,6 +33,9 @@ __all__ = [
     "ActorModelAction",
     "ActorModelState",
     "CancelTimer",
+    "Choice",
+    "OrderedReliableLink",
+    "ScriptedActor",
     "Command",
     "Cow",
     "Crash",

@@ -110,6 +110,15 @@ class CheckerBuilder:
 
         return TpuBfsChecker(self, **kwargs)
 
+    def spawn_tpu_sharded(self, **kwargs) -> "Checker":
+        """Spawn the multi-chip wave engine: the frontier and visited
+        set sharded over a ``jax.sharding.Mesh``, with per-wave
+        all-to-all frontier shuffles replacing the reference's
+        work-stealing job market (src/job_market.rs)."""
+        from .parallel import ShardedTpuBfsChecker
+
+        return ShardedTpuBfsChecker(self, **kwargs)
+
     def serve(self, addr: str):
         """Serve the Explorer web UI for this model (checker.rs:139-146)."""
         from .explorer.server import serve

@@ -73,9 +73,15 @@ def _enable_persistent_cache() -> None:
     import jax
 
     if jax.config.jax_compilation_cache_dir is None:
+        # Per-backend cache: under the axon tunnel, remote-compiled TPU
+        # (and AOT CPU) artifacts target different machine features
+        # than this host — sharing one directory across backends loads
+        # incompatible executables (SIGILL risk).
         jax.config.update(
             "jax_compilation_cache_dir",
-            os.path.expanduser("~/.cache/stateright_tpu_xla"),
+            os.path.expanduser(
+                f"~/.cache/stateright_tpu_xla_{jax.default_backend()}"
+            ),
         )
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
@@ -152,10 +158,11 @@ def expand_frontier(enc, props, evt_idx, frontier, fval, ebits, expand):
     )
 
 
-def discovery_update(props, ex, fval, disc_found, disc_lo, disc_hi):
-    """Fold this wave's property verdicts into the carried per-property
-    discovery flags/fingerprints, keeping the first (shallowest) hit —
-    mirrors bfs.rs discovery recording."""
+def wave_hits(props, ex, fval):
+    """This wave's per-property discovery verdicts over the (local)
+    frontier block: ``(hit[P] bool, lo[P], hi[P])`` — the fingerprint is
+    of an arbitrary hitting row (the reference keeps whichever racing
+    thread's discovery lands first, bfs.rs discovery recording)."""
     import jax.numpy as jnp
 
     cond, evt_cex, ebits = ex["cond"], ex["evt_cex"], ex["ebits"]
@@ -173,11 +180,18 @@ def discovery_update(props, ex, fval, disc_found, disc_lo, disc_hi):
         hits.append(hit)
         los.append(f_lo[row])
         his.append(f_hi[row])
+    return jnp.stack(hits), jnp.stack(los), jnp.stack(his)
+
+
+def discovery_update(props, ex, fval, disc_found, disc_lo, disc_hi):
+    """Fold this wave's property verdicts into the carried per-property
+    discovery flags/fingerprints, keeping the first (shallowest) hit —
+    mirrors bfs.rs discovery recording."""
+    import jax.numpy as jnp
+
     if not props:
         return disc_found, disc_lo, disc_hi
-    hits = jnp.stack(hits)
-    los = jnp.stack(los)
-    his = jnp.stack(his)
+    hits, los, his = wave_hits(props, ex, fval)
     fresh = hits & ~disc_found
     return (
         disc_found | hits,
@@ -214,6 +228,8 @@ class TpuBfsChecker(Checker):
             encoded = to_encoded()
         self.encoded = encoded
         self.capacity = capacity
+        #: summed across shards in sharded variants (occupancy metric).
+        self.total_capacity = capacity
         self.frontier_capacity = frontier_capacity or capacity
         self.track_paths = track_paths
         self.waves_per_sync = waves_per_sync
@@ -565,6 +581,8 @@ class TpuBfsChecker(Checker):
             key_fn = getattr(enc, "cache_key", None)
             if key_fn is not None:
                 cache_key = (
+                    type(self),
+                    self._cache_extras(),
                     type(enc),
                     key_fn(),
                     enc.width,
@@ -598,7 +616,7 @@ class TpuBfsChecker(Checker):
             self._max_depth = max(self._max_depth, int(s[3]))
             self.metrics = dict(
                 frontier_size=int(s[5]),
-                occupancy=self._unique_states / self.capacity,
+                occupancy=self._unique_states / self.total_capacity,
                 dedup_ratio=(
                     1.0 - self._unique_states / self._total_states
                     if self._total_states
@@ -617,17 +635,13 @@ class TpuBfsChecker(Checker):
                     f"{F} new states; re-run with a larger frontier_capacity"
                 )
             if bool(s[9]):
-                raise RuntimeError(
-                    f"candidate-buffer overflow: a wave generated more than "
-                    f"{self.cand_capacity} valid successors; re-run with a "
-                    "larger cand_capacity (or None to disable compaction)"
-                )
+                raise RuntimeError(self._cand_overflow_message())
             if not done and self.metrics["occupancy"] > 0.7:
                 import warnings
 
                 warnings.warn(
                     f"visited table {self.metrics['occupancy']:.0%} full "
-                    f"({self._unique_states}/{self.capacity}); "
+                    f"({self._unique_states}/{self.total_capacity}); "
                     "probe failures become likely past ~85% — consider a "
                     "larger capacity",
                     RuntimeWarning,
@@ -656,13 +670,30 @@ class TpuBfsChecker(Checker):
         )
         disc_found = s[10 : 10 + n_props]
         disc_lo = s[10 + n_props : 10 + 2 * n_props]
-        disc_hi = s[10 + 2 * n_props :]
+        disc_hi = s[10 + 2 * n_props : 10 + 3 * n_props]
+        self._consume_extra_stats(s[10 + 3 * n_props :])
         for i, prop in enumerate(props):
             if disc_found[i]:
                 fp = _fp_int(disc_lo[i], disc_hi[i])
                 self._discovered_fps[prop.name] = fp
                 if self.track_paths:
                     self._discoveries[prop.name] = self._reconstruct(fp)
+
+    def _consume_extra_stats(self, extra: np.ndarray) -> None:
+        """Hook for engine variants that append metric lanes after the
+        per-property discovery lanes (see parallel/engine.py)."""
+
+    def _cache_extras(self) -> tuple:
+        """Engine-variant parameters that distinguish compiled programs
+        (see the compiled-chunk cache in _run)."""
+        return ()
+
+    def _cand_overflow_message(self) -> str:
+        return (
+            f"candidate-buffer overflow: a wave generated more than "
+            f"{self.cand_capacity} valid successors; re-run with a "
+            "larger cand_capacity (or None to disable compaction)"
+        )
 
     # -- reconstruction ----------------------------------------------------
 

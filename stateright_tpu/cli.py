@@ -1,0 +1,266 @@
+"""Per-example CLI entry points.
+
+The reference ships each example as a binary with ``check`` /
+``check-sym`` / ``explore`` / ``spawn`` subcommands (e.g.
+examples/paxos.rs:352-465, examples/2pc.rs:172-251); here one module
+dispatches the same surface for every bundled workload:
+
+    python -m stateright_tpu 2pc check 3
+    python -m stateright_tpu 2pc check-sym 5
+    python -m stateright_tpu 2pc check-tpu 6          (wave engine)
+    python -m stateright_tpu paxos check 2 [network]
+    python -m stateright_tpu paxos explore 2 localhost:3000
+    python -m stateright_tpu paxos spawn
+
+``check`` engines mirror the reference's per-example choices (DFS
+everywhere except interaction-style BFS cases); ``check-tpu`` — the
+addition this framework exists for — runs the same workload on the
+accelerator wave engine. Output goes through ``WriteReporter`` so the
+report shape (``Done. states=… unique=… …``) matches report.rs:60-98.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .actor.network import Network
+from .report import WriteReporter
+
+
+def _opt(args: list[str], index: int, default, parse=int):
+    if len(args) > index:
+        return parse(args[index])
+    return default
+
+
+def _network(args: list[str], index: int) -> Network:
+    name = _opt(args, index, None, parse=str)
+    if name is None:
+        return Network.new_unordered_nonduplicating()
+    return Network.from_name(name)
+
+
+def _report(checker) -> None:
+    checker.report(WriteReporter(sys.stdout))
+
+
+def _explore(builder, args: list[str], index: int) -> None:
+    address = _opt(args, index, "localhost:3000", parse=str)
+    builder.serve(address)
+
+
+# -- workloads -----------------------------------------------------------
+
+
+def _2pc(sub: str, args: list[str]) -> None:
+    from .models.two_phase_commit import TwoPhaseSys
+
+    rm_count = _opt(args, 0, 2)
+    sys_model = TwoPhaseSys(rm_count=rm_count)
+    if sub == "check":
+        print(f"Checking two phase commit with {rm_count} resource managers.")
+        _report(sys_model.checker().spawn_dfs())
+    elif sub == "check-sym":
+        print(
+            f"Checking two phase commit with {rm_count} resource managers "
+            "using symmetry reduction."
+        )
+        _report(sys_model.checker().symmetry().spawn_dfs())
+    elif sub == "check-tpu":
+        print(
+            f"Checking two phase commit with {rm_count} resource managers "
+            "on the TPU wave engine."
+        )
+        # The 2pc space grows ~2.53 bits/RM (288 @ 3 → 296,448 @ 7);
+        # size the visited table to <= ~15% occupancy.
+        import math
+
+        capacity = 1 << max(12, math.ceil(2.6 * rm_count + 2.5))
+        _report(
+            sys_model.checker().spawn_tpu(
+                capacity=capacity,
+                frontier_capacity=capacity // 8,
+                cand_capacity=capacity // 4,
+            )
+        )
+    elif sub == "explore":
+        address = _opt(args, 1, "localhost:3000", parse=str)
+        print(
+            f"Exploring state space for two phase commit with {rm_count} "
+            f"resource managers on {address}."
+        )
+        sys_model.checker().serve(address)
+    else:
+        _usage("2pc")
+
+
+def _paxos(sub: str, args: list[str]) -> None:
+    from .models.paxos import PaxosModelCfg, paxos_model
+
+    client_count = _opt(args, 0, 2)
+    cfg = PaxosModelCfg(client_count=client_count, server_count=3)
+    if sub == "check":
+        network = _network(args, 1)
+        print(f"Model checking Single Decree Paxos with {client_count} clients.")
+        _report(paxos_model(cfg, network).checker().spawn_dfs())
+    elif sub == "check-tpu":
+        print(
+            f"Model checking Single Decree Paxos with {client_count} "
+            "clients on the TPU wave engine."
+        )
+        _report(paxos_model(cfg).checker().spawn_tpu())
+    elif sub == "explore":
+        address = _opt(args, 1, "localhost:3000", parse=str)
+        network = _network(args, 2)
+        print(
+            f"Exploring state space for Single Decree Paxos with "
+            f"{client_count} clients on {address}."
+        )
+        paxos_model(cfg, network).checker().serve(address)
+    elif sub == "spawn":
+        from .actor.spawn import spawn_paxos_cluster
+
+        spawn_paxos_cluster()
+    else:
+        _usage("paxos")
+
+
+def _increment(sub: str, args: list[str]) -> None:
+    from .models.increment import Increment
+
+    thread_count = _opt(args, 0, 2)
+    model = Increment(thread_count=thread_count)
+    if sub == "check":
+        print(f"Model checking increment with {thread_count} threads.")
+        _report(model.checker().spawn_dfs())
+    elif sub == "check-sym":
+        print(
+            f"Model checking increment with {thread_count} threads "
+            "using symmetry reduction."
+        )
+        _report(model.checker().symmetry().spawn_dfs())
+    elif sub == "explore":
+        _explore(model.checker(), args, 1)
+    else:
+        _usage("increment")
+
+
+def _increment_lock(sub: str, args: list[str]) -> None:
+    from .models.increment import IncrementLock
+
+    thread_count = _opt(args, 0, 3)
+    model = IncrementLock(thread_count=thread_count)
+    if sub == "check":
+        print(f"Model checking increment_lock with {thread_count} threads.")
+        _report(model.checker().spawn_dfs())
+    elif sub == "check-sym":
+        print(
+            f"Model checking increment_lock with {thread_count} threads "
+            "using symmetry reduction."
+        )
+        _report(model.checker().symmetry().spawn_dfs())
+    elif sub == "explore":
+        _explore(model.checker(), args, 1)
+    else:
+        _usage("increment-lock")
+
+
+def _single_copy(sub: str, args: list[str]) -> None:
+    from .models.single_copy_register import (
+        SingleCopyRegisterCfg,
+        single_copy_register_model,
+    )
+
+    client_count = _opt(args, 0, 2)
+    cfg = SingleCopyRegisterCfg(client_count=client_count)
+    if sub == "check":
+        network = _network(args, 1)
+        print(
+            f"Model checking a single-copy register with {client_count} "
+            "clients."
+        )
+        _report(single_copy_register_model(cfg, network).checker().spawn_dfs())
+    elif sub == "explore":
+        address = _opt(args, 1, "localhost:3000", parse=str)
+        network = _network(args, 2)
+        print(
+            f"Exploring state space for a single-copy register with "
+            f"{client_count} clients on {address}."
+        )
+        single_copy_register_model(cfg, network).checker().serve(address)
+    elif sub == "spawn":
+        from .actor.spawn import spawn_single_copy_cluster
+
+        spawn_single_copy_cluster()
+    else:
+        _usage("single-copy-register")
+
+
+def _linearizable(sub: str, args: list[str]) -> None:
+    from .models.linearizable_register import AbdModelCfg, abd_model
+
+    client_count = _opt(args, 0, 2)
+    cfg = AbdModelCfg(client_count=client_count)
+    if sub == "check":
+        network = _network(args, 1)
+        print(
+            f"Model checking a linearizable register with {client_count} "
+            "clients."
+        )
+        _report(abd_model(cfg, network).checker().spawn_dfs())
+    elif sub == "explore":
+        address = _opt(args, 1, "localhost:3000", parse=str)
+        network = _network(args, 2)
+        print(
+            f"Exploring state space for a linearizable register with "
+            f"{client_count} clients on {address}."
+        )
+        abd_model(cfg, network).checker().serve(address)
+    elif sub == "spawn":
+        from .actor.spawn import spawn_abd_cluster
+
+        spawn_abd_cluster()
+    else:
+        _usage("linearizable-register")
+
+
+_MODELS = {
+    "2pc": (_2pc, ["check", "check-sym", "check-tpu", "explore"]),
+    "paxos": (_paxos, ["check", "check-tpu", "explore", "spawn"]),
+    "increment": (_increment, ["check", "check-sym", "explore"]),
+    "increment-lock": (_increment_lock, ["check", "check-sym", "explore"]),
+    "single-copy-register": (_single_copy, ["check", "explore", "spawn"]),
+    "linearizable-register": (_linearizable, ["check", "explore", "spawn"]),
+}
+
+
+def _usage(model: str | None = None) -> None:
+    print("USAGE:")
+    if model is None:
+        for name, (_, subs) in _MODELS.items():
+            print(f"  python -m stateright_tpu {name} {{{'|'.join(subs)}}} ...")
+    else:
+        _, subs = _MODELS[model]
+        for sub in subs:
+            extra = {
+                "check": "[COUNT] [NETWORK]",
+                "check-sym": "[COUNT]",
+                "check-tpu": "[COUNT]",
+                "explore": "[COUNT] [ADDRESS] [NETWORK]",
+                "spawn": "",
+            }[sub]
+            print(f"  python -m stateright_tpu {model} {sub} {extra}")
+    print(f"NETWORK: {' | '.join(Network.names())}")
+
+
+def main(argv: list[str] | None = None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv[0] not in _MODELS:
+        _usage()
+        return
+    model, rest = argv[0], argv[1:]
+    handler, subs = _MODELS[model]
+    if not rest or rest[0] not in subs:
+        _usage(model)
+        return
+    handler(rest[0], rest[1:])

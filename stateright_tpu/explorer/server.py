@@ -1,0 +1,242 @@
+"""Explorer HTTP server and view builders.
+
+Mirrors stateright src/checker/explorer.rs:
+
+* ``serve`` (explorer.rs:79-99): attach a 4-second recent-path
+  snapshot visitor, spawn the on-demand checker, serve HTTP.
+* ``GET /.status`` → ``StatusView`` JSON (explorer.rs:16-24, 171-190).
+* ``GET /.states/{fp[/fp...]}`` → a ``StateView`` per enumerated
+  action of the state reached by replaying the fingerprint path
+  (explorer.rs:224-320); each visited fingerprint is also fed to
+  ``check_fingerprint`` so browsing steers the on-demand search.
+* ``POST /.runtocompletion`` → flips to exhaustive search
+  (explorer.rs:144, 192-202).
+
+Views are plain functions over ``(checker, snapshot)`` so tests can
+call them without HTTP, exactly as explorer.rs:322-593 does.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path as FsPath
+from typing import Optional
+
+from ..checker import CheckerBuilder
+from ..fingerprint import fingerprint
+from ..model import Expectation
+from ..path import Path
+
+_EXPECTATION = {
+    Expectation.ALWAYS: "Always",
+    Expectation.SOMETIMES: "Sometimes",
+    Expectation.EVENTUALLY: "Eventually",
+}
+
+_UI_DIR = FsPath(__file__).parent / "ui"
+_UI_FILES = {
+    "/": ("index.htm", "text/html"),
+    "/app.css": ("app.css", "text/css"),
+    "/app.js": ("app.js", "text/javascript"),
+}
+
+
+class Snapshot:
+    """Samples one recently-visited path every ``refresh_sec`` seconds
+    (explorer.rs:61-77, 88-94) to display search progress."""
+
+    def __init__(self, refresh_sec: float = 4.0):
+        self.refresh_sec = refresh_sec
+        self._armed = True
+        self._last_arm = time.monotonic()
+        self._recent: Optional[str] = None
+        self._lock = threading.Lock()
+
+    def visit(self, model, path: Path) -> None:
+        with self._lock:
+            now = time.monotonic()
+            if not self._armed and now - self._last_arm >= self.refresh_sec:
+                self._armed = True
+                self._last_arm = now
+            if not self._armed:
+                return
+            self._armed = False
+            self._recent = repr([model.format_action(a) for a in path.actions()])
+
+    def recent_path(self) -> Optional[str]:
+        with self._lock:
+            return self._recent
+
+
+def get_properties(checker) -> list:
+    """``[expectation, name, encoded discovery path | null]`` triples
+    (explorer.rs:13, 206-222) — the UI's property contract."""
+    out = []
+    for prop in checker.model.properties():
+        disc = checker.discovery(prop.name)
+        out.append(
+            [
+                _EXPECTATION[prop.expectation],
+                prop.name,
+                disc.encode() if disc is not None else None,
+            ]
+        )
+    return out
+
+
+def status_view(checker, snapshot: Optional[Snapshot] = None) -> dict:
+    """``StatusView`` (explorer.rs:16-24, 171-190)."""
+    return {
+        "done": checker.is_done(),
+        "model": type(checker.model).__name__,
+        "state_count": checker.state_count(),
+        "unique_state_count": checker.unique_state_count(),
+        "max_depth": checker.max_depth(),
+        "properties": get_properties(checker),
+        "recent_path": snapshot.recent_path() if snapshot else None,
+    }
+
+
+def state_views(checker, fp_path: str):
+    """``GET /.states{fp_path}`` (explorer.rs:224-320).
+
+    Returns ``(views, None)`` or ``(None, error_message)``.
+    """
+    model = checker.model
+    fps_str = fp_path.strip("/")
+    fps: list[int] = []
+    if fps_str:
+        for part in fps_str.split("/"):
+            try:
+                fps.append(int(part))
+            except ValueError:
+                return None, f"Unable to parse fingerprints {fps_str}"
+
+    views = []
+    if not fps:
+        for state in model.init_states():
+            fp = fingerprint(state)
+            checker.check_fingerprint(fp)
+            views.append(_state_view(model, None, None, state, fp, checker, [fp]))
+        return views, None
+
+    last_state = Path.final_state_of(model, fps)
+    if last_state is None:
+        return None, f"Unable to find state following fingerprints {fps_str}"
+    for action in model.actions(last_state):
+        outcome = model.format_step(last_state, action)
+        next_state = model.next_state(last_state, action)
+        if next_state is None:
+            # "Action ignored" still returned for debugging
+            # (explorer.rs:303-311).
+            views.append(
+                {
+                    "action": model.format_action(action),
+                    "properties": get_properties(checker),
+                }
+            )
+            continue
+        fp = fingerprint(next_state)
+        checker.check_fingerprint(fp)
+        views.append(
+            _state_view(
+                model,
+                model.format_action(action),
+                outcome,
+                next_state,
+                fp,
+                checker,
+                fps + [fp],
+            )
+        )
+    return views, None
+
+
+def _state_view(model, action, outcome, state, fp, checker, fps) -> dict:
+    view = {
+        "state": repr(state),
+        "fingerprint": str(fp),
+        "properties": get_properties(checker),
+    }
+    if action is not None:
+        view["action"] = action
+    if outcome is not None:
+        view["outcome"] = outcome
+    svg = model.as_svg(Path.from_fingerprints(model, fps))
+    if svg is not None:
+        view["svg"] = svg
+    return view
+
+
+def serve(builder: CheckerBuilder, addr: str):
+    """``CheckerBuilder.serve`` (checker.rs:139-146, explorer.rs:79-99).
+
+    Blocks serving the Explorer; returns the checker on shutdown.
+    """
+    snapshot = Snapshot()
+    checker = builder.visitor(snapshot.visit).spawn_on_demand()
+    host, _, port = addr.partition(":")
+    server = make_server(checker, snapshot, host or "localhost", int(port or 3000))
+    print(f"Exploring. Navigate to http://{addr}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return checker
+
+
+def make_server(checker, snapshot, host: str, port: int) -> ThreadingHTTPServer:
+    """Build (without starting) the HTTP server — separable for tests."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+        def _json(self, obj, code=200):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _err(self, msg, code=404):
+            body = msg.encode()
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path in _UI_FILES:
+                name, ctype = _UI_FILES[self.path]
+                data = (_UI_DIR / name).read_bytes()
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            elif self.path == "/.status":
+                self._json(status_view(checker, snapshot))
+            elif self.path.startswith("/.states"):
+                views, err = state_views(checker, self.path[len("/.states"):])
+                if err is not None:
+                    self._err(err)
+                else:
+                    self._json(views)
+            else:
+                self._err("not found")
+
+        def do_POST(self):
+            if self.path == "/.runtocompletion":
+                checker.run_to_completion()
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+            else:
+                self._err("not found")
+
+    return ThreadingHTTPServer((host, port), Handler)

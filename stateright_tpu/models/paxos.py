@@ -220,6 +220,13 @@ def paxos_model(cfg: PaxosModelCfg, network: Network | None = None) -> ActorMode
     model = ActorModel(
         cfg=cfg, init_history=LinearizabilityTester(Register(DEFAULT_VALUE))
     )
+
+    def to_encoded():
+        from .paxos_tpu import PaxosEncoded
+
+        return PaxosEncoded(cfg, network)
+
+    model.to_encoded = to_encoded
     model.add_actors(
         RegisterServer(PaxosActor(model_peers(i, cfg.server_count)))
         for i in range(cfg.server_count)

@@ -1,0 +1,807 @@
+"""Vectorized single-decree Paxos: the north-star TPU workload.
+
+Encodes the full actor-model state of :mod:`stateright_tpu.models.paxos`
+(reference examples/paxos.rs + actor/model_state.rs) — three server
+``PaxosState``s, the register clients, the unordered-nonduplicating
+network, and the in-state ``LinearizabilityTester`` history — as a
+7-lane ``uint32`` vector, with every deliverable envelope compiled to a
+branchless lane-update (SURVEY.md §7 step 5: the actor→encoding
+compilation this framework exists for).
+
+Three structural discoveries (validated by exhaustive host-model probes
+over the pinned 16,668-state space) make a tight encoding possible:
+
+1. **The envelope universe is finite and small.** With ``put_count=1``
+   the reachable (src, dst, msg) alphabet is 68 envelopes; the
+   provably-sound overapproximation enumerated here (coexistence +
+   choosable-proposal closure over ballots) has 70. Every envelope is
+   one bit: the network — a multiset in the reference
+   (network.rs:55) — degenerates to a *set* here (max multiplicity 1,
+   probe-verified), so three ``uint32`` lanes hold it canonically and
+   "deliver envelope k" is a static per-bit transition: src, dst and
+   message content are compile-time constants folded into each of the
+   K=70 action slots.
+
+2. **History phases.** The model prunes actor-no-op deliveries before
+   the history hook runs (model.rs:317-319), so stale ``PutOk``/
+   ``GetOk`` never corrupt the tester: each client's tester state
+   follows the strict progression ``W in-flight → W done + R in-flight
+   → W+R done``, and — because only one proposal is ever decided — the
+   cross-thread snapshots of linearizability.rs:114-126 are always
+   empty. Two bits of phase + two bits of read-value per client encode
+   the tester exactly.
+
+3. **The linearizability verdict is a 144-entry truth table.** Because
+   the tester state is (phase, read_value) per client, the reference's
+   backtracking serializer (linearizability.rs:196-284) has only
+   ``(4*3)^2`` possible inputs. The table is precomputed host-side *by
+   the real serializer* at encoding-build time and the device-side
+   ``always linearizable`` condition is a single gather — the
+   device-filters/host-confirms split SURVEY §7 step 6 calls for,
+   taken to its limit.
+
+Unreachable-by-proof code paths (e.g. a Put at ballot round ≥ 2, an
+out-of-universe ``last_accepted``) set a poison bit that perturbs the
+fingerprint, so any soundness gap surfaces as a differential-test
+failure instead of a silent wrong answer; ``encode()`` raises on any
+host state outside the bounded universe.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from ..actor import Id
+from ..actor.register import Get, GetOk, Internal, Put, PutOk
+from ..encoding import EncodedModelBase
+from ..semantics.register import ReadOk, ReadOp, WriteOk, WriteOp
+from .paxos import (
+    Accept,
+    Accepted,
+    Decided,
+    PaxosModelCfg,
+    Prepare,
+    Prepared,
+    paxos_model,
+)
+
+# -- lane layout ---------------------------------------------------------
+# Server lane (one per server):
+_B_BALLOT, _W_BALLOT = 0, 3       # ballot enum
+_B_PROP, _W_PROP = 3, 2           # proposal code (0 = None)
+_B_ACC, _W_ACC = 5, 4             # accepted la-code (0 = None)
+_B_DEC = 9                        # is_decided
+_B_ACCEPTS, _W_ACCEPTS = 10, 3    # accepts id-mask
+_B_PREP, _W_PREP = 13, 4          # prepares[i]: 0 = absent, else 1+la
+# Client/history lane: per client j at bit j*6:
+#   +0 (2b) actor phase: 0 awaiting PutOk, 1 awaiting GetOk, 2 done
+#   +2 (2b) history phase: 0 W-inflight, 1 W-done, 2 +R-inflight, 3 done
+#   +4 (2b) read value code (0 '\x00', 1+ value index)
+_B_POISON = 30
+
+
+def _field(lane, shift, width, xp):
+    return (lane >> xp.uint32(shift)) & xp.uint32((1 << width) - 1)
+
+
+def _set_field(lane, shift, width, value, xp):
+    mask = xp.uint32(((1 << width) - 1) << shift)
+    return (lane & ~mask) | (
+        (value.astype(xp.uint32) if hasattr(value, "astype") else xp.uint32(value))
+        << xp.uint32(shift)
+    ) & mask
+
+
+@dataclass(frozen=True)
+class EnvSpec:
+    """One envelope of the bounded universe; all fields are host-side
+    constants folded into the compiled transition."""
+
+    src: int
+    dst: int
+    kind: str  # put|get|putok|getok|prepare|prepared|accept|accepted|decided
+    ballot: int = 0      # ballot enum
+    prop: int = 0        # proposal code
+    la: int = 0          # last_accepted la-code (prepared)
+    value: int = 0       # value code (getok)
+
+
+class PaxosEncoded(EncodedModelBase):
+    """EncodedModel for ``paxos_model(PaxosModelCfg(...))``.
+
+    Supports the reference benchmark shape: 3 servers, 1 put per
+    client, 1-2 clients (examples/paxos.rs:325 pins 2c/3s = 16,668).
+    """
+
+    def __init__(self, cfg: PaxosModelCfg, network=None):
+        if cfg.server_count != 3 or cfg.put_count != 1:
+            raise ValueError(
+                "PaxosEncoded supports server_count=3, put_count=1 "
+                f"(got {cfg})"
+            )
+        if not (1 <= cfg.client_count <= 2):
+            raise ValueError(
+                f"PaxosEncoded supports 1-2 clients (got {cfg.client_count})"
+            )
+        if network is not None and type(network).__name__ != (
+            "UnorderedNonDuplicating"
+        ):
+            raise ValueError(
+                "PaxosEncoded models the unordered non-duplicating network"
+            )
+        self.cfg = cfg
+        self.S = cfg.server_count
+        self.C = cfg.client_count
+        self.clients = list(range(self.S, self.S + self.C))
+        self.host_model = paxos_model(cfg)
+
+        # Proposals: client i's single put (req_id=i, requester=i,
+        # value chr(ord('A')+i-S)); code = 1 + index (req-id order).
+        self.values = [chr(ord("A") + i - self.S) for i in self.clients]
+        self.proposals = [
+            (i, Id(i), self.values[j]) for j, i in enumerate(self.clients)
+        ]
+        self.P = len(self.proposals)
+
+        # Ballots. Leaders = put-target servers (client i -> i % S).
+        # With 1 leader, rounds stop at 1. With 2 leaders l0<l1 the
+        # reachable ballots are (1,l0) (1,l1) (2,l0) (2,l1) — a server
+        # Putting at round r requires its ballot to have been raised by
+        # the *other* leader's round-r ballot, and each server Puts at
+        # most once, so rounds cap at the leader count. Coexistence:
+        # (2,l0) implies l0 Put after adopting (1,l1), excluding (1,l0)
+        # — so {(1,l0),(1,l1)}, {(1,l0),(2,l1)}, {(1,l1),(2,l0)} are
+        # the only co-reachable pairs.
+        self.leaders = sorted({i % self.S for i in self.clients})
+        ballots = [(r, l) for r in range(1, len(self.leaders) + 1)
+                   for l in self.leaders]
+        ballots.sort()
+        #: ballot enum: 0 = initial (0, Id(0)); 1.. = sorted reachable
+        self.ballots = ballots
+        self.ballot_enum = {(0, Id(0)): 0}
+        for n, (r, l) in enumerate(ballots):
+            self.ballot_enum[(r, Id(l))] = n + 1
+        self.NB = len(ballots)
+
+        def coexists(b1: int, b2: int) -> bool:
+            """May ballot enums b1 < b2 both exist in one run?"""
+            (r1, l1), (r2, l2) = ballots[b1 - 1], ballots[b2 - 1]
+            if l1 == l2:
+                return False  # one Put per server: one ballot per leader
+            if r1 == r2:
+                return r1 == 1
+            # (higher round, l2) requires l2's Put at (r2-1, l1)=b1's
+            # round; only coexists when b1 is that raising ballot.
+            return r2 == r1 + 1
+
+        # choosable(b): proposals a leader can drive under ballot b —
+        # its own put, or any adoptable last_accepted from a lower
+        # coexisting ballot (closure).
+        own_prop = {}
+        for j, i in enumerate(self.clients):
+            own_prop.setdefault(i % self.S, []).append(j + 1)
+        choosable: dict[int, set] = {}
+        la_universe: dict[int, list] = {}
+        for b in range(1, self.NB + 1):
+            _, l = ballots[b - 1]
+            ch = set(own_prop.get(l, []))
+            las = [0]
+            for b2 in range(1, b):
+                if coexists(b2, b):
+                    for p in sorted(choosable[b2]):
+                        las.append(1 + (b2 - 1) * self.P + (p - 1))
+                        ch.add(p)
+            choosable[b] = ch
+            la_universe[b] = las
+        self.choosable = {b: sorted(ch) for b, ch in choosable.items()}
+        self.la_universe = la_universe
+
+        self.universe = self._build_universe()
+        self.index = {self._env_key(e): k for k, e in enumerate(self.universe)}
+        self.K = len(self.universe)
+        self.net_lanes = (self.K + 31) // 32
+        self.width = self.S + 1 + self.net_lanes
+        self.max_actions = self.K
+        self._lin_table = self._build_lin_table()
+
+    def cache_key(self):
+        return (self.C, self.S, self.cfg.put_count)
+
+    # -- universe ----------------------------------------------------------
+
+    def _build_universe(self) -> list:
+        u: list[EnvSpec] = []
+        S, P = self.S, self.P
+        # Puts and Gets (register.rs:144-236 request scheme).
+        for j, c in enumerate(self.clients):
+            u.append(EnvSpec(c, c % S, "put", prop=j + 1))
+        for j, c in enumerate(self.clients):
+            u.append(EnvSpec(c, (c + 1) % S, "get"))
+        # PutOk from any leader that can drive this client's proposal.
+        for l in self.leaders:
+            for j, c in enumerate(self.clients):
+                if any(j + 1 in self.choosable[b]
+                       for b in range(1, self.NB + 1)
+                       if self.ballots[b - 1][1] == l):
+                    u.append(EnvSpec(l, c, "putok", prop=j + 1))
+        # GetOk from the get-target server, any decided value.
+        for j, c in enumerate(self.clients):
+            for v in range(1, P + 1):
+                u.append(EnvSpec((c + 1) % S, c, "getok", value=v))
+        # Internal protocol messages.
+        for b in range(1, self.NB + 1):
+            _, l = self.ballots[b - 1]
+            peers = [d for d in range(S) if d != l]
+            for d in peers:
+                u.append(EnvSpec(l, d, "prepare", ballot=b))
+            for d in peers:
+                for la in self.la_universe[b]:
+                    u.append(EnvSpec(d, l, "prepared", ballot=b, la=la))
+            for p in self.choosable[b]:
+                for d in peers:
+                    u.append(EnvSpec(l, d, "accept", ballot=b, prop=p))
+            for d in peers:
+                u.append(EnvSpec(d, l, "accepted", ballot=b))
+            for p in self.choosable[b]:
+                for d in peers:
+                    u.append(EnvSpec(l, d, "decided", ballot=b, prop=p))
+        return u
+
+    def _env_key(self, e: EnvSpec) -> tuple:
+        return (e.src, e.dst, e.kind, e.ballot, e.prop, e.la, e.value)
+
+    # -- host <-> codes ----------------------------------------------------
+
+    def _ballot_code(self, ballot: Tuple) -> int:
+        code = self.ballot_enum.get((ballot[0], ballot[1]))
+        if code is None:
+            raise ValueError(f"ballot outside universe: {ballot!r}")
+        return code
+
+    def _prop_code(self, proposal: Optional[Tuple]) -> int:
+        if proposal is None:
+            return 0
+        for j, p in enumerate(self.proposals):
+            if p == proposal:
+                return j + 1
+        raise ValueError(f"proposal outside universe: {proposal!r}")
+
+    def _la_code(self, la: Optional[Tuple]) -> int:
+        if la is None:
+            return 0
+        b = self._ballot_code(la[0])
+        p = self._prop_code(la[1])
+        if b == 0 or p == 0:
+            raise ValueError(f"last_accepted outside universe: {la!r}")
+        return 1 + (b - 1) * self.P + (p - 1)
+
+    def _value_code(self, value: str) -> int:
+        if value == "\x00":
+            return 0
+        try:
+            return 1 + self.values.index(value)
+        except ValueError:
+            raise ValueError(f"value outside universe: {value!r}")
+
+    def _msg_env_key(self, src: int, dst: int, msg: Any) -> tuple:
+        if isinstance(msg, Put):
+            return (src, dst, "put", 0, self._prop_code((msg.req_id, Id(src), msg.value)), 0, 0)
+        if isinstance(msg, Get):
+            return (src, dst, "get", 0, 0, 0, 0)
+        if isinstance(msg, PutOk):
+            j = self.clients.index(msg.req_id)  # first-op req_id == client id
+            return (src, dst, "putok", 0, j + 1, 0, 0)
+        if isinstance(msg, GetOk):
+            return (src, dst, "getok", 0, 0, 0, self._value_code(msg.value))
+        if isinstance(msg, Internal):
+            m = msg.msg
+            if isinstance(m, Prepare):
+                return (src, dst, "prepare", self._ballot_code(m.ballot), 0, 0, 0)
+            if isinstance(m, Prepared):
+                return (
+                    src, dst, "prepared", self._ballot_code(m.ballot),
+                    0, self._la_code(m.last_accepted), 0,
+                )
+            if isinstance(m, Accept):
+                return (
+                    src, dst, "accept", self._ballot_code(m.ballot),
+                    self._prop_code(m.proposal), 0, 0,
+                )
+            if isinstance(m, Accepted):
+                return (src, dst, "accepted", self._ballot_code(m.ballot), 0, 0, 0)
+            if isinstance(m, Decided):
+                return (
+                    src, dst, "decided", self._ballot_code(m.ballot),
+                    self._prop_code(m.proposal), 0, 0,
+                )
+        raise ValueError(f"message outside universe: {msg!r}")
+
+    # -- encode ------------------------------------------------------------
+
+    def encode(self, state) -> np.ndarray:
+        vec = np.zeros(self.width, dtype=np.uint32)
+        for i in range(self.S):
+            s = state.actor_states[i].state
+            lane = 0
+            lane |= self._ballot_code(s.ballot) << _B_BALLOT
+            lane |= self._prop_code(s.proposal) << _B_PROP
+            lane |= self._la_code(s.accepted) << _B_ACC
+            lane |= (1 if s.is_decided else 0) << _B_DEC
+            mask = 0
+            for sid in s.accepts:
+                mask |= 1 << int(sid)
+            lane |= mask << _B_ACCEPTS
+            for sid, la in s.prepares.items():
+                lane |= (1 + self._la_code(la)) << (_B_PREP + _W_PREP * int(sid))
+            vec[i] = lane
+        clane = 0
+        for j, c in enumerate(self.clients):
+            cs = state.actor_states[c]
+            if cs.awaiting == c and cs.op_count == 1:
+                phase = 0
+            elif cs.awaiting == 2 * c and cs.op_count == 2:
+                phase = 1
+            elif cs.awaiting is None and cs.op_count == 3:
+                phase = 2
+            else:
+                raise ValueError(f"client state outside universe: {cs!r}")
+            hphase, rval = self._history_phase(state.history, Id(c))
+            clane |= phase << (j * 6)
+            clane |= hphase << (j * 6 + 2)
+            clane |= rval << (j * 6 + 4)
+        vec[self.S] = clane
+        for env, count in self._network_items(state.network):
+            if count != 1:
+                raise ValueError(
+                    f"envelope multiplicity {count} outside universe: {env!r}"
+                )
+            key = self._msg_env_key(int(env.src), int(env.dst), env.msg)
+            k = self.index.get(key)
+            if k is None:
+                raise ValueError(f"envelope outside universe: {env!r}")
+            vec[self.S + 1 + k // 32] |= np.uint32(1 << (k % 32))
+        if any(state.crashed) or any(t for t in state.timers_set):
+            raise ValueError("crashes/timers outside the paxos universe")
+        return vec
+
+    def _network_items(self, network):
+        from collections import Counter
+
+        return Counter(network.iter_all()).items()
+
+    def _history_phase(self, history, thread: Id) -> tuple[int, int]:
+        if not history.is_valid:
+            raise ValueError("invalid history outside universe")
+        completed = dict(history.history_by_thread).get(thread, ())
+        in_flight = dict(history.in_flight_by_thread).get(thread)
+        j = self.clients.index(int(thread))
+        wv = self.values[j]
+        rval = 0
+        if len(completed) == 0 and in_flight is not None:
+            snap, op = in_flight
+            if snap != () or not isinstance(op, WriteOp) or op.value != wv:
+                raise ValueError(f"history outside universe: {in_flight!r}")
+            phase = 0
+        elif len(completed) >= 1:
+            snap, op, ret = completed[0]
+            if (
+                snap != ()
+                or not isinstance(op, WriteOp)
+                or op.value != wv
+                or not isinstance(ret, WriteOk)
+            ):
+                raise ValueError(f"history outside universe: {completed!r}")
+            if len(completed) == 1 and in_flight is None:
+                phase = 1
+            elif len(completed) == 1:
+                snap, op = in_flight
+                if snap != () or not isinstance(op, ReadOp):
+                    raise ValueError(f"history outside universe: {in_flight!r}")
+                phase = 2
+            elif len(completed) == 2 and in_flight is None:
+                snap, op, ret = completed[1]
+                if snap != () or not isinstance(op, ReadOp) or not isinstance(ret, ReadOk):
+                    raise ValueError(f"history outside universe: {completed!r}")
+                phase = 3
+                rval = self._value_code(ret.value)
+            else:
+                raise ValueError(f"history outside universe: {completed!r}")
+        else:
+            raise ValueError(f"history outside universe: thread {thread!r}")
+        return phase, rval
+
+    def init_vecs(self) -> np.ndarray:
+        return np.stack(
+            [self.encode(s) for s in self.host_model.init_states()]
+        )
+
+    # -- linearizability truth table --------------------------------------
+
+    def _build_lin_table(self) -> np.ndarray:
+        """Evaluate the REAL serializer on every (phase, rval) combo.
+
+        Reachable states have at most one client past phase 0 (only one
+        proposal is ever decided); combos with both clients progressed
+        are marked not-linearizable so that, were one ever produced,
+        it would surface as a loud counterexample rather than pass
+        silently.
+        """
+        from ..semantics import LinearizabilityTester, Register
+
+        size = (4 * 3) ** self.C
+        table = np.zeros(size, dtype=bool)
+        import itertools
+
+        for combo in itertools.product(range(4), range(3), repeat=self.C):
+            phases = combo[0::2]
+            rvals = combo[1::2]
+            idx = 0
+            for ph, rv in zip(phases, rvals):
+                idx = idx * 12 + ph * 3 + rv
+            if sum(1 for p in phases if p > 0) > 1 or any(
+                rv > self.P for rv in rvals
+            ):
+                table[idx] = False
+                continue
+            tester = LinearizabilityTester(Register("\x00"))
+            ok = True
+            for j in range(self.C):
+                tester = tester.on_invoke(
+                    Id(self.clients[j]), WriteOp(self.values[j])
+                )
+            for j in range(self.C):
+                t = Id(self.clients[j])
+                ph, rv = phases[j], rvals[j]
+                if ph >= 1:
+                    tester = tester.on_return(t, WriteOk())
+                if ph >= 2:
+                    tester = tester.on_invoke(t, ReadOp())
+                if ph >= 3:
+                    v = "\x00" if rv == 0 else self.values[rv - 1]
+                    tester = tester.on_return(t, ReadOk(v))
+            table[idx] = tester.serialized_history() is not None
+        return table
+
+    # -- device step -------------------------------------------------------
+
+    def _bit(self, vec, k, xp):
+        lane = vec[self.S + 1 + k // 32]
+        return ((lane >> xp.uint32(k % 32)) & xp.uint32(1)) != 0
+
+    def _net_update(self, vec, clear_k, send_masks, xp):
+        """Clear bit ``clear_k``; OR per-lane ``send_masks`` in."""
+        out = vec
+        for ln in range(self.net_lanes):
+            lane = vec[self.S + 1 + ln]
+            if clear_k // 32 == ln:
+                lane = lane & ~xp.uint32(1 << (clear_k % 32))
+            m = send_masks.get(ln)
+            if m is not None:
+                lane = lane | m
+            out = out.at[self.S + 1 + ln].set(lane)
+        return out
+
+    def _const_mask(self, keys) -> dict:
+        """Per-lane OR mask for a set of universe keys (host consts)."""
+        masks: dict[int, int] = {}
+        for key in keys:
+            k = self.index[key]
+            masks[k // 32] = masks.get(k // 32, 0) | (1 << (k % 32))
+        return masks
+
+    def step_vec(self, vec):
+        import jax.numpy as jnp
+
+        succs, valids = [], []
+        for k, e in enumerate(self.universe):
+            s, valid = self._deliver(vec, k, e, jnp)
+            succs.append(s)
+            valids.append(valid)
+        return jnp.stack(succs), jnp.stack(valids)
+
+    def _deliver(self, vec, k, e: EnvSpec, xp):
+        present = self._bit(vec, k, xp)
+        handler = getattr(self, f"_on_{e.kind}")
+        new_vec, handled = handler(vec, k, e, xp)
+        return new_vec, present & handled
+
+    # Per-kind handlers: return (successor_vec, handled). All message
+    # fields are Python constants; only lane contents are traced.
+
+    def _on_put(self, vec, k, e: EnvSpec, xp):
+        lane = vec[e.dst]
+        decided = _field(lane, _B_DEC, 1, xp) != 0
+        prop = _field(lane, _B_PROP, _W_PROP, xp)
+        ballot = _field(lane, _B_BALLOT, _W_BALLOT, xp)
+        acc = _field(lane, _B_ACC, _W_ACC, xp)
+        handled = (~decided) & (prop == 0)
+        # New ballot: (round+1, dst). Rounds for this leader:
+        rounds = sorted(
+            r for (r, l) in self.ballots if l == e.dst
+        )
+        round_of = xp.asarray(
+            [0] + [r for (r, _) in self.ballots], dtype=xp.uint32
+        )
+        cur_round = round_of[ballot]
+        nb = xp.uint32(0)
+        poison = handled & xp.bool_(True)
+        for r in rounds:
+            hit = cur_round == (r - 1)
+            nb = xp.where(hit, xp.uint32(self.ballot_enum[(r, Id(e.dst))]), nb)
+            poison = poison & ~hit
+        new_lane = xp.uint32(0)
+        new_lane = new_lane | (nb << _B_BALLOT)
+        new_lane = new_lane | (xp.uint32(e.prop) << _B_PROP)
+        new_lane = new_lane | (acc << _B_ACC)
+        new_lane = new_lane | ((acc + 1) << xp.uint32(_B_PREP + _W_PREP * e.dst))
+        # Sends: Prepare(nb) to both peers — select the mask by round.
+        masks: dict = {}
+        for r in rounds:
+            b = self.ballot_enum[(r, Id(e.dst))]
+            keys = [
+                (e.dst, d, "prepare", b, 0, 0, 0)
+                for d in range(self.S)
+                if d != e.dst
+            ]
+            cm = self._const_mask(keys)
+            hit = cur_round == (r - 1)
+            for ln, m in cm.items():
+                masks[ln] = masks.get(ln, xp.uint32(0)) | xp.where(
+                    hit, xp.uint32(m), xp.uint32(0)
+                )
+        out = vec.at[e.dst].set(xp.where(handled, new_lane, lane))
+        out = self._poison(out, poison, xp)
+        out = self._net_update(out, k, masks, xp)
+        return out, handled
+
+    def _on_get(self, vec, k, e: EnvSpec, xp):
+        lane = vec[e.dst]
+        decided = _field(lane, _B_DEC, 1, xp) != 0
+        acc = _field(lane, _B_ACC, _W_ACC, xp)
+        handled = decided
+        # Reply GetOk(value of accepted proposal).
+        val = xp.where(acc > 0, ((acc - 1) % xp.uint32(self.P)) + 1, 0)
+        masks: dict = {}
+        for v in range(1, self.P + 1):
+            key = (e.dst, e.src, "getok", 0, 0, 0, v)
+            if key not in self.index:
+                continue
+            cm = self._const_mask([key])
+            hit = handled & (val == v)
+            for ln, m in cm.items():
+                masks[ln] = masks.get(ln, xp.uint32(0)) | xp.where(
+                    hit, xp.uint32(m), xp.uint32(0)
+                )
+        out = self._net_update(vec, k, masks, xp)
+        return out, handled
+
+    def _on_putok(self, vec, k, e: EnvSpec, xp):
+        j = self.clients.index(e.dst)
+        lane = vec[self.S]
+        phase = _field(lane, j * 6, 2, xp)
+        handled = phase == 0
+        new_lane = _set_field(lane, j * 6, 2, xp.uint32(1), xp)
+        # History: W returns, R invoked (phases 0 -> 2).
+        new_lane = _set_field(new_lane, j * 6 + 2, 2, xp.uint32(2), xp)
+        out = vec.at[self.S].set(xp.where(handled, new_lane, lane))
+        get_key = (e.dst, (e.dst + 1) % self.S, "get", 0, 0, 0, 0)
+        cm = self._const_mask([get_key])
+        masks = {
+            ln: xp.where(handled, xp.uint32(m), xp.uint32(0))
+            for ln, m in cm.items()
+        }
+        out = self._net_update(out, k, masks, xp)
+        return out, handled
+
+    def _on_getok(self, vec, k, e: EnvSpec, xp):
+        j = self.clients.index(e.dst)
+        lane = vec[self.S]
+        phase = _field(lane, j * 6, 2, xp)
+        handled = phase == 1
+        new_lane = _set_field(lane, j * 6, 2, xp.uint32(2), xp)
+        new_lane = _set_field(new_lane, j * 6 + 2, 2, xp.uint32(3), xp)
+        new_lane = _set_field(new_lane, j * 6 + 4, 2, xp.uint32(e.value), xp)
+        out = vec.at[self.S].set(xp.where(handled, new_lane, lane))
+        out = self._net_update(out, k, {}, xp)
+        return out, handled
+
+    def _on_prepare(self, vec, k, e: EnvSpec, xp):
+        lane = vec[e.dst]
+        decided = _field(lane, _B_DEC, 1, xp) != 0
+        ballot = _field(lane, _B_BALLOT, _W_BALLOT, xp)
+        acc = _field(lane, _B_ACC, _W_ACC, xp)
+        handled = (~decided) & (ballot < e.ballot)
+        new_lane = _set_field(lane, _B_BALLOT, _W_BALLOT, xp.uint32(e.ballot), xp)
+        # Send Prepared(b, la=accepted) to the leader; select the
+        # envelope by the acceptor's current accepted code.
+        masks: dict = {}
+        covered = handled & xp.bool_(False)
+        for la in self.la_universe[e.ballot]:
+            key = (e.dst, e.src, "prepared", e.ballot, 0, la, 0)
+            cm = self._const_mask([key])
+            hit = handled & (acc == la)
+            covered = covered | hit
+            for ln, m in cm.items():
+                masks[ln] = masks.get(ln, xp.uint32(0)) | xp.where(
+                    hit, xp.uint32(m), xp.uint32(0)
+                )
+        poison = handled & ~covered
+        out = vec.at[e.dst].set(xp.where(handled, new_lane, lane))
+        out = self._poison(out, poison, xp)
+        out = self._net_update(out, k, masks, xp)
+        return out, handled
+
+    def _on_prepared(self, vec, k, e: EnvSpec, xp):
+        l = e.dst
+        lane = vec[l]
+        decided = _field(lane, _B_DEC, 1, xp) != 0
+        ballot = _field(lane, _B_BALLOT, _W_BALLOT, xp)
+        prop = _field(lane, _B_PROP, _W_PROP, xp)
+        handled = (~decided) & (ballot == e.ballot)
+        # prepares[src] = 1 + la.
+        new_lane = _set_field(
+            lane, _B_PREP + _W_PREP * e.src, _W_PREP, xp.uint32(1 + e.la), xp
+        )
+        entries = [
+            _field(new_lane, _B_PREP + _W_PREP * i, _W_PREP, xp)
+            for i in range(self.S)
+        ]
+        count = sum((en != 0).astype(xp.uint32) for en in entries)
+        fire = handled & (count == 2)  # majority(3) (paxos.rs:144)
+        # best la among present entries (la codes order by (ballot,
+        # proposal), None lowest — matches _accepted_sort_key).
+        best = xp.uint32(0)
+        for en in entries:
+            la = xp.where(en != 0, en - 1, 0)
+            best = xp.maximum(best, la)
+        chosen = xp.where(
+            best > 0, ((best - 1) % xp.uint32(self.P)) + 1, prop
+        )
+        acc_code = 1 + (e.ballot - 1) * self.P + (chosen - 1)
+        fired_lane = new_lane
+        fired_lane = _set_field(fired_lane, _B_PROP, _W_PROP, chosen, xp)
+        fired_lane = _set_field(fired_lane, _B_ACC, _W_ACC, acc_code, xp)
+        fired_lane = _set_field(
+            fired_lane, _B_ACCEPTS, _W_ACCEPTS, xp.uint32(1 << l), xp
+        )
+        new_lane = xp.where(fire, fired_lane, new_lane)
+        masks: dict = {}
+        covered = fire & xp.bool_(False)
+        for p in self.choosable[e.ballot]:
+            keys = [
+                (l, d, "accept", e.ballot, p, 0, 0)
+                for d in range(self.S)
+                if d != l
+            ]
+            cm = self._const_mask(keys)
+            hit = fire & (chosen == p)
+            covered = covered | hit
+            for ln, m in cm.items():
+                masks[ln] = masks.get(ln, xp.uint32(0)) | xp.where(
+                    hit, xp.uint32(m), xp.uint32(0)
+                )
+        poison = fire & ~covered
+        out = vec.at[l].set(xp.where(handled, new_lane, lane))
+        out = self._poison(out, poison, xp)
+        out = self._net_update(out, k, masks, xp)
+        return out, handled
+
+    def _on_accept(self, vec, k, e: EnvSpec, xp):
+        lane = vec[e.dst]
+        decided = _field(lane, _B_DEC, 1, xp) != 0
+        ballot = _field(lane, _B_BALLOT, _W_BALLOT, xp)
+        handled = (~decided) & (ballot <= e.ballot)
+        acc_code = 1 + (e.ballot - 1) * self.P + (e.prop - 1)
+        new_lane = _set_field(lane, _B_BALLOT, _W_BALLOT, xp.uint32(e.ballot), xp)
+        new_lane = _set_field(new_lane, _B_ACC, _W_ACC, xp.uint32(acc_code), xp)
+        out = vec.at[e.dst].set(xp.where(handled, new_lane, lane))
+        cm = self._const_mask([(e.dst, e.src, "accepted", e.ballot, 0, 0, 0)])
+        masks = {
+            ln: xp.where(handled, xp.uint32(m), xp.uint32(0))
+            for ln, m in cm.items()
+        }
+        out = self._net_update(out, k, masks, xp)
+        return out, handled
+
+    def _on_accepted(self, vec, k, e: EnvSpec, xp):
+        l = e.dst
+        lane = vec[l]
+        decided = _field(lane, _B_DEC, 1, xp) != 0
+        ballot = _field(lane, _B_BALLOT, _W_BALLOT, xp)
+        prop = _field(lane, _B_PROP, _W_PROP, xp)
+        handled = (~decided) & (ballot == e.ballot)
+        accepts = _field(lane, _B_ACCEPTS, _W_ACCEPTS, xp) | xp.uint32(
+            1 << e.src
+        )
+        count = sum(
+            ((accepts >> xp.uint32(i)) & 1).astype(xp.uint32)
+            for i in range(self.S)
+        )
+        fire = handled & (count == 2)
+        new_lane = _set_field(lane, _B_ACCEPTS, _W_ACCEPTS, accepts, xp)
+        new_lane = xp.where(
+            fire, new_lane | xp.uint32(1 << _B_DEC), new_lane
+        )
+        masks: dict = {}
+        covered = fire & xp.bool_(False)
+        for p in self.choosable[e.ballot]:
+            keys = [
+                (l, d, "decided", e.ballot, p, 0, 0)
+                for d in range(self.S)
+                if d != l
+            ]
+            # PutOk to the proposal's requester.
+            keys.append((l, self.clients[p - 1], "putok", 0, p, 0, 0))
+            cm = self._const_mask(keys)
+            hit = fire & (prop == p)
+            covered = covered | hit
+            for ln, m in cm.items():
+                masks[ln] = masks.get(ln, xp.uint32(0)) | xp.where(
+                    hit, xp.uint32(m), xp.uint32(0)
+                )
+        poison = fire & ~covered
+        out = vec.at[l].set(xp.where(handled, new_lane, lane))
+        out = self._poison(out, poison, xp)
+        out = self._net_update(out, k, masks, xp)
+        return out, handled
+
+    def _on_decided(self, vec, k, e: EnvSpec, xp):
+        lane = vec[e.dst]
+        decided = _field(lane, _B_DEC, 1, xp) != 0
+        handled = ~decided
+        acc_code = 1 + (e.ballot - 1) * self.P + (e.prop - 1)
+        new_lane = _set_field(lane, _B_BALLOT, _W_BALLOT, xp.uint32(e.ballot), xp)
+        new_lane = _set_field(new_lane, _B_ACC, _W_ACC, xp.uint32(acc_code), xp)
+        new_lane = new_lane | xp.uint32(1 << _B_DEC)
+        out = vec.at[e.dst].set(xp.where(handled, new_lane, lane))
+        out = self._net_update(out, k, {}, xp)
+        return out, handled
+
+    def _poison(self, vec, cond, xp):
+        lane = vec[self.S]
+        return vec.at[self.S].set(
+            xp.where(cond, lane | xp.uint32(1 << _B_POISON), lane)
+        )
+
+    # -- properties --------------------------------------------------------
+
+    def property_conditions_vec(self, vec):
+        import jax.numpy as jnp
+
+        clane = vec[self.S]
+        idx = jnp.uint32(0)
+        for j in range(self.C):
+            ph = _field(clane, j * 6 + 2, 2, jnp)
+            rv = _field(clane, j * 6 + 4, 2, jnp)
+            idx = idx * 12 + ph * 3 + rv
+        table = jnp.asarray(self._lin_table)
+        linearizable = table[idx] & (
+            _field(clane, _B_POISON, 1, jnp) == 0
+        )
+        # "value chosen": a deliverable GetOk with a non-default value.
+        masks = self._const_mask(
+            [
+                self._env_key(e)
+                for e in self.universe
+                if e.kind == "getok" and e.value != 0
+            ]
+        )
+        chosen = jnp.bool_(False)
+        for ln, m in masks.items():
+            chosen = chosen | ((vec[self.S + 1 + ln] & jnp.uint32(m)) != 0)
+        return jnp.stack([linearizable, chosen])
+
+
+def paxos_encoded(
+    client_count: int = 2, server_count: int = 3, put_count: int = 1
+) -> PaxosEncoded:
+    return PaxosEncoded(
+        PaxosModelCfg(
+            client_count=client_count,
+            server_count=server_count,
+            put_count=put_count,
+        )
+    )

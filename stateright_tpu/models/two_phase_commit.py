@@ -66,6 +66,12 @@ class TwoPhaseSys(Model):
 
     rm_count: int
 
+    def to_encoded(self):
+        """The TPU-engine encoding (spawn_tpu discovers this hook)."""
+        from .two_phase_commit_tpu import TwoPhaseSysEncoded
+
+        return TwoPhaseSysEncoded(self.rm_count)
+
     def init_states(self) -> Sequence[TwoPhaseState]:
         return [
             TwoPhaseState(

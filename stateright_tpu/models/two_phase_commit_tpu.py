@@ -167,11 +167,3 @@ class TwoPhaseSysEncoded(EncodedModelBase):
             jnp.any(rms == _ABORTED) & jnp.any(rms == _COMMITTED)
         )
         return jnp.stack([all_aborted, all_committed, consistent])
-
-
-def _to_encoded(self: TwoPhaseSys) -> TwoPhaseSysEncoded:
-    return TwoPhaseSysEncoded(self.rm_count)
-
-
-# spawn_tpu() discovers encodings via Model.to_encoded().
-TwoPhaseSys.to_encoded = _to_encoded

@@ -1,19 +1,25 @@
 """Test configuration.
 
-TPU-engine tests run on a virtual 8-device CPU mesh so multi-chip
-sharding (shard_map + all_to_all frontier shuffles) is exercised
-without TPU hardware. Must be set before jax is imported anywhere.
+Tests run on a virtual 8-device CPU mesh (fast, deterministic, no TPU
+required); the sharded-engine tests in test_parallel.py exercise the
+multi-chip path (shard_map + all_to_all frontier shuffles) on that
+mesh. Real-TPU runs go through bench.py, which leaves the platform
+selection alone.
+
+The axon sitecustomize force-registers the TPU backend and overrides
+the JAX_PLATFORMS env var via jax.config, so forcing CPU requires both
+(a) the XLA flag before any backend initializes and (b) an explicit
+config update, which beats the plugin's.
 """
 
 import os
 
-# Force CPU even when the environment provides a TPU backend (the
-# driver's axon tunnel sets JAX_PLATFORMS=axon): tests must be fast,
-# deterministic, and able to fake an 8-device mesh. Real-TPU runs go
-# through bench.py, which leaves the environment alone.
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

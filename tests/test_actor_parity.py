@@ -1,0 +1,230 @@
+"""Actor-layer parity: ordered reliable link, heterogeneous actors
+(Choice / scripted clients), and the write-once-register adapter.
+
+References: ordered_reliable_link.rs:32-207, actor.rs:343-549,
+write_once_register.rs:16-331.
+"""
+
+from dataclasses import dataclass
+from typing import Any
+
+import pytest
+
+from stateright_tpu.actor import (
+    Actor,
+    ActorModel,
+    Cow,
+    Id,
+    Network,
+    Out,
+)
+from stateright_tpu.actor.choice import Choice, L, R, ScriptedActor
+from stateright_tpu.actor.ordered_reliable_link import (
+    Ack,
+    Deliver,
+    LinkState,
+    NetworkTimer,
+    OrderedReliableLink,
+)
+from stateright_tpu.actor.register import Get, GetOk, Put, PutOk
+from stateright_tpu.actor.write_once_register import (
+    PutFail,
+    WORegisterClient,
+    record_invocations,
+    record_returns,
+)
+from stateright_tpu.model import Expectation
+from stateright_tpu.models.single_copy_register import SingleCopyActor
+from stateright_tpu.semantics import LinearizabilityTester
+from stateright_tpu.semantics.write_once_register import WORegister
+
+
+# -- ordered reliable link ----------------------------------------------
+
+
+class Sender(Actor):
+    """Sends the values 42, 43 at startup (through the link wrapper) —
+    the reference's ORL test fixture (ordered_reliable_link.rs:222-239)."""
+
+    def on_start(self, id: Id, out: Out) -> tuple:
+        out.send(Id(1), 42)
+        out.send(Id(1), 43)
+        return ()
+
+
+class Receiver(Actor):
+    """Records every delivered value in order."""
+
+    def on_start(self, id: Id, out: Out) -> tuple:
+        return ()
+
+    def on_msg(self, id: Id, state: Cow, src: Id, msg: Any, out: Out) -> None:
+        state.set(state.value + (msg,))
+
+
+def orl_model() -> ActorModel:
+    """Mirror of the reference's ORL model (ordered_reliable_link.rs:
+    252-281): lossy duplicating network, boundary |network| < 4."""
+    model = ActorModel()
+    model.actor(OrderedReliableLink(Sender()))
+    model.actor(OrderedReliableLink(Receiver()))
+    model.init_network(Network.new_unordered_duplicating())
+    model.set_lossy_network(True)
+
+    def received(state) -> tuple:
+        return state.actor_states[1].wrapped_state
+
+    model.property(
+        Expectation.ALWAYS,
+        "no redelivery",
+        lambda m, s: received(s).count(42) < 2 and received(s).count(43) < 2,
+    )
+    model.property(
+        Expectation.ALWAYS,
+        "ordered",
+        lambda m, s: list(received(s)) == sorted(received(s)),
+    )
+    model.property(
+        Expectation.SOMETIMES,
+        "delivered",
+        lambda m, s: received(s) == (42, 43),
+    )
+    model.within_boundary_fn(lambda cfg, s: len(s.network) < 4)
+    return model
+
+
+def test_orl_no_redelivery_and_ordered_over_lossy_duplicating():
+    """The reference ORL guarantee (ordered_reliable_link.rs:283-300):
+    at-most-once delivery in non-decreasing order, with full delivery
+    reachable, over a lossy duplicating network with resends."""
+    checker = orl_model().checker().spawn_bfs().join()
+    checker.assert_no_discovery("no redelivery")
+    checker.assert_no_discovery("ordered")
+    checker.assert_any_discovery("delivered")
+
+
+def test_orl_resend_timer_repopulates_lost_messages():
+    """After a Drop, firing the network timer restores the envelope."""
+    model = orl_model()
+    init = list(model.init_states())[0]
+    # Sender's pending-ack map holds both messages until acked.
+    sender: LinkState = init.actor_states[0]
+    assert sorted(sender.msgs_pending_ack.keys()) == [1, 2]
+    assert sender.next_send_seq == 3
+
+
+def test_orl_acks_clear_pending():
+    model = orl_model()
+    checker = model.checker().spawn_bfs().join()
+    path = checker.assert_any_discovery("delivered")
+    final = path.last_state()
+    assert final.actor_states[1].wrapped_state == (42, 43)
+
+
+# -- heterogeneous actors (Choice / scripted) ----------------------------
+
+
+def test_scripted_client_drives_server():
+    """A ScriptedActor (actor.rs:515-549) drives a SingleCopyActor."""
+    model = ActorModel()
+    model.actor(SingleCopyActor())
+    model.actor(
+        ScriptedActor([(Id(0), Put(1, "X")), (Id(0), Get(2))])
+    )
+    model.init_network(Network.new_unordered_nonduplicating())
+    model.property(
+        Expectation.SOMETIMES,
+        "read returns X",
+        lambda m, s: any(
+            isinstance(env.msg, GetOk) and env.msg.value == "X"
+            for env in s.network.iter_deliverable()
+        ),
+    )
+    checker = model.checker().spawn_bfs().join()
+    checker.assert_properties()
+
+
+def test_choice_tags_states_disjointly():
+    """Choice keeps two actor kinds' states type-disjoint
+    (actor.rs:343-497)."""
+    model = ActorModel()
+    model.actor(Choice.left(SingleCopyActor()))
+    model.actor(
+        Choice.right_of(ScriptedActor([(Id(0), Put(1, "V"))]))
+    )
+    model.init_network(Network.new_unordered_nonduplicating())
+    model.property(
+        Expectation.SOMETIMES,
+        "write acknowledged",
+        lambda m, s: any(
+            isinstance(env.msg, PutOk)
+            for env in s.network.iter_deliverable()
+        ),
+    )
+    checker = model.checker().spawn_bfs().join()
+    checker.assert_properties()
+    init = list(model.init_states())[0]
+    assert isinstance(init.actor_states[0], L)
+    assert isinstance(init.actor_states[1], R)
+
+
+# -- write-once register -------------------------------------------------
+
+
+class WOServer(Actor):
+    """Minimal write-once server: first Put wins, later Puts fail."""
+
+    def on_start(self, id: Id, out: Out):
+        return None  # unwritten
+
+    def on_msg(self, id: Id, state: Cow, src: Id, msg: Any, out: Out) -> None:
+        if isinstance(msg, Put):
+            if state.value is None:
+                state.set(msg.value)
+                out.send(src, PutOk(msg.req_id))
+            else:
+                out.send(src, PutFail(msg.req_id))
+        elif isinstance(msg, Get):
+            out.send(src, GetOk(msg.req_id, state.value))
+
+
+def wo_model() -> ActorModel:
+    model = ActorModel(
+        init_history=LinearizabilityTester(WORegister())
+    )
+    model.actor(WOServer())
+    model.add_actors(
+        WORegisterClient(put_count=1, server_count=1) for _ in range(2)
+    )
+    model.init_network(Network.new_unordered_nonduplicating())
+    model.property(
+        Expectation.ALWAYS,
+        "linearizable",
+        lambda m, s: s.history.serialized_history() is not None,
+    )
+    model.property(
+        Expectation.SOMETIMES,
+        "a write fails",
+        lambda m, s: any(
+            isinstance(env.msg, PutFail)
+            for env in s.network.iter_deliverable()
+        ),
+    )
+    model.record_msg_in(record_returns)
+    model.record_msg_out(record_invocations)
+    return model
+
+
+def test_wo_register_linearizable_and_second_write_fails():
+    """Two clients racing to write a write-once register: histories
+    stay linearizable against WORegister semantics, and some
+    interleaving rejects the second write."""
+    checker = wo_model().checker().spawn_bfs().join()
+    checker.assert_properties()
+
+
+def test_wo_register_counts_stable():
+    c1 = wo_model().checker().spawn_bfs().join()
+    c2 = wo_model().checker().spawn_dfs().join()
+    assert c1.unique_state_count() == c2.unique_state_count()
+    assert sorted(c1.discoveries()) == sorted(c2.discoveries())

@@ -1,0 +1,117 @@
+"""The UDP actor runtime: the same actor classes the checker verified,
+executed over real loopback sockets (spawn.rs:64-224 counterpart).
+
+Ports are picked per-test from the ephemeral range to avoid clashes.
+"""
+
+import json
+import socket
+import time
+
+import pytest
+
+from stateright_tpu.actor import Id
+from stateright_tpu.actor.register import Get, GetOk, Put, PutOk
+from stateright_tpu.actor.spawn import (
+    json_serde,
+    register_msg_types,
+    spawn,
+    spawn_paxos_cluster,
+)
+from stateright_tpu.models.ping_pong import Ping, PingPongActor, Pong
+
+
+def _free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket(socket.SOCK_DGRAM and socket.AF_INET, socket.SOCK_DGRAM)
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _await(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_json_serde_round_trip():
+    serialize, deserialize = json_serde(register_msg_types())
+    from stateright_tpu.models.paxos import Prepared
+    from stateright_tpu.actor.register import Internal
+
+    for msg in [
+        Put(1, "X"),
+        Get(2),
+        PutOk(1),
+        GetOk(2, "X"),
+        Internal(Prepared((1, Id(0)), ((1, Id(0)), (3, Id(3), "A")))),
+    ]:
+        out = deserialize(serialize(msg))
+        assert out == msg or (
+            # Ids decode as plain ints — structurally equal.
+            json.loads(serialize(out)) == json.loads(serialize(msg))
+        )
+
+
+def test_ping_pong_over_udp():
+    """The model-checked PingPongActor volleys over real sockets."""
+    p0, p1 = _free_ports(2)
+    id0 = Id.from_addr("127.0.0.1", p0)
+    id1 = Id.from_addr("127.0.0.1", p1)
+    serialize, deserialize = json_serde([Ping, Pong])
+    handles = spawn(
+        serialize,
+        deserialize,
+        [(id0, PingPongActor(serve_to=id1)), (id1, PingPongActor(None))],
+    )
+    try:
+        assert _await(lambda: all(h.state and h.state >= 5 for h in handles))
+    finally:
+        for h in handles:
+            h.stop()
+        for h in handles:
+            h.join(2)
+
+
+def test_paxos_cluster_put_get_round_trip():
+    """3 real PaxosActor servers decide a value and serve reads —
+    driven by a raw UDP client, like the reference's `nc` workflow
+    (examples/paxos.rs:403-419)."""
+    base = _free_ports(4)
+    # The cluster helper requires 3 consecutive ports; find a run.
+    for attempt in range(20):
+        probe = _free_ports(1)[0]
+        try:
+            handles = spawn_paxos_cluster(base_port=probe, block=False)
+            break
+        except OSError:
+            continue
+    else:
+        pytest.skip("no 3 consecutive free ports")
+    serialize, deserialize = json_serde(register_msg_types())
+    client = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    client.bind(("127.0.0.1", 0))
+    client.settimeout(8.0)
+    try:
+        client.sendto(serialize(Put(42, "X")), ("127.0.0.1", probe))
+        data, _ = client.recvfrom(65507)
+        reply = deserialize(data)
+        assert reply == PutOk(42), reply
+        client.sendto(serialize(Get(43)), ("127.0.0.1", probe))
+        data, _ = client.recvfrom(65507)
+        reply = deserialize(data)
+        assert reply == GetOk(43, "X"), reply
+    finally:
+        client.close()
+        for h in handles:
+            h.stop()
+        for h in handles:
+            h.join(2)

@@ -101,7 +101,7 @@ def test_tpu_2pc_matches_host_288_states():
     tpu = (
         TwoPhaseSys(rm_count=3)
         .checker()
-        .spawn_tpu(capacity=1 << 12)
+        .spawn_tpu(capacity=1 << 12, frontier_capacity=512, cand_capacity=1024)
         .join()
     )
     assert tpu.unique_state_count() == 288
@@ -112,7 +112,7 @@ def test_tpu_2pc_matches_host_288_states():
 
 
 def test_tpu_2pc_counterexample_paths_replay():
-    tpu = TwoPhaseSys(rm_count=3).checker().spawn_tpu(capacity=1 << 12).join()
+    tpu = TwoPhaseSys(rm_count=3).checker().spawn_tpu(capacity=1 << 12, frontier_capacity=512, cand_capacity=1024).join()
     for name, path in tpu.discoveries().items():
         # Replay through the host model: raises if encoding diverges.
         assert len(path) >= 1
@@ -124,7 +124,12 @@ def test_tpu_2pc_5rms_matches_host():
     tpu = (
         TwoPhaseSys(rm_count=5)
         .checker()
-        .spawn_tpu(capacity=1 << 15, frontier_capacity=1 << 12)
+        .spawn_tpu(
+            capacity=1 << 15,
+            frontier_capacity=1 << 11,
+            cand_capacity=1 << 14,
+            track_paths=False,
+        )
         .join()
     )
     assert tpu.unique_state_count() == 8832

@@ -77,7 +77,7 @@ def tpu_workloads(quick=False):
             return (
                 TwoPhaseSys(rm_count=rm)
                 .checker()
-                .spawn_tpu(track_paths=False, **kw)
+                .spawn_tpu_sortmerge(track_paths=False, **kw)
             )
 
         return spawn
@@ -91,7 +91,7 @@ def tpu_workloads(quick=False):
                     PaxosModelCfg(client_count=clients, server_count=3)
                 )
                 .checker()
-                .spawn_tpu(track_paths=False, **kw)
+                .spawn_tpu_sortmerge(track_paths=False, **kw)
             )
 
         return spawn
@@ -99,14 +99,19 @@ def tpu_workloads(quick=False):
     loads = [
         (
             "2pc rm=5",
-            twopc(5, capacity=1 << 15, frontier_capacity=1 << 12),
+            twopc(
+                5,
+                capacity=1 << 14,
+                frontier_capacity=1 << 11,
+                cand_capacity=1 << 14,
+            ),
             8832,
         ),
         (
             "paxos 2c/3s",
             paxos(
                 2,
-                capacity=1 << 16,
+                capacity=1 << 15,
                 frontier_capacity=1 << 12,
                 cand_capacity=1 << 14,
             ),
@@ -116,24 +121,34 @@ def tpu_workloads(quick=False):
             "2pc rm=6",
             twopc(
                 6,
-                capacity=1 << 17,
+                capacity=1 << 16,
                 frontier_capacity=1 << 14,
                 cand_capacity=1 << 16,
             ),
             50816,
         ),
+        (
+            "2pc rm=7",
+            twopc(
+                7,
+                capacity=1 << 19,
+                frontier_capacity=1 << 16,
+                cand_capacity=1 << 19,
+            ),
+            296448,
+        ),
     ]
     if not quick:
         loads.append(
             (
-                "2pc rm=7",
+                "2pc rm=8",
                 twopc(
-                    7,
-                    capacity=1 << 20,
-                    frontier_capacity=1 << 16,
-                    cand_capacity=1 << 18,
+                    8,
+                    capacity=1 << 21,
+                    frontier_capacity=1 << 19,
+                    cand_capacity=1 << 22,
                 ),
-                296448,
+                1745408,
             )
         )
     return loads

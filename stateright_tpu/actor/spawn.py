@@ -185,10 +185,11 @@ def _event_loop(handle: ActorHandle, sock, serialize, deserialize) -> None:
                 # BlockingIOError rather than socket.timeout.
                 data = None
                 now = time.monotonic()
-                for timer, when in timers.items():
-                    if when <= now:
-                        fired = timer
-                        break
+                due = [(when, t) for t, when in timers.items() if when <= now]
+                if due:
+                    # Earliest deadline first (spawn.rs services the
+                    # minimum deadline it waited on).
+                    fired = min(due, key=lambda d: d[0])[1]
             cow = Cow(state)
             out = Out()
             if data is not None:

@@ -110,6 +110,14 @@ class CheckerBuilder:
 
         return TpuBfsChecker(self, **kwargs)
 
+    def spawn_tpu_sortmerge(self, **kwargs) -> "Checker":
+        """Spawn the sort-merge wave engine: visited set as a sorted
+        fingerprint array merged per wave, no scatters in the hot loop
+        — the TPU-idiomatic dedup (see checkers/tpu_sortmerge.py)."""
+        from .checkers.tpu_sortmerge import SortMergeTpuBfsChecker
+
+        return SortMergeTpuBfsChecker(self, **kwargs)
+
     def spawn_tpu_sharded(self, **kwargs) -> "Checker":
         """Spawn the multi-chip wave engine: the frontier and visited
         set sharded over a ``jax.sharding.Mesh``, with per-wave

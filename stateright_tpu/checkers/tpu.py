@@ -47,7 +47,7 @@ from ..checker import Checker, CheckerBuilder
 from ..encoding import EncodedModel
 from ..model import Expectation
 from ..ops.fingerprint import fingerprint_u32v
-from ..ops.hashset import DeviceHashSet, insert, sort_unique
+from ..ops.hashset import DeviceHashSet, insert
 from ..ops.u64 import U64, u64_add
 from ..path import Path
 from ..report import ReportData, Reporter
@@ -349,9 +349,10 @@ class TpuBfsChecker(Checker):
             fval = jnp.arange(F) < n0
             ebits = jnp.where(fval, jnp.uint32(ebits_init), jnp.uint32(0))
             lo0, hi0 = fingerprint_u32v(init_rows, jnp)
-            (slo, shi, _), first = sort_unique(lo0, hi0, jnp)
             table = DeviceHashSet.empty(capacity, jnp)
-            table, _, pending, _ = insert(table, slo, shi, first, jnp)
+            table, _, pending, _ = insert(
+                table, lo0, hi0, jnp.ones(n0, dtype=bool), jnp
+            )
             return dict(
                 t_lo=table.lo,
                 t_hi=table.hi,
@@ -425,17 +426,15 @@ class TpuBfsChecker(Checker):
                 b_val = ex["v"]
                 c_overflow = c["c_overflow"]
             b_lo, b_hi = fingerprint_u32v(b_ext[:, :W], jnp)
-            b_lo = jnp.where(b_val, b_lo, jnp.uint32(_SENTINEL))
-            b_hi = jnp.where(b_val, b_hi, jnp.uint32(_SENTINEL))
 
-            # Dedup within the wave, then insert-if-absent.
-            (s_lo, s_hi, order), first = sort_unique(b_lo, b_hi, jnp)
-            active = first & b_val[order]
+            # Insert-if-absent; duplicate candidates within the wave
+            # resolve inside the probe loop (one winner per key), so no
+            # sort-unique pass is needed.
             table, is_new, pending, slots = insert(
-                table, s_lo, s_hi, active, jnp, rounds=probe_rounds
+                table, b_lo, b_hi, b_val, jnp, rounds=probe_rounds
             )
             overflow = c["overflow"] | jnp.any(pending)
-            s_ext = b_ext[order]
+            s_ext = b_ext
 
             if track_paths:
                 # Parent forest: write each new state's parent
@@ -636,17 +635,8 @@ class TpuBfsChecker(Checker):
                 )
             if bool(s[9]):
                 raise RuntimeError(self._cand_overflow_message())
-            if not done and self.metrics["occupancy"] > 0.7:
-                import warnings
-
-                warnings.warn(
-                    f"visited table {self.metrics['occupancy']:.0%} full "
-                    f"({self._unique_states}/{self.total_capacity}); "
-                    "probe failures become likely past ~85% — consider a "
-                    "larger capacity",
-                    RuntimeWarning,
-                    stacklevel=2,
-                )
+            if not done:
+                self._maybe_warn_occupancy(self.metrics["occupancy"])
             if done:
                 break
             if reporter is not None:
@@ -662,12 +652,7 @@ class TpuBfsChecker(Checker):
 
         # Keep device handles; download lazily only if a path is
         # reconstructed (_build_generated).
-        self._final_tables = (
-            carry["t_lo"],
-            carry["t_hi"],
-            carry["p_lo_t"],
-            carry["p_hi_t"],
-        )
+        self._capture_final(carry)
         disc_found = s[10 : 10 + n_props]
         disc_lo = s[10 + n_props : 10 + 2 * n_props]
         disc_hi = s[10 + 2 * n_props : 10 + 3 * n_props]
@@ -683,10 +668,35 @@ class TpuBfsChecker(Checker):
         """Hook for engine variants that append metric lanes after the
         per-property discovery lanes (see parallel/engine.py)."""
 
+    def _capture_final(self, carry) -> None:
+        """Stash device handles needed for lazy path reconstruction."""
+        self._final_tables = (
+            carry["t_lo"],
+            carry["t_hi"],
+            carry["p_lo_t"],
+            carry["p_hi_t"],
+        )
+
     def _cache_extras(self) -> tuple:
         """Engine-variant parameters that distinguish compiled programs
         (see the compiled-chunk cache in _run)."""
         return ()
+
+    def _maybe_warn_occupancy(self, occupancy: float) -> None:
+        """Open addressing degrades before it overflows; warn early.
+        (The sort-merge engine overrides this: its visited array is
+        exact-capacity with no probe pressure.)"""
+        if occupancy > 0.7:
+            import warnings
+
+            warnings.warn(
+                f"visited table {occupancy:.0%} full "
+                f"({self._unique_states}/{self.total_capacity}); "
+                "probe failures become likely past ~85% — consider a "
+                "larger capacity",
+                RuntimeWarning,
+                stacklevel=3,
+            )
 
     def _cand_overflow_message(self) -> str:
         return (

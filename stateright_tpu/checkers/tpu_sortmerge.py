@@ -1,0 +1,379 @@
+"""The sort-merge wave engine: dedup without scatters.
+
+TPU microbenchmarks (v5e, this repo's stage ablation) show the hash
+table engine's cost profile is inverted on TPU hardware: arbitrary-
+index scatter/gather — the heart of GPU-style open-addressing
+(ops/hashset.py) — runs at ~2M rows per 100ms, while ``lax.sort``
+moves 2M 2-lane rows in 1.8ms. XLA:TPU lowers scatters to serialized
+updates; sorts are native and fast. So this engine re-architects the
+wave around sorts, the classic vector-machine model-checking layout:
+
+* The visited set is a **sorted fingerprint array** (two uint32 limb
+  lanes, all-ones sentinel padding), not a hash table.
+* Per wave: fingerprint all padded candidates (elementwise) →
+  **sort#1** ``(hi, lo, row)`` compacts valid candidates to the B
+  lowest keys (invalid rows carry sentinel keys and sort last) → one
+  B-row payload gather → **sort#2** merges candidate keys with the
+  visited array (stable, visited first, so first-of-run marks the
+  winner and intra-wave duplicates resolve for free) → **sort#3**
+  rebuilds the deduplicated visited array (losers sentinelized, slice
+  back to capacity) → **sort#4** compacts the new states' positions
+  for the next frontier, followed by small F-row gathers.
+* The parent forest is an **append-only device log** of
+  (child, parent) fingerprint pairs written with
+  ``dynamic_update_slice`` — contiguous writes, no scatter — drained
+  lazily on the host only when a counterexample path is reconstructed.
+
+Everything else — the device-resident multi-wave ``lax.while_loop``,
+packed-stats chunk sync, properties/EventuallyBits/discovery logic —
+is shared with :mod:`stateright_tpu.checkers.tpu`.
+
+Measured (2pc rm=7, 296,448 states, warm, one v5e chip): the hash
+table engine runs ~390ms/wave; this engine's stage budget is ~20ms/wave
+(see bench.py for recorded end-to-end numbers).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..model import Expectation
+from ..ops.fingerprint import fingerprint_u32v
+from ..ops.u64 import U64, u64_add
+from .tpu import (
+    TpuBfsChecker,
+    discovery_update,
+    expand_frontier,
+)
+
+_SENT = 0xFFFFFFFF
+
+
+class SortMergeTpuBfsChecker(TpuBfsChecker):
+    """``CheckerBuilder.spawn_tpu_sortmerge()``.
+
+    ``capacity`` is the visited-array length — unlike the hash table
+    there is no load-factor pressure: it can sit at exactly the
+    expected unique-state count (overflow is detected, not silent).
+    """
+
+    def _cache_extras(self) -> tuple:
+        return ("sortmerge",)
+
+    def _maybe_warn_occupancy(self, occupancy: float) -> None:
+        """No probe pressure: the sorted array works at 100% occupancy
+        and overflow is detected exactly — nothing to warn about."""
+
+    def _cand_overflow_message(self) -> str:
+        return (
+            "candidate-buffer overflow: a wave generated more than "
+            f"{self.cand_capacity or self.frontier_capacity * self.encoded.max_actions} "
+            "valid successors; re-run with a larger cand_capacity"
+        )
+
+    # -- device programs ---------------------------------------------------
+
+    def _build_programs(self, n0: int):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        enc = self.encoded
+        props = list(self.model.properties())
+        n_props = len(props)
+        evt_idx = [
+            i for i, p in enumerate(props)
+            if p.expectation == Expectation.EVENTUALLY
+        ]
+        if evt_idx and max(evt_idx) >= 32:
+            raise ValueError(
+                "the TPU engine supports eventually properties only at "
+                "property indices < 32; reorder properties() so eventually "
+                f"properties come first (got index {max(evt_idx)})"
+            )
+        K, W, F = enc.max_actions, enc.width, self.frontier_capacity
+        C = self.capacity
+        B = min(self.cand_capacity or F * K, F * K)
+        target_states = self.builder._target_state_count
+        target_depth = self.builder._target_max_depth
+        waves_per_sync = self.waves_per_sync
+        ebits_init = self._eventually_bits_init()
+        track_paths = self.track_paths
+        # Parent log rows: every unique state (≤ C) gets one entry;
+        # the F-row block write at a dynamic offset needs headroom.
+        L = C + F if track_paths else 0
+
+        def clamp_keys(lo, hi):
+            # All-ones is the visited-array padding sentinel; nudge
+            # real fingerprints off it (mirrors the NonZero convention
+            # at the other end of the range, ops/fingerprint.py).
+            both = (lo == jnp.uint32(_SENT)) & (hi == jnp.uint32(_SENT))
+            return lo, jnp.where(both, jnp.uint32(_SENT - 1), hi)
+
+        def seed(init_rows):
+            lo0, hi0 = fingerprint_u32v(init_rows, jnp)
+            lo0, hi0 = clamp_keys(lo0, hi0)
+            # Visited array: init keys sorted, sentinel padding.
+            v_hi = jnp.full(C, _SENT, jnp.uint32).at[:n0].set(hi0)
+            v_lo = jnp.full(C, _SENT, jnp.uint32).at[:n0].set(lo0)
+            v_hi, v_lo = lax.sort((v_hi, v_lo), num_keys=2)
+            frontier = jnp.zeros((F, W), dtype=jnp.uint32).at[:n0].set(
+                init_rows
+            )
+            fval = jnp.arange(F) < n0
+            ebits = jnp.where(fval, jnp.uint32(ebits_init), jnp.uint32(0))
+            return dict(
+                v_lo=v_lo,
+                v_hi=v_hi,
+                pl_child_lo=jnp.zeros(L, jnp.uint32),
+                pl_child_hi=jnp.zeros(L, jnp.uint32),
+                pl_par_lo=jnp.zeros(L, jnp.uint32),
+                pl_par_hi=jnp.zeros(L, jnp.uint32),
+                pl_n=jnp.uint32(0),
+                frontier=frontier,
+                fval=fval,
+                ebits=ebits,
+                depth=jnp.int32(1),
+                wchunk=jnp.int32(0),
+                waves=jnp.uint32(0),
+                gen_lo=jnp.uint32(n0),
+                gen_hi=jnp.uint32(0),
+                new=jnp.uint32(n0),
+                disc_found=jnp.zeros(n_props, dtype=bool),
+                disc_lo=jnp.zeros(n_props, dtype=jnp.uint32),
+                disc_hi=jnp.zeros(n_props, dtype=jnp.uint32),
+                overflow=jnp.bool_(n0 > C),
+                f_overflow=jnp.bool_(False),
+                c_overflow=jnp.bool_(False),
+                done=jnp.bool_(n0 == 0),
+            )
+
+        def body(c):
+            ebits = c["ebits"]
+            fval = c["fval"]
+            if target_depth is None:
+                expand = jnp.bool_(True)
+            else:
+                expand = c["depth"] < target_depth
+
+            ex = expand_frontier(
+                enc, props, evt_idx, c["frontier"], fval, ebits, expand
+            )
+            disc_found, disc_lo, disc_hi = discovery_update(
+                props, ex, fval, c["disc_found"], c["disc_lo"], c["disc_hi"]
+            )
+
+            # Fingerprint every padded candidate (elementwise, cheap);
+            # invalid rows get the sentinel key so they sort last.
+            flat, valid = ex["flat"], ex["v"]
+            k_lo, k_hi = fingerprint_u32v(flat, jnp)
+            k_lo, k_hi = clamp_keys(k_lo, k_hi)
+            k_lo = jnp.where(valid, k_lo, jnp.uint32(_SENT))
+            k_hi = jnp.where(valid, k_hi, jnp.uint32(_SENT))
+            n_cand = jnp.sum(valid)
+            c_overflow = c["c_overflow"] | (n_cand > B)
+
+            # Sort#1: candidates by key, carrying the flat row index —
+            # the B lowest keys are exactly the valid ones (plus
+            # sentinels if fewer). No scatter anywhere.
+            rows = jnp.arange(F * K, dtype=jnp.uint32)
+            s_hi, s_lo, s_row = lax.sort((k_hi, k_lo, rows), num_keys=2)
+            s_hi, s_lo, s_row = s_hi[:B], s_lo[:B], s_row[:B]
+
+            # One payload gather for candidate states; parent
+            # fingerprints and inherited ebits live in F-sized arrays
+            # (row // K), so those gathers are small.
+            b_state = flat[s_row]
+            b_parent_row = s_row // jnp.uint32(K)
+            b_par_lo = ex["f_lo"][b_parent_row]
+            b_par_hi = ex["f_hi"][b_parent_row]
+            b_ebits = ex["ebits"][b_parent_row]
+
+            # Sort#2: merge with the visited array. Stable sort with
+            # the visited keys FIRST in the concatenation means the
+            # first element of every equal-key run is the visited
+            # entry when present — so is_new is first-of-run AND
+            # from-candidates, and intra-wave duplicates resolve to
+            # one winner for free.
+            m_hi = jnp.concatenate([c["v_hi"], s_hi])
+            m_lo = jnp.concatenate([c["v_lo"], s_lo])
+            m_pos = jnp.concatenate(
+                [
+                    jnp.zeros(C, jnp.uint32),
+                    jnp.arange(1, B + 1, dtype=jnp.uint32),
+                ]
+            )
+            m_hi, m_lo, m_pos = lax.sort((m_hi, m_lo, m_pos), num_keys=2)
+            real = ~((m_hi == jnp.uint32(_SENT)) & (m_lo == jnp.uint32(_SENT)))
+            prev_same = jnp.concatenate(
+                [
+                    jnp.zeros(1, bool),
+                    (m_hi[1:] == m_hi[:-1]) & (m_lo[1:] == m_lo[:-1]),
+                ]
+            )
+            is_new = real & ~prev_same & (m_pos > 0)
+            new_count = jnp.sum(is_new)
+
+            # Sort#3: rebuild the visited array — duplicate-run losers
+            # become sentinels, then the C lowest keys are the new set.
+            # Overflow iff a real key lands beyond capacity.
+            u_hi = jnp.where(prev_same, jnp.uint32(_SENT), m_hi)
+            u_lo = jnp.where(prev_same, jnp.uint32(_SENT), m_lo)
+            u_hi, u_lo = lax.sort((u_hi, u_lo), num_keys=2)
+            overflow = c["overflow"] | ~(
+                (u_hi[C] == jnp.uint32(_SENT)) & (u_lo[C] == jnp.uint32(_SENT))
+            )
+            v_hi, v_lo = u_hi[:C], u_lo[:C]
+
+            # Sort#4: compact the new states' candidate positions into
+            # the next frontier (new rows first, in candidate order).
+            nf_pos = jnp.where(is_new, m_pos, jnp.uint32(_SENT))
+            (nf_pos,) = lax.sort((nf_pos,), num_keys=1)
+            nf_pos = nf_pos[:F]
+            nf_valid = jnp.arange(F) < new_count
+            f_overflow = c["f_overflow"] | (new_count > F)
+            nf_row = jnp.where(nf_valid, nf_pos - 1, jnp.uint32(0))
+            next_frontier = b_state[nf_row]
+            next_ebits = jnp.where(nf_valid, b_ebits[nf_row], 0)
+
+            # Parent-log append: contiguous block write at the running
+            # offset (no scatter); rows past new_count are garbage that
+            # the next wave's block overwrites.
+            if track_paths:
+                nc_lo = jnp.where(nf_valid, s_lo[nf_row], 0)
+                nc_hi = jnp.where(nf_valid, s_hi[nf_row], 0)
+                np_lo = jnp.where(nf_valid, b_par_lo[nf_row], 0)
+                np_hi = jnp.where(nf_valid, b_par_hi[nf_row], 0)
+                off = (c["pl_n"],)
+                pl_child_lo = lax.dynamic_update_slice(
+                    c["pl_child_lo"], nc_lo, off
+                )
+                pl_child_hi = lax.dynamic_update_slice(
+                    c["pl_child_hi"], nc_hi, off
+                )
+                pl_par_lo = lax.dynamic_update_slice(
+                    c["pl_par_lo"], np_lo, off
+                )
+                pl_par_hi = lax.dynamic_update_slice(
+                    c["pl_par_hi"], np_hi, off
+                )
+                pl_n = c["pl_n"] + new_count.astype(jnp.uint32)
+            else:
+                pl_child_lo = c["pl_child_lo"]
+                pl_child_hi = c["pl_child_hi"]
+                pl_par_lo = c["pl_par_lo"]
+                pl_par_hi = c["pl_par_hi"]
+                pl_n = c["pl_n"]
+
+            g = u64_add(
+                U64(c["gen_lo"], c["gen_hi"]),
+                U64(n_cand.astype(jnp.uint32), jnp.uint32(0)),
+            )
+            new = c["new"] + new_count.astype(jnp.uint32)
+            all_disc = (
+                jnp.all(disc_found) if n_props else jnp.bool_(False)
+            )
+            if target_states is None:
+                target_hit = jnp.bool_(False)
+            else:
+                target_hit = new >= jnp.uint32(target_states)
+            cont = (
+                (new_count > 0)
+                & ~all_disc
+                & ~target_hit
+                & ~overflow
+                & ~f_overflow
+                & ~c_overflow
+            )
+            return dict(
+                v_lo=v_lo,
+                v_hi=v_hi,
+                pl_child_lo=pl_child_lo,
+                pl_child_hi=pl_child_hi,
+                pl_par_lo=pl_par_lo,
+                pl_par_hi=pl_par_hi,
+                pl_n=pl_n,
+                frontier=next_frontier,
+                fval=nf_valid & cont,
+                ebits=next_ebits,
+                depth=jnp.where(cont, c["depth"] + 1, c["depth"]),
+                wchunk=c["wchunk"] + 1,
+                waves=c["waves"] + 1,
+                gen_lo=g.lo,
+                gen_hi=g.hi,
+                new=new,
+                disc_found=disc_found,
+                disc_lo=disc_lo,
+                disc_hi=disc_hi,
+                overflow=overflow,
+                f_overflow=f_overflow,
+                c_overflow=c_overflow,
+                done=~cont,
+            )
+
+        def cond(c):
+            return ~c["done"] & (c["wchunk"] < waves_per_sync)
+
+        def chunk(carry):
+            c = dict(carry, wchunk=jnp.int32(0))
+            c = lax.while_loop(cond, body, c)
+            scalars = jnp.stack(
+                [
+                    c["done"].astype(jnp.uint32),
+                    c["overflow"].astype(jnp.uint32),
+                    c["f_overflow"].astype(jnp.uint32),
+                    c["depth"].astype(jnp.uint32),
+                    c["waves"],
+                    jnp.sum(c["fval"]).astype(jnp.uint32),
+                    c["gen_lo"],
+                    c["gen_hi"],
+                    c["new"],
+                    c["c_overflow"].astype(jnp.uint32),
+                ]
+            )
+            stats = jnp.concatenate(
+                [
+                    scalars,
+                    c["disc_found"].astype(jnp.uint32),
+                    c["disc_lo"],
+                    c["disc_hi"],
+                ]
+            )
+            return c, stats
+
+        import jax
+
+        return jax.jit(seed), jax.jit(chunk, donate_argnums=0)
+
+    # -- reconstruction ----------------------------------------------------
+
+    def _capture_final(self, carry) -> None:
+        self._final_tables = (
+            carry["pl_child_lo"],
+            carry["pl_child_hi"],
+            carry["pl_par_lo"],
+            carry["pl_par_hi"],
+            carry["pl_n"],
+        )
+
+    def _build_generated(self):
+        """Materialize child→parent from the append-only device log
+        (the lazy download; roots are simply absent from the log)."""
+        if self.generated is None:
+            c_lo, c_hi, p_lo, p_hi, pl_n = (
+                np.asarray(a) for a in self._final_tables
+            )
+            n = int(pl_n)
+            child = (
+                c_hi[:n].astype(np.uint64) << np.uint64(32)
+            ) | c_lo[:n].astype(np.uint64)
+            parent = (
+                p_hi[:n].astype(np.uint64) << np.uint64(32)
+            ) | p_lo[:n].astype(np.uint64)
+            self.generated = {
+                int(c): (int(p) if p else None)
+                for c, p in zip(child.tolist(), parent.tolist())
+            }
+        return self.generated

@@ -5,15 +5,19 @@ The TPU counterpart of the reference BFS's sharded concurrent
 table of 64-bit fingerprints stored as two ``uint32`` arrays (0,0 =
 empty — fingerprints are never zero), with batched insert-if-absent.
 
-Batched insertion resolves conflicts without atomics:
+Batched insertion resolves conflicts — including DUPLICATE keys within
+one batch — without atomics: each still-active key reads its slot; on
+empty it *claims* via ``scatter-max`` of its row index into a claim
+array, then re-reads to learn the winner; a claim loser re-reads
+before moving (the winner may hold its own key), and occupied-by-other
+keys advance their private triangular probe sequence. This is the
+classic GPU model-checker table insert (cf. GPUexplore), expressed as
+XLA scatter/gather. (:func:`sort_unique` remains available for callers
+that want an explicit pre-dedup pass.)
 
-1. The caller pre-deduplicates the batch (sort + neighbor-compare, see
-   :func:`sort_unique`), so all competing keys are distinct.
-2. K probe rounds: each still-active key reads its slot; on empty it
-   *claims* via ``scatter-max`` of its row index into a claim array,
-   then re-reads to learn the winner; losers and occupied-by-other
-   keys re-probe triangularly. This is the classic GPU model-checker
-   table insert (cf. GPUexplore), expressed as XLA scatter/gather.
+NOTE: on TPU hardware, XLA lowers these scatters poorly (~50x slower
+than sorts at equal row counts — see checkers/tpu_sortmerge.py, which
+is the TPU-preferred dedup built on sorts instead).
 
 Everything is functional: ``insert`` returns the new table arrays.
 The probe loop is a static Python loop (PROBE_ROUNDS is small) so XLA
@@ -92,7 +96,7 @@ def insert(
     xp,
     rounds: int = PROBE_ROUNDS,
 ) -> Tuple[DeviceHashSet, Any, Any, Any]:
-    """Insert distinct keys where ``active``; return
+    """Insert keys where ``active``; return
     ``(new_table, is_new, overflow, slot)``.
 
     ``is_new[i]`` — key i was inserted (absent before); ``overflow[i]``
@@ -102,8 +106,13 @@ def insert(
     keep side tables indexed by table position — the engine stores the
     parent fingerprint of each visited state this way, so the whole
     parent forest stays device-resident (bfs.rs:28-29 equivalent).
-    Keys in the batch MUST be distinct where active (use
-    :func:`sort_unique` first); inactive rows are ignored.
+
+    The batch may contain DUPLICATE keys: every row keeps its own
+    probe position along the deterministic triangular sequence for its
+    key, and a row that loses a claim race re-reads its slot before
+    moving on — so of N rows with one key, exactly one reports
+    ``is_new`` (if absent) and the rest find the winner's entry. This
+    is what lets the engines skip a whole sort-unique pass per wave.
     """
     if xp.__name__.startswith("jax"):
         return _insert_jax(table, key_lo, key_hi, active, rounds)
@@ -111,13 +120,14 @@ def insert(
     mask = xp.uint32(table.capacity - 1)
     row_ids = xp.arange(n, dtype=xp.uint32)
     idx = _slot_hash(key_lo, key_hi, mask, xp)
+    probe = xp.zeros(n, dtype=xp.uint32)
     lo, hi = table.lo, table.hi
     lo, hi = lo.copy(), hi.copy()  # keep numpy path functional too
     inserted = xp.zeros(n, dtype=bool)
     found = xp.zeros(n, dtype=bool)
     slot = xp.zeros(n, dtype=xp.uint32)
     pending = active
-    for r in range(rounds):
+    for _ in range(rounds):
         if not pending.any():
             break
         slot_lo = lo[idx]
@@ -142,8 +152,13 @@ def insert(
         inserted = inserted | won
         slot = xp.where(won, idx, slot)
         pending = pending & ~won
-        # Triangular re-probe for losers/occupied.
-        idx = (idx + xp.uint32(r + 1)) & mask
+        # Advance only rows that saw a different key; claim losers
+        # re-read (the winner may hold their own key). Each row steps
+        # its own triangular sequence so a key's probe path never
+        # depends on batch contention.
+        advance = pending & ~is_empty & ~is_match
+        probe = xp.where(advance, probe + 1, probe)
+        idx = xp.where(advance, (idx + probe) & mask, idx)
     return DeviceHashSet(lo, hi), inserted, pending, slot
 
 
@@ -210,12 +225,21 @@ def _insert_jax(
         write_idx = jnp.where(won, idx, jnp.uint32(cap))
         lo = lo.at[write_idx].set(key_lo, mode="drop")
         hi = hi.at[write_idx].set(key_hi, mode="drop")
+        # Advance only rows that saw a DIFFERENT key; claim losers
+        # re-read their slot next round (the winner may hold their own
+        # key — that's how duplicate keys within a batch resolve).
+        # Per-row probe counters keep each key's triangular sequence
+        # deterministic regardless of contention, so later inserts and
+        # contains() retrace the same path.
+        pending = pending & ~won
+        advance = pending & ~is_empty & ~is_match
+        probe = jnp.where(advance, c["probe"] + 1, c["probe"])
         return dict(
             lo=lo,
             hi=hi,
-            # Triangular re-probe for losers/occupied.
-            idx=(idx + c["r"].astype(jnp.uint32) + 1) & mask,
-            pending=pending & ~won,
+            idx=jnp.where(advance, (idx + probe) & mask, idx),
+            probe=probe,
+            pending=pending,
             inserted=c["inserted"] | won,
             slot=jnp.where(won, idx, slot),
             r=c["r"] + 1,
@@ -225,6 +249,7 @@ def _insert_jax(
         lo=table.lo,
         hi=table.hi,
         idx=_slot_hash(key_lo, key_hi, mask, jnp),
+        probe=jnp.zeros(n, dtype=jnp.uint32),
         pending=active,
         inserted=jnp.zeros(n, dtype=bool),
         slot=jnp.zeros(n, dtype=jnp.uint32),

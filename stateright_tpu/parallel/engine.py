@@ -41,10 +41,9 @@ from ..checker import CheckerBuilder
 from ..encoding import EncodedModel
 from ..model import Expectation
 from ..ops.fingerprint import fingerprint_u32v
-from ..ops.hashset import DeviceHashSet, insert, sort_unique
+from ..ops.hashset import DeviceHashSet, insert
 from ..ops.u64 import U64, u64_add
 from ..checkers.tpu import (
-    _SENTINEL,
     TpuBfsChecker,
     expand_frontier,
     wave_hits,
@@ -190,12 +189,8 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             n_mine = jnp.sum(mine)
             fval = jnp.arange(F) < n_mine
             ebits = jnp.where(fval, jnp.uint32(ebits_init), jnp.uint32(0))
-            klo = jnp.where(mine, lo0, jnp.uint32(_SENTINEL))
-            khi = jnp.where(mine, hi0, jnp.uint32(_SENTINEL))
-            (s_lo, s_hi, order), first = sort_unique(klo, khi, jnp)
-            active = first & mine[order]
             table = DeviceHashSet.empty(capacity, jnp)
-            table, _, pending, _ = insert(table, s_lo, s_hi, active, jnp)
+            table, _, pending, _ = insert(table, lo0, hi0, mine, jnp)
             overflow = bool_any(jnp.any(pending))
             return dict(
                 t_lo=table.lo,
@@ -310,20 +305,18 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                 send, "shard", split_axis=0, concat_axis=0, tiled=True
             )
 
-            # Owner-local dedup + insert (bfs.rs:292-306 semantics,
-            # now with zero cross-shard contention by construction).
+            # Owner-local insert-if-absent (bfs.rs:292-306 semantics,
+            # with zero cross-shard contention by construction);
+            # duplicate keys in the received batch resolve inside the
+            # probe loop, so no sort-unique pass is needed.
             r_lo = recv[:, E]
             r_hi = recv[:, E + 1]
             r_val = (r_lo != 0) | (r_hi != 0)
-            klo = jnp.where(r_val, r_lo, jnp.uint32(_SENTINEL))
-            khi = jnp.where(r_val, r_hi, jnp.uint32(_SENTINEL))
-            (s_lo, s_hi, order), first = sort_unique(klo, khi, jnp)
-            active = first & r_val[order]
             table, is_new, pending, slots = insert(
-                table, s_lo, s_hi, active, jnp, rounds=probe_rounds
+                table, r_lo, r_hi, r_val, jnp, rounds=probe_rounds
             )
             overflow = c["overflow"] | bool_any(jnp.any(pending))
-            s_ext = recv[order]
+            s_ext = recv
 
             if track_paths:
                 par_idx = jnp.where(is_new, slots, jnp.uint32(capacity))

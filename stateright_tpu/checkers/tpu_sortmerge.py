@@ -35,8 +35,6 @@ table engine runs ~390ms/wave; this engine's stage budget is ~20ms/wave
 
 from __future__ import annotations
 
-from typing import Optional
-
 import numpy as np
 
 from ..model import Expectation
@@ -342,8 +340,6 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 ]
             )
             return c, stats
-
-        import jax
 
         return jax.jit(seed), jax.jit(chunk, donate_argnums=0)
 

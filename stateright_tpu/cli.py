@@ -108,7 +108,15 @@ def _paxos(sub: str, args: list[str]) -> None:
             f"Model checking Single Decree Paxos with {client_count} "
             "clients on the TPU wave engine."
         )
-        _report(paxos_model(cfg).checker().spawn_tpu())
+        _report(
+            paxos_model(cfg)
+            .checker()
+            .spawn_tpu_sortmerge(
+                capacity=1 << 15,
+                frontier_capacity=1 << 12,
+                cand_capacity=1 << 14,
+            )
+        )
     elif sub == "explore":
         address = _opt(args, 1, "localhost:3000", parse=str)
         network = _network(args, 2)
@@ -133,6 +141,16 @@ def _increment(sub: str, args: list[str]) -> None:
     if sub == "check":
         print(f"Model checking increment with {thread_count} threads.")
         _report(model.checker().spawn_dfs())
+    elif sub == "check-tpu":
+        print(
+            f"Model checking increment with {thread_count} threads "
+            "on the TPU wave engine."
+        )
+        _report(
+            model.checker().spawn_tpu_sortmerge(
+                capacity=1 << 12, frontier_capacity=256, cand_capacity=1024
+            )
+        )
     elif sub == "check-sym":
         print(
             f"Model checking increment with {thread_count} threads "
@@ -153,6 +171,16 @@ def _increment_lock(sub: str, args: list[str]) -> None:
     if sub == "check":
         print(f"Model checking increment_lock with {thread_count} threads.")
         _report(model.checker().spawn_dfs())
+    elif sub == "check-tpu":
+        print(
+            f"Model checking increment_lock with {thread_count} threads "
+            "on the TPU wave engine."
+        )
+        _report(
+            model.checker().spawn_tpu_sortmerge(
+                capacity=1 << 12, frontier_capacity=256, cand_capacity=1024
+            )
+        )
     elif sub == "check-sym":
         print(
             f"Model checking increment_lock with {thread_count} threads "
@@ -180,6 +208,18 @@ def _single_copy(sub: str, args: list[str]) -> None:
             "clients."
         )
         _report(single_copy_register_model(cfg, network).checker().spawn_dfs())
+    elif sub == "check-tpu":
+        print(
+            f"Model checking a single-copy register with {client_count} "
+            "clients on the TPU wave engine."
+        )
+        _report(
+            single_copy_register_model(cfg)
+            .checker()
+            .spawn_tpu_sortmerge(
+                capacity=256, frontier_capacity=64, cand_capacity=256
+            )
+        )
     elif sub == "explore":
         address = _opt(args, 1, "localhost:3000", parse=str)
         network = _network(args, 2)
@@ -227,9 +267,9 @@ def _linearizable(sub: str, args: list[str]) -> None:
 _MODELS = {
     "2pc": (_2pc, ["check", "check-sym", "check-tpu", "explore"]),
     "paxos": (_paxos, ["check", "check-tpu", "explore", "spawn"]),
-    "increment": (_increment, ["check", "check-sym", "explore"]),
-    "increment-lock": (_increment_lock, ["check", "check-sym", "explore"]),
-    "single-copy-register": (_single_copy, ["check", "explore", "spawn"]),
+    "increment": (_increment, ["check", "check-sym", "check-tpu", "explore"]),
+    "increment-lock": (_increment_lock, ["check", "check-sym", "check-tpu", "explore"]),
+    "single-copy-register": (_single_copy, ["check", "check-tpu", "explore", "spawn"]),
     "linearizable-register": (_linearizable, ["check", "explore", "spawn"]),
 }
 

@@ -42,6 +42,12 @@ class IncrementLock(Model):
     def __init__(self, thread_count: int = 3):
         self.thread_count = thread_count
 
+    def to_encoded(self):
+        """The TPU-engine encoding (spawn_tpu* discovers this hook)."""
+        from .increment_tpu import IncrementLockEncoded
+
+        return IncrementLockEncoded(self.thread_count)
+
     def init_states(self) -> Sequence[IncrementState]:
         return [
             IncrementState(
@@ -102,6 +108,12 @@ class Increment(Model):
 
     def __init__(self, thread_count: int = 2):
         self.thread_count = thread_count
+
+    def to_encoded(self):
+        """The TPU-engine encoding (spawn_tpu* discovers this hook)."""
+        from .increment_tpu import IncrementEncoded
+
+        return IncrementEncoded(self.thread_count)
 
     def init_states(self) -> Sequence[IncrementState]:
         return [

@@ -1,0 +1,149 @@
+"""Vectorized shared-counter models (increment / increment_lock).
+
+Encodes :mod:`stateright_tpu.models.increment` (reference
+examples/increment.rs + examples/increment_lock.rs) for the TPU wave
+engines. Layout (``width = 1 + ceil(N/4)`` lanes):
+
+  lane 0:       bits 0-3 shared counter i, bit 4 lock flag
+  lanes 1..:    threads packed 8 bits each: t (4b) | pc (3b)
+
+Each thread has at most one enabled action at any pc, so
+``max_actions = thread_count`` — action k is "thread k takes its
+enabled step".
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..encoding import EncodedModelBase
+from .increment import Increment, IncrementLock, IncrementState, ProcState
+
+
+class _IncrementEncodedBase(EncodedModelBase):
+    #: lock-guarded program or racy program
+    locked: bool
+
+    def __init__(self, host_model, thread_count: int):
+        if thread_count > 8:
+            raise ValueError("encoding supports at most 8 threads")
+        self.n = thread_count
+        self.width = 1 + (thread_count + 3) // 4
+        self.max_actions = thread_count
+        self.host_model = host_model
+
+    def cache_key(self):
+        return (type(self).__name__, self.n)
+
+    # -- host side -------------------------------------------------------
+
+    def encode(self, state: IncrementState) -> np.ndarray:
+        vec = np.zeros(self.width, dtype=np.uint32)
+        vec[0] = state.i | (int(state.lock) << 4)
+        for tid, proc in enumerate(state.s):
+            lane, shift = 1 + tid // 4, (tid % 4) * 8
+            vec[lane] |= (proc.t | (proc.pc << 4)) << shift
+        return vec
+
+    def decode(self, vec: np.ndarray) -> IncrementState:
+        vec = np.asarray(vec)
+        procs = []
+        for tid in range(self.n):
+            lane, shift = 1 + tid // 4, (tid % 4) * 8
+            raw = (int(vec[lane]) >> shift) & 0xFF
+            procs.append(ProcState(t=raw & 0xF, pc=raw >> 4))
+        return IncrementState(
+            i=int(vec[0]) & 0xF,
+            lock=bool(int(vec[0]) & 0x10),
+            s=tuple(procs),
+        )
+
+    def init_vecs(self) -> np.ndarray:
+        return np.stack(
+            [self.encode(s) for s in self.host_model.init_states()]
+        )
+
+    # -- device side -----------------------------------------------------
+
+    def _thread_fields(self, vec, tid, jnp):
+        lane, shift = 1 + tid // 4, (tid % 4) * 8
+        raw = (vec[lane] >> jnp.uint32(shift)) & jnp.uint32(0xFF)
+        return raw & jnp.uint32(0xF), raw >> jnp.uint32(4)
+
+    def _with_thread(self, vec, tid, t, pc, jnp):
+        lane, shift = 1 + tid // 4, (tid % 4) * 8
+        cleared = vec[lane] & ~jnp.uint32(0xFF << shift)
+        raw = (t | (pc << jnp.uint32(4))) << jnp.uint32(shift)
+        return vec.at[lane].set(cleared | raw)
+
+    def step_vec(self, vec):
+        import jax.numpy as jnp
+
+        i = vec[0] & jnp.uint32(0xF)
+        lock = (vec[0] & jnp.uint32(0x10)) != 0
+        succs, valids = [], []
+        for tid in range(self.n):
+            t, pc = self._thread_fields(vec, tid, jnp)
+            if self.locked:
+                # pc 0 -lock-> 1 -read-> 2 -write-> 3 -release-> 4
+                valid = (
+                    ((pc == 0) & ~lock)
+                    | (pc == 1)
+                    | (pc == 2)
+                    | ((pc == 3) & lock)
+                )
+            else:
+                # pc 1 -read-> 2 -write-> 3
+                valid = (pc == 1) | (pc == 2)
+            # Branchless next state per pc.
+            read = pc == 1
+            write = pc == 2
+            new_t = jnp.where(read, i, t)
+            new_pc = pc + 1
+            s = self._with_thread(vec, tid, new_t, new_pc, jnp)
+            new_i = jnp.where(write, t + 1, i)
+            new_lock = jnp.where(
+                pc == 0, True, jnp.where(pc == 3, False, lock)
+            )
+            s = s.at[0].set(
+                new_i | (new_lock.astype(jnp.uint32) << jnp.uint32(4))
+            )
+            succs.append(s)
+            valids.append(valid)
+        return jnp.stack(succs), jnp.stack(valids)
+
+    def _counts(self, vec, jnp):
+        i = vec[0] & jnp.uint32(0xF)
+        done = jnp.uint32(0)
+        critical = jnp.uint32(0)
+        for tid in range(self.n):
+            _, pc = self._thread_fields(vec, tid, jnp)
+            done = done + (pc >= 3).astype(jnp.uint32)
+            critical = critical + ((pc >= 1) & (pc < 4)).astype(jnp.uint32)
+        return i, done, critical
+
+
+class IncrementLockEncoded(_IncrementEncodedBase):
+    locked = True
+
+    def __init__(self, thread_count: int):
+        super().__init__(IncrementLock(thread_count), thread_count)
+
+    def property_conditions_vec(self, vec):
+        import jax.numpy as jnp
+
+        i, done, critical = self._counts(vec, jnp)
+        return jnp.stack([done == i, critical <= 1])  # fin, mutex
+
+
+class IncrementEncoded(_IncrementEncodedBase):
+    locked = False
+
+    def __init__(self, thread_count: int):
+        super().__init__(Increment(thread_count), thread_count)
+
+    def property_conditions_vec(self, vec):
+        import jax.numpy as jnp
+
+        i, done, _ = self._counts(vec, jnp)
+        return jnp.stack([done == i])  # fin

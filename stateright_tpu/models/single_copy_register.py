@@ -63,6 +63,13 @@ def single_copy_register_model(
     model = ActorModel(
         cfg=cfg, init_history=LinearizabilityTester(Register(DEFAULT_VALUE))
     )
+
+    def to_encoded():
+        from .single_copy_register_tpu import SingleCopyEncoded
+
+        return SingleCopyEncoded(cfg, network)
+
+    model.to_encoded = to_encoded
     model.add_actors(
         RegisterServer(SingleCopyActor()) for _ in range(cfg.server_count)
     )

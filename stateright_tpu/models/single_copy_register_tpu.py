@@ -1,0 +1,383 @@
+"""Vectorized single-copy register: second actor-model TPU encoding.
+
+Encodes the full actor-model state of
+:mod:`stateright_tpu.models.single_copy_register` (reference
+examples/single-copy-register.rs, pinned at 93 states for 2 clients /
+1 server) — server value, register clients, the 12-envelope network as
+a bitmask, and the in-state ``LinearizabilityTester`` — into 3 uint32
+lanes.
+
+Unlike paxos (models/paxos_tpu.py), BOTH clients complete operations
+here, so the tester's cross-thread snapshots (linearizability.rs:
+114-126) are live data: each client's read invocation records how many
+of the peer's operations had completed. The tester state per client is
+(phase, read-value, read-snapshot) — 36 combinations — so the
+serializer verdict is a 1296-entry truth table precomputed by the REAL
+serializer over directly-constructed tester states. This demonstrates
+the device-filters/host-precomputes pattern generalizing beyond the
+empty-snapshot special case.
+
+Layout (width = 3):
+  lane 0: server value (2b) | client actor phases (2b each)
+  lane 1: per client 6 bits of tester state: phase(2) rv(2) snapR(2)
+  lane 2: network bitmask (12 envelopes)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..actor import Id
+from ..actor.register import Get, GetOk, Put, PutOk
+from ..encoding import EncodedModelBase
+from ..semantics import LinearizabilityTester, Register
+from ..semantics.register import ReadOk, ReadOp, WriteOk, WriteOp
+from .single_copy_register import (
+    SingleCopyRegisterCfg,
+    single_copy_register_model,
+)
+
+class SingleCopyEncoded(EncodedModelBase):
+    def __init__(self, cfg: SingleCopyRegisterCfg, network=None):
+        if cfg.server_count != 1 or cfg.put_count != 1:
+            raise ValueError(
+                f"SingleCopyEncoded supports 1 server, put_count=1 (got {cfg})"
+            )
+        if not (1 <= cfg.client_count <= 2):
+            raise ValueError("SingleCopyEncoded supports 1-2 clients")
+        if network is not None and type(network).__name__ != (
+            "UnorderedNonDuplicating"
+        ):
+            raise ValueError(
+                "SingleCopyEncoded models the unordered non-duplicating "
+                "network"
+            )
+        self.cfg = cfg
+        self.C = cfg.client_count
+        self.clients = list(range(1, 1 + self.C))
+        self.values = [chr(ord("A") + i - 1) for i in self.clients]
+        self.P = len(self.values)
+        self.host_model = single_copy_register_model(cfg)
+        self.universe = self._build_universe()
+        self.index = {e: k for k, e in enumerate(self.universe)}
+        self.K = len(self.universe)
+        self.width = 3
+        self.max_actions = self.K
+        self._lin_table = self._build_lin_table()
+
+    def cache_key(self):
+        return (self.C,)
+
+    # -- universe ----------------------------------------------------------
+    # Envelope key: (src, dst, kind, arg) with kind put|get|putok|getok.
+
+    def _build_universe(self) -> list:
+        u = []
+        for j, c in enumerate(self.clients):
+            u.append((c, 0, "put", j + 1))
+        for c in self.clients:
+            u.append((c, 0, "get", 0))
+        for j, c in enumerate(self.clients):
+            u.append((0, c, "putok", j + 1))
+        for c in self.clients:
+            for v in range(self.P + 1):  # '\x00' readable before any write
+                u.append((0, c, "getok", v))
+        return u
+
+    def _value_code(self, value: str) -> int:
+        if value == "\x00":
+            return 0
+        try:
+            return 1 + self.values.index(value)
+        except ValueError:
+            raise ValueError(f"value outside universe: {value!r}")
+
+    def _msg_key(self, src: int, dst: int, msg) -> tuple:
+        if isinstance(msg, Put):
+            return (src, dst, "put", self._value_code(msg.value))
+        if isinstance(msg, Get):
+            return (src, dst, "get", 0)
+        if isinstance(msg, PutOk):
+            j = self.clients.index(msg.req_id)
+            return (src, dst, "putok", j + 1)
+        if isinstance(msg, GetOk):
+            return (src, dst, "getok", self._value_code(msg.value))
+        raise ValueError(f"message outside universe: {msg!r}")
+
+    # -- encode ------------------------------------------------------------
+
+    def encode(self, state) -> np.ndarray:
+        vec = np.zeros(self.width, dtype=np.uint32)
+        server_value = state.actor_states[0].state
+        lane0 = self._value_code(server_value)
+        for j, c in enumerate(self.clients):
+            cs = state.actor_states[c]
+            if cs.awaiting == c and cs.op_count == 1:
+                phase = 0
+            elif cs.awaiting == 2 * c and cs.op_count == 2:
+                phase = 1
+            elif cs.awaiting is None and cs.op_count == 3:
+                phase = 2
+            else:
+                raise ValueError(f"client state outside universe: {cs!r}")
+            lane0 |= phase << (2 + 2 * j)
+        vec[0] = lane0
+        lane1 = 0
+        for j, c in enumerate(self.clients):
+            hphase, rv, snap = self._history_fields(state.history, c)
+            lane1 |= (hphase | (rv << 2) | (snap << 4)) << (6 * j)
+        vec[1] = lane1
+        from collections import Counter
+
+        for env, count in Counter(state.network.iter_all()).items():
+            if count != 1:
+                raise ValueError(
+                    f"envelope multiplicity {count} outside universe"
+                )
+            k = self.index.get(
+                self._msg_key(int(env.src), int(env.dst), env.msg)
+            )
+            if k is None:
+                raise ValueError(f"envelope outside universe: {env!r}")
+            vec[2] |= np.uint32(1 << k)
+        if any(state.crashed) or any(t for t in state.timers_set):
+            raise ValueError("crashes/timers outside the universe")
+        return vec
+
+    def _history_fields(self, history, c: int) -> Tuple[int, int, int]:
+        if not history.is_valid:
+            raise ValueError("invalid history outside universe")
+        thread = Id(c)
+        peer = Id(self.clients[1 - self.clients.index(c)]) if self.C == 2 else None
+        completed = dict(history.history_by_thread).get(thread, ())
+        in_flight = dict(history.in_flight_by_thread).get(thread)
+        j = self.clients.index(c)
+        wv = self.values[j]
+
+        def check_w(entry):
+            snap, op = entry[0], entry[1]
+            if snap != () or not isinstance(op, WriteOp) or op.value != wv:
+                raise ValueError(f"history outside universe: {entry!r}")
+
+        def snap_code(snap) -> int:
+            if snap == ():
+                return 0
+            if (
+                self.C == 2
+                and len(snap) == 1
+                and snap[0][0] == peer
+                and snap[0][1] in (0, 1)
+            ):
+                return snap[0][1] + 1
+            raise ValueError(f"snapshot outside universe: {snap!r}")
+
+        rv = 0
+        snap = 0
+        if len(completed) == 0 and in_flight is not None:
+            check_w(in_flight)
+            phase = 0
+        elif len(completed) >= 1:
+            check_w(completed[0])
+            if not isinstance(completed[0][2], WriteOk):
+                raise ValueError(f"history outside universe: {completed!r}")
+            if len(completed) == 1 and in_flight is None:
+                phase = 1
+            elif len(completed) == 1:
+                if not isinstance(in_flight[1], ReadOp):
+                    raise ValueError(
+                        f"history outside universe: {in_flight!r}"
+                    )
+                snap = snap_code(in_flight[0])
+                phase = 2
+            elif len(completed) == 2 and in_flight is None:
+                s, op, ret = completed[1]
+                if not isinstance(op, ReadOp) or not isinstance(ret, ReadOk):
+                    raise ValueError(
+                        f"history outside universe: {completed!r}"
+                    )
+                snap = snap_code(s)
+                rv = self._value_code(ret.value)
+                phase = 3
+            else:
+                raise ValueError(f"history outside universe: {completed!r}")
+        else:
+            raise ValueError(f"history outside universe: thread {c}")
+        return phase, rv, snap
+
+    def init_vecs(self) -> np.ndarray:
+        return np.stack(
+            [self.encode(s) for s in self.host_model.init_states()]
+        )
+
+    # -- linearizability truth table --------------------------------------
+
+    def _tester_for(self, combos) -> Optional[LinearizabilityTester]:
+        """Directly construct the tester state for per-client
+        (phase, rv, snap) triples; None if structurally impossible."""
+        history = {}
+        in_flight = {}
+        for j, (phase, rv, snap) in enumerate(combos):
+            t = Id(self.clients[j])
+            peer = (
+                Id(self.clients[1 - j]) if self.C == 2 else None
+            )
+            wv = self.values[j]
+            snap_t = () if snap == 0 else ((peer, snap - 1),)
+            if snap != 0 and peer is None:
+                return None
+            w_done = ((), WriteOp(wv), WriteOk())
+            if phase == 0:
+                history[t] = ()
+                in_flight[t] = ((), WriteOp(wv))
+            elif phase == 1:
+                if rv or snap:
+                    return None
+                history[t] = (w_done,)
+            elif phase == 2:
+                if rv:
+                    return None
+                history[t] = (w_done,)
+                in_flight[t] = (snap_t, ReadOp())
+            else:
+                v = "\x00" if rv == 0 else self.values[rv - 1]
+                history[t] = (
+                    w_done,
+                    (snap_t, ReadOp(), ReadOk(v)),
+                )
+        return LinearizabilityTester(
+            init_ref_obj=Register("\x00"),
+            history_by_thread=tuple(sorted(history.items())),
+            in_flight_by_thread=tuple(sorted(in_flight.items())),
+        )
+
+    def _build_lin_table(self) -> np.ndarray:
+        import itertools
+
+        size = 36 ** self.C
+        table = np.zeros(size, dtype=bool)
+        for combo in itertools.product(
+            range(4), range(3), range(3), repeat=self.C
+        ):
+            triples = [
+                (combo[3 * j], combo[3 * j + 1], combo[3 * j + 2])
+                for j in range(self.C)
+            ]
+            idx = 0
+            for ph, rv, sn in triples:
+                idx = idx * 36 + (ph * 3 + rv) * 3 + sn
+            tester = self._tester_for(triples)
+            table[idx] = (
+                tester is not None
+                and tester.serialized_history() is not None
+            )
+        return table
+
+    # -- device step -------------------------------------------------------
+
+    def _client_fields(self, vec, j, xp):
+        phase = (vec[0] >> xp.uint32(2 + 2 * j)) & xp.uint32(3)
+        h = (vec[1] >> xp.uint32(6 * j)) & xp.uint32(0x3F)
+        return phase, h & 3, (h >> xp.uint32(2)) & 3, h >> xp.uint32(4)
+
+    def step_vec(self, vec):
+        import jax.numpy as jnp
+
+        succs, valids = [], []
+        for k, env in enumerate(self.universe):
+            s, valid = self._deliver(vec, k, env, jnp)
+            succs.append(s)
+            valids.append(valid)
+        return jnp.stack(succs), jnp.stack(valids)
+
+    def _net(self, vec, k, xp):
+        return ((vec[2] >> xp.uint32(k)) & xp.uint32(1)) != 0
+
+    def _deliver(self, vec, k, env, xp):
+        src, dst, kind, arg = env
+        present = self._net(vec, k, xp)
+        net = vec[2] & ~xp.uint32(1 << k)
+        if kind == "put":
+            # Server: set value, reply PutOk (always handled).
+            new0 = (vec[0] & ~xp.uint32(3)) | xp.uint32(arg)
+            out = vec.at[0].set(new0)
+            ok_bit = self.index[(0, src, "putok", arg)]
+            out = out.at[2].set(net | xp.uint32(1 << ok_bit))
+            return out, present
+        if kind == "get":
+            value = vec[0] & xp.uint32(3)
+            reply = net
+            for v in range(self.P + 1):
+                bit = self.index[(0, src, "getok", v)]
+                reply = reply | xp.where(
+                    value == v, xp.uint32(1 << bit), xp.uint32(0)
+                )
+            return vec.at[2].set(reply), present
+        j = self.clients.index(dst)
+        phase, hphase, rv, snap = self._client_fields(vec, j, xp)
+        if kind == "putok":
+            handled = phase == 0
+            new0 = (vec[0] & ~xp.uint32(3 << (2 + 2 * j))) | xp.uint32(
+                1 << (2 + 2 * j)
+            )
+            # History: W returns, R invoked; the snapshot records the
+            # peer's completed-op count right now.
+            if self.C == 2:
+                _, peer_h, _, _ = self._client_fields(vec, 1 - j, xp)
+                peer_done = xp.where(
+                    peer_h == 0, 0, xp.where(peer_h == 3, 2, 1)
+                ).astype(xp.uint32)
+            else:
+                peer_done = xp.uint32(0)
+            h = xp.uint32(2) | (peer_done << xp.uint32(4))  # phase 2, rv 0
+            new1 = (
+                vec[1] & ~xp.uint32(0x3F << (6 * j))
+            ) | (h << xp.uint32(6 * j))
+            # The client follows up with its Get (register.rs:144-236).
+            get_bit = self.index[(dst, 0, "get", 0)]
+            net = net | xp.where(
+                handled, xp.uint32(1 << get_bit), xp.uint32(0)
+            )
+            out = vec.at[0].set(xp.where(handled, new0, vec[0]))
+            out = out.at[1].set(xp.where(handled, new1, vec[1]))
+            out = out.at[2].set(net)
+            return out, present & handled
+        if kind == "getok":
+            handled = phase == 1
+            new0 = (vec[0] & ~xp.uint32(3 << (2 + 2 * j))) | xp.uint32(
+                2 << (2 + 2 * j)
+            )
+            h = (
+                xp.uint32(3)
+                | (xp.uint32(arg) << xp.uint32(2))
+                | (snap << xp.uint32(4))
+            )
+            new1 = (
+                vec[1] & ~xp.uint32(0x3F << (6 * j))
+            ) | (h << xp.uint32(6 * j))
+            out = vec.at[0].set(xp.where(handled, new0, vec[0]))
+            out = out.at[1].set(xp.where(handled, new1, vec[1]))
+            out = out.at[2].set(net)
+            return out, present & handled
+        raise AssertionError(kind)
+
+    # -- properties --------------------------------------------------------
+
+    def property_conditions_vec(self, vec):
+        import jax.numpy as jnp
+
+        idx = jnp.uint32(0)
+        for j in range(self.C):
+            _, hphase, rv, snap = self._client_fields(vec, j, jnp)
+            idx = idx * 36 + (hphase * 3 + rv) * 3 + snap
+        # The envelope universe is closed (proved by the exhaustive
+        # per-state differential test), so no poison guard is needed.
+        table = jnp.asarray(self._lin_table)
+        linearizable = table[idx]
+        chosen = jnp.bool_(False)
+        for v in range(1, self.P + 1):
+            for c in self.clients:
+                bit = self.index[(0, c, "getok", v)]
+                chosen = chosen | self._net(vec, bit, jnp)
+        return jnp.stack([linearizable, chosen])

@@ -125,9 +125,13 @@ def insert(
     lo, hi = lo.copy(), hi.copy()  # keep numpy path functional too
     inserted = xp.zeros(n, dtype=bool)
     found = xp.zeros(n, dtype=bool)
+    overflow = xp.zeros(n, dtype=bool)
     slot = xp.zeros(n, dtype=xp.uint32)
     pending = active
-    for _ in range(rounds):
+    # Each round with pending rows makes progress, so n + rounds bounds
+    # the loop; a key overflows when its probe DEPTH exhausts `rounds`
+    # (claim-loser re-read rounds don't eat the probe budget).
+    for _ in range(rounds + n):
         if not pending.any():
             break
         slot_lo = lo[idx]
@@ -159,7 +163,10 @@ def insert(
         advance = pending & ~is_empty & ~is_match
         probe = xp.where(advance, probe + 1, probe)
         idx = xp.where(advance, (idx + probe) & mask, idx)
-    return DeviceHashSet(lo, hi), inserted, pending, slot
+        exhausted = pending & (probe >= rounds)
+        overflow = overflow | exhausted
+        pending = pending & ~exhausted
+    return DeviceHashSet(lo, hi), inserted, overflow | pending, slot
 
 
 def _match_vma(x, vma):
@@ -202,7 +209,12 @@ def _insert_jax(
     row_ids = jnp.arange(n, dtype=jnp.uint32)
 
     def cond(c):
-        return (c["r"] < rounds) & jnp.any(c["pending"])
+        # Every round with pending rows makes progress (an insertion,
+        # a match, or a probe advance), so n + rounds bounds the loop;
+        # per-key overflow is governed by probe DEPTH below, not by
+        # the iteration count — claim-loser re-read rounds don't eat
+        # a key's probe budget.
+        return (c["r"] < rounds + n) & jnp.any(c["pending"])
 
     def body(c):
         lo, hi, idx, pending = c["lo"], c["hi"], c["idx"], c["pending"]
@@ -234,12 +246,16 @@ def _insert_jax(
         pending = pending & ~won
         advance = pending & ~is_empty & ~is_match
         probe = jnp.where(advance, c["probe"] + 1, c["probe"])
+        # A key whose probe depth exhausts `rounds` overflows and
+        # leaves the pending set (reported to the caller).
+        exhausted = pending & (probe >= rounds)
         return dict(
             lo=lo,
             hi=hi,
             idx=jnp.where(advance, (idx + probe) & mask, idx),
             probe=probe,
-            pending=pending,
+            pending=pending & ~exhausted,
+            overflow=c["overflow"] | exhausted,
             inserted=c["inserted"] | won,
             slot=jnp.where(won, idx, slot),
             r=c["r"] + 1,
@@ -251,6 +267,7 @@ def _insert_jax(
         idx=_slot_hash(key_lo, key_hi, mask, jnp),
         probe=jnp.zeros(n, dtype=jnp.uint32),
         pending=active,
+        overflow=jnp.zeros(n, dtype=bool),
         inserted=jnp.zeros(n, dtype=bool),
         slot=jnp.zeros(n, dtype=jnp.uint32),
         r=jnp.int32(0),
@@ -262,7 +279,7 @@ def _insert_jax(
     return (
         DeviceHashSet(out["lo"], out["hi"]),
         out["inserted"],
-        out["pending"],
+        out["overflow"] | out["pending"],
         out["slot"],
     )
 

@@ -1,0 +1,85 @@
+"""Increment / increment-lock on the TPU engines.
+
+Note on counts: models whose every property gets discovered (racy
+increment's "fin" counterexample) early-exit — the reference's racing
+workers make visited counts nondeterministic there too (bfs.rs:128-135)
+— so those cases compare discovered-property sets, not counts. The
+lock-guarded model explores its full space and pins counts exactly.
+"""
+
+import numpy as np
+import pytest
+
+from stateright_tpu.models.increment import Increment, IncrementLock
+
+
+def test_increment_lock_full_space_matches_host():
+    host = IncrementLock(3).checker().spawn_bfs().join()
+    tpu = (
+        IncrementLock(3)
+        .checker()
+        .spawn_tpu_sortmerge(
+            capacity=1 << 10, frontier_capacity=256, cand_capacity=1024
+        )
+        .join()
+    )
+    assert tpu.unique_state_count() == host.unique_state_count()
+    assert host.discoveries() == {} and tpu.discoveries() == {}
+    tpu.assert_properties()
+
+
+def test_increment_racy_finds_lost_update():
+    host = Increment(3).checker().spawn_bfs().join()
+    tpu = (
+        Increment(3)
+        .checker()
+        .spawn_tpu_sortmerge(
+            capacity=1 << 10, frontier_capacity=256, cand_capacity=1024
+        )
+        .join()
+    )
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries()) == ["fin"]
+    # The counterexample replays and genuinely violates the invariant.
+    path = tpu.assert_any_discovery("fin")
+    final = path.last_state()
+    assert sum(1 for p in final.s if p.pc >= 3) != final.i
+
+
+def test_increment_step_exhaustive_differential():
+    """Every reachable state's successor set matches the host model."""
+    import jax
+    import jax.numpy as jnp
+    from collections import deque
+
+    m = Increment(3)
+    enc = m.to_encoded()
+    step = jax.jit(enc.step_vec)
+    seen = set()
+    frontier = deque()
+    for s in m.init_states():
+        seen.add(tuple(enc.encode(s).tolist()))
+        frontier.append(s)
+    while frontier:
+        s = frontier.popleft()
+        succs, valid = step(jnp.asarray(enc.encode(s)))
+        succs, valid = np.asarray(succs), np.asarray(valid)
+        dev = sorted(
+            tuple(succs[i].tolist())
+            for i in range(enc.max_actions)
+            if valid[i]
+        )
+        host = sorted(tuple(enc.encode(n).tolist()) for n in m.next_states(s))
+        assert dev == host, f"divergence at {s!r}"
+        for n in m.next_states(s):
+            key = tuple(enc.encode(n).tolist())
+            if key not in seen:
+                seen.add(key)
+                frontier.append(n)
+
+
+def test_increment_encode_decode_roundtrip():
+    m = IncrementLock(4)
+    enc = m.to_encoded()
+    for s in m.init_states():
+        for n in m.next_states(s):
+            assert enc.decode(enc.encode(n)) == n

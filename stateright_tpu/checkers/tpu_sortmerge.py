@@ -55,20 +55,39 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
     ``capacity`` is the visited-array length — unlike the hash table
     there is no load-factor pressure: it can sit at exactly the
     expected unique-state count (overflow is detected, not silent).
+
+    ``tiles`` splits the frontier into that many expansion tiles
+    processed sequentially inside each wave: peak memory for the flat
+    successor tensor drops from ``F*K*W`` to ``(F/tiles)*K*W`` lanes,
+    which is what lets 10⁷-10⁸-state spaces (2pc rm=9/10) fit on one
+    chip. The candidate budget is per-tile: each tile may contribute at
+    most ``cand_capacity/tiles`` valid successors (overflow detected).
     """
 
+    def __init__(self, builder, tiles: int = 1, **kwargs):
+        super().__init__(builder, **kwargs)
+        self.tiles = tiles
+        if self.frontier_capacity % tiles:
+            raise ValueError(
+                f"frontier_capacity {self.frontier_capacity} not divisible "
+                f"by tiles {tiles}"
+            )
+
     def _cache_extras(self) -> tuple:
-        return ("sortmerge",)
+        return ("sortmerge", self.tiles)
 
     def _maybe_warn_occupancy(self, occupancy: float) -> None:
         """No probe pressure: the sorted array works at 100% occupancy
         and overflow is detected exactly — nothing to warn about."""
 
     def _cand_overflow_message(self) -> str:
+        fk = self.frontier_capacity * self.encoded.max_actions
+        per_tile = -(-min(self.cand_capacity or fk, fk) // self.tiles)
         return (
-            "candidate-buffer overflow: a wave generated more than "
-            f"{self.cand_capacity or self.frontier_capacity * self.encoded.max_actions} "
-            "valid successors; re-run with a larger cand_capacity"
+            f"candidate-buffer overflow: an expansion tile generated more "
+            f"than {per_tile} valid successors "
+            f"(cand_capacity/tiles = {per_tile}); re-run with a larger "
+            "cand_capacity or fewer tiles"
         )
 
     # -- device programs ---------------------------------------------------
@@ -148,46 +167,97 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 done=jnp.bool_(n0 == 0),
             )
 
+        NT = self.tiles
+        T = F // NT
+        # Round the per-tile budget up so the user's cand_capacity is a
+        # floor, never silently truncated.
+        Bt = -(-B // NT)
+        B_eff = Bt * NT
+
         def body(c):
-            ebits = c["ebits"]
-            fval = c["fval"]
             if target_depth is None:
                 expand = jnp.bool_(True)
             else:
                 expand = c["depth"] < target_depth
 
-            ex = expand_frontier(
-                enc, props, evt_idx, c["frontier"], fval, ebits, expand
+            # Tiled expansion: each tile of T frontier rows expands,
+            # fingerprints, and sort#1-compacts its own candidates into
+            # a Bt-row segment of the shared candidate buffers
+            # (contiguous dynamic_update_slice writes — no scatter).
+            # Only the [T*K, W] tile tensor is ever materialized.
+            def tile_body(t, acc):
+                (
+                    ck_lo, ck_hi, cst, cplo, cphi, ceb,
+                    dfound, dlo, dhi, n_cand, c_overflow,
+                ) = acc
+                off = t * T
+                tf = lax.dynamic_slice(c["frontier"], (off, 0), (T, W))
+                tfv = lax.dynamic_slice(c["fval"], (off,), (T,))
+                teb = lax.dynamic_slice(c["ebits"], (off,), (T,))
+                ex = expand_frontier(
+                    enc, props, evt_idx, tf, tfv, teb, expand
+                )
+                dfound, dlo, dhi = discovery_update(
+                    props, ex, tfv, dfound, dlo, dhi
+                )
+                flat, valid = ex["flat"], ex["v"]
+                k_lo, k_hi = fingerprint_u32v(flat, jnp)
+                k_lo, k_hi = clamp_keys(k_lo, k_hi)
+                k_lo = jnp.where(valid, k_lo, jnp.uint32(_SENT))
+                k_hi = jnp.where(valid, k_hi, jnp.uint32(_SENT))
+                t_cand = jnp.sum(valid)
+                c_overflow = c_overflow | (t_cand > Bt)
+                # Sort#1 (per tile): valid keys have the Bt lowest
+                # values (invalid rows carry the sentinel key).
+                rows = jnp.arange(T * K, dtype=jnp.uint32)
+                s_hi, s_lo, s_row = lax.sort(
+                    (k_hi, k_lo, rows), num_keys=2
+                )
+                s_hi, s_lo, s_row = s_hi[:Bt], s_lo[:Bt], s_row[:Bt]
+                st = flat[s_row]
+                prow = s_row // jnp.uint32(K)
+                o = t * Bt
+                ck_lo = lax.dynamic_update_slice(ck_lo, s_lo, (o,))
+                ck_hi = lax.dynamic_update_slice(ck_hi, s_hi, (o,))
+                cst = lax.dynamic_update_slice(cst, st, (o, 0))
+                if track_paths:
+                    # Parent fingerprints are only needed for the log.
+                    cplo = lax.dynamic_update_slice(
+                        cplo, ex["f_lo"][prow], (o,)
+                    )
+                    cphi = lax.dynamic_update_slice(
+                        cphi, ex["f_hi"][prow], (o,)
+                    )
+                ceb = lax.dynamic_update_slice(
+                    ceb, ex["ebits"][prow], (o,)
+                )
+                return (
+                    ck_lo, ck_hi, cst, cplo, cphi, ceb,
+                    dfound, dlo, dhi, n_cand + t_cand.astype(jnp.uint32),
+                    c_overflow,
+                )
+
+            (
+                s_lo, s_hi, b_state, b_par_lo, b_par_hi, b_ebits,
+                disc_found, disc_lo, disc_hi, n_cand, c_overflow,
+            ) = lax.fori_loop(
+                0,
+                NT,
+                tile_body,
+                (
+                    jnp.full(B_eff, _SENT, jnp.uint32),
+                    jnp.full(B_eff, _SENT, jnp.uint32),
+                    jnp.zeros((B_eff, W), jnp.uint32),
+                    jnp.zeros(B_eff if track_paths else 0, jnp.uint32),
+                    jnp.zeros(B_eff if track_paths else 0, jnp.uint32),
+                    jnp.zeros(B_eff, jnp.uint32),
+                    c["disc_found"],
+                    c["disc_lo"],
+                    c["disc_hi"],
+                    jnp.uint32(0),
+                    c["c_overflow"],
+                ),
             )
-            disc_found, disc_lo, disc_hi = discovery_update(
-                props, ex, fval, c["disc_found"], c["disc_lo"], c["disc_hi"]
-            )
-
-            # Fingerprint every padded candidate (elementwise, cheap);
-            # invalid rows get the sentinel key so they sort last.
-            flat, valid = ex["flat"], ex["v"]
-            k_lo, k_hi = fingerprint_u32v(flat, jnp)
-            k_lo, k_hi = clamp_keys(k_lo, k_hi)
-            k_lo = jnp.where(valid, k_lo, jnp.uint32(_SENT))
-            k_hi = jnp.where(valid, k_hi, jnp.uint32(_SENT))
-            n_cand = jnp.sum(valid)
-            c_overflow = c["c_overflow"] | (n_cand > B)
-
-            # Sort#1: candidates by key, carrying the flat row index —
-            # the B lowest keys are exactly the valid ones (plus
-            # sentinels if fewer). No scatter anywhere.
-            rows = jnp.arange(F * K, dtype=jnp.uint32)
-            s_hi, s_lo, s_row = lax.sort((k_hi, k_lo, rows), num_keys=2)
-            s_hi, s_lo, s_row = s_hi[:B], s_lo[:B], s_row[:B]
-
-            # One payload gather for candidate states; parent
-            # fingerprints and inherited ebits live in F-sized arrays
-            # (row // K), so those gathers are small.
-            b_state = flat[s_row]
-            b_parent_row = s_row // jnp.uint32(K)
-            b_par_lo = ex["f_lo"][b_parent_row]
-            b_par_hi = ex["f_hi"][b_parent_row]
-            b_ebits = ex["ebits"][b_parent_row]
 
             # Sort#2: merge with the visited array. Stable sort with
             # the visited keys FIRST in the concatenation means the
@@ -200,7 +270,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             m_pos = jnp.concatenate(
                 [
                     jnp.zeros(C, jnp.uint32),
-                    jnp.arange(1, B + 1, dtype=jnp.uint32),
+                    jnp.arange(1, B_eff + 1, dtype=jnp.uint32),
                 ]
             )
             m_hi, m_lo, m_pos = lax.sort((m_hi, m_lo, m_pos), num_keys=2)

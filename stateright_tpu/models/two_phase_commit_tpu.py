@@ -1,20 +1,22 @@
 """Vectorized two-phase commit: the TPU-engine proving ground.
 
 Encodes :class:`~stateright_tpu.models.two_phase_commit.TwoPhaseSys`
-(reference examples/2pc.rs) as fixed-width uint32 vectors, with the
-whole action set generated branchlessly per state — the
+(reference examples/2pc.rs) as PACKED fixed-width uint32 vectors, with
+the whole action set generated branchlessly per state — the
 ``#[derive(TpuState)]`` pattern from the north star, done by hand
 (SURVEY.md §7 step 2 names 2pc as the proving ground).
 
-Layout (``width = rm_count + 3`` lanes):
-  [0 .. N-1]  rm_state enum (0=Working 1=Prepared 2=Committed 3=Aborted)
-  [N]         tm_state enum (0=Init 1=Committed 2=Aborted)
-  [N+1]       tm_prepared bitmask
-  [N+2]       message-set bitmask: bit0=commit, bit1=abort,
-              bit (2+rm)=prepared(rm)
+Packed layout (``width = 2`` for up to 10 RMs):
+  lane 0: rm_state enum, 2 bits per RM (0=Working 1=Prepared
+          2=Committed 3=Aborted)
+  lane 1: bits 0-1   tm_state enum (0=Init 1=Committed 2=Aborted)
+          bits 2..   tm_prepared bitmask (N bits)
+          then       message-set bitmask: commit, abort, prepared(rm)
 
-Every dynamic host structure (the message *set*) is a bitmask here, so
-equal host states encode to identical vectors canonically.
+Width drives the engine's hot-loop cost directly — the flat successor
+tensor is ``F*K*W`` lanes and the splitmix64 fingerprint does one
+u64 fold per lane — so the packed layout is ~6x cheaper per wave than
+a lane-per-RM layout at rm=9/10 benchmark scale.
 
 Actions (``max_actions = 2 + 5*N``), mirroring 2pc.rs actions():
   0: tm_commit        1: tm_abort
@@ -35,9 +37,16 @@ _INIT, _TM_COMMITTED, _TM_ABORTED = 0, 1, 2
 
 class TwoPhaseSysEncoded(EncodedModelBase):
     def __init__(self, rm_count: int):
+        if rm_count > 10:
+            raise ValueError(
+                f"packed 2pc encoding supports up to 10 RMs (got {rm_count})"
+            )
         self.rm_count = rm_count
-        self.width = rm_count + 3
+        self.width = 2
         self.max_actions = 2 + 5 * rm_count
+        #: lane-1 bit offsets
+        self._prep_shift = 2
+        self._msgs_shift = 2 + rm_count
         self.host_model = TwoPhaseSys(rm_count=rm_count)
 
     def cache_key(self):
@@ -48,31 +57,28 @@ class TwoPhaseSysEncoded(EncodedModelBase):
 
     def encode(self, state: TwoPhaseState) -> np.ndarray:
         n = self.rm_count
-        vec = np.zeros(self.width, dtype=np.uint32)
+        lane0 = 0
         for i, rm in enumerate(state.rm_state):
-            vec[i] = rm.value
-        vec[n] = state.tm_state.value
-        prep = 0
+            lane0 |= rm.value << (2 * i)
+        lane1 = state.tm_state.value
         for i, p in enumerate(state.tm_prepared):
             if p:
-                prep |= 1 << i
-        vec[n + 1] = prep
-        msgs = 0
+                lane1 |= 1 << (self._prep_shift + i)
         for m in state.msgs:
             if m == ("commit",):
-                msgs |= 1
+                lane1 |= 1 << self._msgs_shift
             elif m == ("abort",):
-                msgs |= 2
+                lane1 |= 1 << (self._msgs_shift + 1)
             else:
-                msgs |= 1 << (2 + m[1])
-        vec[n + 2] = msgs
-        return vec
+                lane1 |= 1 << (self._msgs_shift + 2 + m[1])
+        return np.array([lane0, lane1], dtype=np.uint32)
 
     def decode(self, vec: np.ndarray) -> TwoPhaseState:
         n = self.rm_count
         vec = np.asarray(vec)
+        lane0, lane1 = int(vec[0]), int(vec[1])
         msgs = set()
-        m = int(vec[n + 2])
+        m = lane1 >> self._msgs_shift
         if m & 1:
             msgs.add(("commit",))
         if m & 2:
@@ -81,10 +87,13 @@ class TwoPhaseSysEncoded(EncodedModelBase):
             if m & (1 << (2 + i)):
                 msgs.add(("prepared", i))
         return TwoPhaseState(
-            rm_state=tuple(RmState(int(vec[i])) for i in range(n)),
-            tm_state=TmState(int(vec[n])),
+            rm_state=tuple(
+                RmState((lane0 >> (2 * i)) & 3) for i in range(n)
+            ),
+            tm_state=TmState(lane1 & 3),
             tm_prepared=tuple(
-                bool(int(vec[n + 1]) & (1 << i)) for i in range(n)
+                bool(lane1 & (1 << (self._prep_shift + i)))
+                for i in range(n)
             ),
             msgs=frozenset(msgs),
         )
@@ -97,60 +106,70 @@ class TwoPhaseSysEncoded(EncodedModelBase):
     # -- device side -----------------------------------------------------
 
     def step_vec(self, vec):
-        """uint32[W] -> (uint32[K, W], bool[K]); mirrors 2pc.rs
-        actions()/next_state() as branchless lane updates."""
+        """uint32[2] -> (uint32[K, 2], bool[K]); mirrors 2pc.rs
+        actions()/next_state() as branchless bitfield updates."""
         import jax.numpy as jnp
 
         n = self.rm_count
-        tm = vec[n]
-        prep = vec[n + 1]
-        msgs = vec[n + 2]
+        ps, ms = self._prep_shift, self._msgs_shift
+        lane0, lane1 = vec[0], vec[1]
+        tm = lane1 & jnp.uint32(3)
+        prep = (lane1 >> jnp.uint32(ps)) & jnp.uint32((1 << n) - 1)
+        commit_bit = jnp.uint32(1 << ms)
+        abort_bit = jnp.uint32(1 << (ms + 1))
         full_prep = jnp.uint32((1 << n) - 1)
-
-        def set_lane(v, lane, value):
-            return v.at[lane].set(jnp.uint32(value))
 
         succs = []
         valids = []
 
+        def with_tm(l1, value):
+            return (l1 & ~jnp.uint32(3)) | jnp.uint32(value)
+
         # tm_commit: all prepared & TM still deciding.
-        s = set_lane(vec, n, _TM_COMMITTED)
-        s = s.at[n + 2].set(msgs | jnp.uint32(1))
-        succs.append(s)
+        succs.append(
+            jnp.stack([lane0, with_tm(lane1, _TM_COMMITTED) | commit_bit])
+        )
         valids.append((tm == _INIT) & (prep == full_prep))
 
         # tm_abort
-        s = set_lane(vec, n, _TM_ABORTED)
-        s = s.at[n + 2].set(msgs | jnp.uint32(2))
-        succs.append(s)
+        succs.append(
+            jnp.stack([lane0, with_tm(lane1, _TM_ABORTED) | abort_bit])
+        )
         valids.append(tm == _INIT)
 
         for rm in range(n):
-            rm_working = vec[rm] == _WORKING
-            prepared_bit = jnp.uint32(1 << (2 + rm))
+            rm_state = (lane0 >> jnp.uint32(2 * rm)) & jnp.uint32(3)
+            rm_working = rm_state == _WORKING
+            prepared_bit = jnp.uint32(1 << (ms + 2 + rm))
+
+            def with_rm(l0, value):
+                return (l0 & ~jnp.uint32(3 << (2 * rm))) | jnp.uint32(
+                    value << (2 * rm)
+                )
 
             # tm_rcv_prepared(rm)
-            s = vec.at[n + 1].set(prep | jnp.uint32(1 << rm))
-            succs.append(s)
-            valids.append((tm == _INIT) & ((msgs & prepared_bit) != 0))
+            succs.append(
+                jnp.stack([lane0, lane1 | jnp.uint32(1 << (ps + rm))])
+            )
+            valids.append((tm == _INIT) & ((lane1 & prepared_bit) != 0))
 
             # rm_prepare(rm)
-            s = set_lane(vec, rm, _PREPARED)
-            s = s.at[n + 2].set(msgs | prepared_bit)
-            succs.append(s)
+            succs.append(
+                jnp.stack([with_rm(lane0, _PREPARED), lane1 | prepared_bit])
+            )
             valids.append(rm_working)
 
             # rm_choose_abort(rm)
-            succs.append(set_lane(vec, rm, _ABORTED))
+            succs.append(jnp.stack([with_rm(lane0, _ABORTED), lane1]))
             valids.append(rm_working)
 
             # rm_rcv_commit(rm)
-            succs.append(set_lane(vec, rm, _COMMITTED))
-            valids.append((msgs & jnp.uint32(1)) != 0)
+            succs.append(jnp.stack([with_rm(lane0, _COMMITTED), lane1]))
+            valids.append((lane1 & commit_bit) != 0)
 
             # rm_rcv_abort(rm)
-            succs.append(set_lane(vec, rm, _ABORTED))
-            valids.append((msgs & jnp.uint32(2)) != 0)
+            succs.append(jnp.stack([with_rm(lane0, _ABORTED), lane1]))
+            valids.append((lane1 & abort_bit) != 0)
 
         return jnp.stack(succs), jnp.stack(valids)
 
@@ -160,7 +179,9 @@ class TwoPhaseSysEncoded(EncodedModelBase):
         import jax.numpy as jnp
 
         n = self.rm_count
-        rms = vec[:n]
+        rms = (
+            vec[0] >> (2 * jnp.arange(n, dtype=jnp.uint32))
+        ) & jnp.uint32(3)
         all_aborted = jnp.all(rms == _ABORTED)
         all_committed = jnp.all(rms == _COMMITTED)
         consistent = ~(

@@ -120,3 +120,34 @@ def test_sortmerge_fast_mode_and_targets():
     )
     assert sm.unique_state_count() == ht.unique_state_count()
     assert sm.max_depth() == 5
+
+
+@pytest.mark.parametrize("tiles", [2, 4])
+def test_sortmerge_tiled_matches_untiled(tiles):
+    """The tiled expansion path (frontier split into per-wave tiles)
+    produces identical results to tiles=1."""
+    base = (
+        TwoPhaseSys(rm_count=3)
+        .checker()
+        .spawn_tpu_sortmerge(
+            capacity=512, frontier_capacity=128, cand_capacity=1024
+        )
+        .join()
+    )
+    tiled = (
+        TwoPhaseSys(rm_count=3)
+        .checker()
+        .spawn_tpu_sortmerge(
+            capacity=512,
+            frontier_capacity=128,
+            cand_capacity=1024,
+            tiles=tiles,
+        )
+        .join()
+    )
+    assert tiled.unique_state_count() == base.unique_state_count() == 288
+    assert tiled.state_count() == base.state_count()
+    assert sorted(tiled.discoveries()) == sorted(base.discoveries())
+    for name, path in tiled.discoveries().items():
+        prop = tiled.model.property_by_name(name)
+        assert prop.condition(tiled.model, path.last_state())

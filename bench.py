@@ -146,7 +146,7 @@ def tpu_workloads(quick=False):
                     8,
                     capacity=1 << 21,
                     frontier_capacity=1 << 19,
-                    cand_capacity=1 << 22,
+                    cand_capacity=3 << 20,
                 ),
                 1745408,
             )

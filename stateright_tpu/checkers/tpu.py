@@ -95,7 +95,8 @@ def _combine64(lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
     return (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
 
 
-def expand_frontier(enc, props, evt_idx, frontier, fval, ebits, expand):
+def expand_frontier(enc, props, evt_idx, frontier, fval, ebits, expand,
+                    with_repeats=True):
     """The shared first half of a wave (single-chip and sharded): from a
     frontier block to property verdicts + flattened candidate successors.
 
@@ -111,8 +112,12 @@ def expand_frontier(enc, props, evt_idx, frontier, fval, ebits, expand):
       ``f_lo/f_hi``  uint32[F]    frontier fingerprints
       ``flat``       uint32[F*K, W] candidate successors
       ``v``          bool[F*K]    candidate validity
+    and, only when ``with_repeats=True``:
       ``p_lo/p_hi``  uint32[F*K]  parent (frontier) fingerprints per candidate
       ``child_ebits`` uint32[F*K] ebits each candidate inherits
+    (callers that index per-candidate data by ``row // K`` at the end of
+    the wave — the adaptive sort-merge engine — pass False to skip
+    materializing these F*K arrays)
     """
     import jax
     import jax.numpy as jnp
@@ -144,7 +149,7 @@ def expand_frontier(enc, props, evt_idx, frontier, fval, ebits, expand):
     terminal = fval & ~jnp.any(valid, axis=1) & expand
     evt_cex = terminal & (ebits != 0)
 
-    return dict(
+    out = dict(
         cond=cond,
         ebits=ebits,
         evt_cex=evt_cex,
@@ -152,10 +157,12 @@ def expand_frontier(enc, props, evt_idx, frontier, fval, ebits, expand):
         f_hi=f_hi,
         flat=succs.reshape(F * K, W),
         v=valid.reshape(F * K),
-        p_lo=jnp.repeat(f_lo, K),
-        p_hi=jnp.repeat(f_hi, K),
-        child_ebits=jnp.repeat(ebits, K),
     )
+    if with_repeats:
+        out["p_lo"] = jnp.repeat(f_lo, K)
+        out["p_hi"] = jnp.repeat(f_hi, K)
+        out["child_ebits"] = jnp.repeat(ebits, K)
+    return out
 
 
 def wave_hits(props, ex, fval):

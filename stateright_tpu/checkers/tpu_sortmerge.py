@@ -1,36 +1,60 @@
-"""The sort-merge wave engine: dedup without scatters.
+"""The adaptive sort-merge wave engine: dedup without scatters, sized
+to the running wave.
 
-TPU microbenchmarks (v5e, this repo's stage ablation) show the hash
+TPU microbenchmarks (v5e, ``tools/profile_sortmerge.py``) show the hash
 table engine's cost profile is inverted on TPU hardware: arbitrary-
 index scatter/gather — the heart of GPU-style open-addressing
-(ops/hashset.py) — runs at ~2M rows per 100ms, while ``lax.sort``
-moves 2M 2-lane rows in 1.8ms. XLA:TPU lowers scatters to serialized
-updates; sorts are native and fast. So this engine re-architects the
-wave around sorts, the classic vector-machine model-checking layout:
+(ops/hashset.py) — runs ~10ns/row, and a 21-step binary search over a
+sorted 2M-row array costs 2,085ms for 4M queries (sequential gathers),
+while ``lax.sort`` moves 4M 3-lane rows in 6.5ms. XLA:TPU lowers
+scatters to serialized updates; sorts are native and fast. So this
+engine architects the wave around sorts, the classic vector-machine
+model-checking layout:
 
 * The visited set is a **sorted fingerprint array** (two uint32 limb
   lanes, all-ones sentinel padding), not a hash table.
-* Per wave: fingerprint all padded candidates (elementwise) →
-  **sort#1** ``(hi, lo, row)`` compacts valid candidates to the B
-  lowest keys (invalid rows carry sentinel keys and sort last) → one
-  B-row payload gather → **sort#2** merges candidate keys with the
-  visited array (stable, visited first, so first-of-run marks the
-  winner and intra-wave duplicates resolve for free) → **sort#3**
-  rebuilds the deduplicated visited array (losers sentinelized, slice
-  back to capacity) → **sort#4** compacts the new states' positions
-  for the next frontier, followed by small F-row gathers.
+* Per wave: vmap-expand the frontier → fingerprint candidates →
+  compact the valid candidates (tiled top-B sorts) → one stable merge
+  sort against the visited prefix (visited first, so first-of-run
+  marks the winner and intra-wave duplicates resolve for free) →
+  rebuild the deduplicated visited array → compact the new states
+  into the next frontier.
 * The parent forest is an **append-only device log** of
   (child, parent) fingerprint pairs written with
   ``dynamic_update_slice`` — contiguous writes, no scatter — drained
   lazily on the host only when a counterexample path is reconstructed.
 
+**Adaptive wave sizing (round 3).** The round-2 engine compiled ONE
+wave program at worst-case shapes, so every wave paid peak cost: the
+2pc rm=8 profile showed a flat ~365ms/wave whether the wave produced
+2 or 244,342 new states (tools/profile_sortmerge.py), dominated by a
+22M-row sort over the full F×K candidate tensor and a 4M-row payload
+gather. This engine instead compiles a LADDER of wave-body variants
+and dispatches per wave with ``lax.switch`` — still inside the
+device-resident ``lax.while_loop``, so the host still syncs only once
+per chunk:
+
+* **frontier class** — the frontier is always a compacted prefix, so
+  a wave with n live rows runs the smallest variant with F_c ≥ n:
+  expansion, fingerprinting, and candidate compaction all scale with
+  the running wave, not the worst one.
+* **visited class** — the visited array is sorted with sentinel
+  padding, so only the prefix holding the current unique count needs
+  to participate in the merge; the merge stage is a nested switch
+  over visited-prefix sizes.
+* **tiling** — within a class, candidate compaction runs as NT
+  per-tile top-B sorts (lax.sort is superlinear: 22M rows cost 109ms
+  where 16×1.4M cost ~40ms).
+* **full-flat mode** — when the class's F×K×W successor tensor fits
+  the memory budget it is kept alive through the merge, and only the
+  ≤F winning rows are gathered at the end of the wave (the round-2
+  engine gathered all B candidate payloads every wave: ~10ns/row ≈
+  40ms/wave at rm=8). Classes too big for the budget fall back to
+  per-tile payload gathers.
+
 Everything else — the device-resident multi-wave ``lax.while_loop``,
 packed-stats chunk sync, properties/EventuallyBits/discovery logic —
 is shared with :mod:`stateright_tpu.checkers.tpu`.
-
-Measured (2pc rm=7, 296,448 states, warm, one v5e chip): the hash
-table engine runs ~390ms/wave; this engine's stage budget is ~20ms/wave
-(see bench.py for recorded end-to-end numbers).
 """
 
 from __future__ import annotations
@@ -49,6 +73,25 @@ from .tpu import (
 _SENT = 0xFFFFFFFF
 
 
+def _ladder(lo: int, hi: int, step: int) -> list[int]:
+    """Geometric size ladder [min(lo,hi), ..., hi] with ratio `step`."""
+    vals = []
+    v = min(lo, hi)
+    while v < hi:
+        vals.append(v)
+        v *= step
+    vals.append(hi)
+    return vals
+
+
+def _divisor_at_least(n: int, want: int) -> int:
+    """Smallest divisor of n that is ≥ want (≤ n)."""
+    d = max(min(want, n), 1)
+    while n % d:
+        d += 1
+    return d
+
+
 class SortMergeTpuBfsChecker(TpuBfsChecker):
     """``CheckerBuilder.spawn_tpu_sortmerge()``.
 
@@ -56,37 +99,67 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
     there is no load-factor pressure: it can sit at exactly the
     expected unique-state count (overflow is detected, not silent).
 
-    ``tiles`` splits the frontier into that many expansion tiles
-    processed sequentially inside each wave: peak memory for the flat
-    successor tensor drops from ``F*K*W`` to ``(F/tiles)*K*W`` lanes,
-    which is what lets 10⁷-10⁸-state spaces (2pc rm=9/10) fit on one
-    chip. The candidate budget is per-tile: each tile may contribute at
-    most ``cand_capacity/tiles`` valid successors (overflow detected).
+    ``cand_capacity`` is the per-wave candidate budget for the LARGEST
+    frontier class; smaller classes use min(F_c*K, cand_capacity).
+    Overflow is detected per expansion tile, never silent.
+
+    ``tiles`` forces at least that many expansion tiles on the largest
+    frontier class (smaller classes tile automatically so no single
+    compaction sort exceeds ``tile_rows`` rows).
+
+    ``f_min``/``v_min``/``ladder_step`` shape the adaptive ladders; a
+    small model (F ≤ f_min, capacity ≤ v_min) degenerates to a single
+    fixed-shape wave program, which is also the fallback the test
+    suite exercises at toy scale.
     """
 
-    def __init__(self, builder, tiles: int = 1, **kwargs):
+    def __init__(
+        self,
+        builder,
+        tiles: int = 1,
+        tile_rows: int = 1 << 21,
+        f_min: int = 1 << 15,
+        v_min: int = 1 << 19,
+        ladder_step: int = 2,
+        v_ladder_step: int = 4,
+        flat_budget_bytes: int = 1 << 30,
+        **kwargs,
+    ):
         super().__init__(builder, **kwargs)
         self.tiles = tiles
-        if self.frontier_capacity % tiles:
+        self.tile_rows = tile_rows
+        self.f_min = f_min
+        self.v_min = v_min
+        self.ladder_step = ladder_step
+        self.v_ladder_step = v_ladder_step
+        self.flat_budget_bytes = flat_budget_bytes
+        if tiles > 1 and self.frontier_capacity % tiles:
             raise ValueError(
                 f"frontier_capacity {self.frontier_capacity} not divisible "
                 f"by tiles {tiles}"
             )
 
     def _cache_extras(self) -> tuple:
-        return ("sortmerge", self.tiles)
+        return (
+            "sortmerge",
+            self.tiles,
+            self.tile_rows,
+            self.f_min,
+            self.v_min,
+            self.ladder_step,
+            self.v_ladder_step,
+            self.flat_budget_bytes,
+        )
 
     def _maybe_warn_occupancy(self, occupancy: float) -> None:
         """No probe pressure: the sorted array works at 100% occupancy
         and overflow is detected exactly — nothing to warn about."""
 
     def _cand_overflow_message(self) -> str:
-        fk = self.frontier_capacity * self.encoded.max_actions
-        per_tile = -(-min(self.cand_capacity or fk, fk) // self.tiles)
         return (
-            f"candidate-buffer overflow: an expansion tile generated more "
-            f"than {per_tile} valid successors "
-            f"(cand_capacity/tiles = {per_tile}); re-run with a larger "
+            "candidate-buffer overflow: an expansion tile generated more "
+            "valid successors than its per-tile budget "
+            f"(cand_capacity={self.cand_capacity}); re-run with a larger "
             "cand_capacity or fewer tiles"
         )
 
@@ -112,7 +185,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             )
         K, W, F = enc.max_actions, enc.width, self.frontier_capacity
         C = self.capacity
-        B = min(self.cand_capacity or F * K, F * K)
+        B_user = min(self.cand_capacity or F * K, F * K)
         target_states = self.builder._target_state_count
         target_depth = self.builder._target_max_depth
         waves_per_sync = self.waves_per_sync
@@ -121,6 +194,15 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         # Parent log rows: every unique state (≤ C) gets one entry;
         # the F-row block write at a dynamic offset needs headroom.
         L = C + F if track_paths else 0
+
+        # Ladder bottoms are deliberately coarse (waves below f_min
+        # frontier rows are dispatch/sync-dominated, merges below v_min
+        # cost single-digit ms) and the visited ladder is coarser than
+        # the frontier ladder: XLA compile time grows superlinearly in
+        # the number of (f, v) branch combinations, and each visited
+        # step only changes merge-sort row counts.
+        f_ladder = _ladder(self.f_min, F, self.ladder_step)
+        v_ladder = _ladder(self.v_min, C, self.v_ladder_step)
 
         def clamp_keys(lo, hi):
             # All-ones is the visited-array padding sentinel; nudge
@@ -152,6 +234,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 frontier=frontier,
                 fval=fval,
                 ebits=ebits,
+                n_frontier=jnp.uint32(n0),
                 depth=jnp.int32(1),
                 wchunk=jnp.int32(0),
                 waves=jnp.uint32(0),
@@ -164,221 +247,414 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 overflow=jnp.bool_(n0 > C),
                 f_overflow=jnp.bool_(False),
                 c_overflow=jnp.bool_(False),
+                max_cand=jnp.uint32(0),
+                max_tile_cand=jnp.uint32(0),
                 done=jnp.bool_(n0 == 0),
             )
 
-        NT = self.tiles
-        T = F // NT
-        # Round the per-tile budget up so the user's cand_capacity is a
-        # floor, never silently truncated.
-        Bt = -(-B // NT)
-        B_eff = Bt * NT
+        def class_params(fc: int):
+            """Static per-frontier-class shapes."""
+            F_f = f_ladder[fc]
+            FK = F_f * K
+            B_class = min(FK, B_user)
+            compaction = FK > B_class
+            want_tiles = -(-FK // self.tile_rows)
+            if F_f == F:
+                want_tiles = max(want_tiles, self.tiles)
+            NT = _divisor_at_least(F_f, want_tiles)
+            T = F_f // NT
+            # Per-tile budget gets slack over the even split (25% plus
+            # a floor): candidates skew across tiles, and cand_capacity
+            # is a WHOLE-WAVE contract — a tile must not overflow where
+            # the untiled engine wouldn't. Capped at the lossless T*K.
+            Bt = -(-B_class // NT)
+            if NT > 1:
+                Bt += max(8192, Bt // 4)
+            Bt = min(Bt, T * K)
+            B_eff = Bt * NT
+            full_flat = FK * W * 4 <= self.flat_budget_bytes
+            return F_f, FK, NT, T, Bt, B_eff, compaction, full_flat
+
+        def make_merge(c, vc, B_eff, ck_lo, ck_hi, fetch, n_cand,
+                       disc_found, disc_lo, disc_hi, c_overflow,
+                       max_tile_cand):
+            """The merge stage for visited-prefix class vc: one stable
+            3-lane merge sort (visited-first ⇒ first-of-run wins and
+            intra-wave duplicates resolve for free), a 2-lane rebuild
+            sort, and a 1-lane frontier-compaction sort."""
+            V_v = v_ladder[vc]
+            M = V_v + B_eff
+
+            def merge(_):
+                m_hi = jnp.concatenate([c["v_hi"][:V_v], ck_hi])
+                m_lo = jnp.concatenate([c["v_lo"][:V_v], ck_lo])
+                m_pos = jnp.concatenate(
+                    [
+                        jnp.zeros(V_v, jnp.uint32),
+                        jnp.arange(1, B_eff + 1, dtype=jnp.uint32),
+                    ]
+                )
+                m_hi, m_lo, m_pos = lax.sort(
+                    (m_hi, m_lo, m_pos), num_keys=2
+                )
+                real = ~(
+                    (m_hi == jnp.uint32(_SENT)) & (m_lo == jnp.uint32(_SENT))
+                )
+                prev_same = jnp.concatenate(
+                    [
+                        jnp.zeros(1, bool),
+                        (m_hi[1:] == m_hi[:-1]) & (m_lo[1:] == m_lo[:-1]),
+                    ]
+                )
+                is_new = real & ~prev_same & (m_pos > 0)
+                new_count = jnp.sum(is_new)
+
+                # Rebuild the visited prefix: duplicate-run losers
+                # become sentinels, then the lowest keys are the set.
+                u_hi = jnp.where(prev_same, jnp.uint32(_SENT), m_hi)
+                u_lo = jnp.where(prev_same, jnp.uint32(_SENT), m_lo)
+                u_hi, u_lo = lax.sort((u_hi, u_lo), num_keys=2)
+                if M <= C:
+                    # u + new ≤ V_v + B_eff ≤ C: overflow impossible.
+                    v_hi_new = lax.dynamic_update_slice(
+                        c["v_hi"], u_hi, (0,)
+                    )
+                    v_lo_new = lax.dynamic_update_slice(
+                        c["v_lo"], u_lo, (0,)
+                    )
+                    overflow = c["overflow"]
+                else:
+                    overflow = c["overflow"] | ~(
+                        (u_hi[C] == jnp.uint32(_SENT))
+                        & (u_lo[C] == jnp.uint32(_SENT))
+                    )
+                    v_hi_new, v_lo_new = u_hi[:C], u_lo[:C]
+
+                # Compact the new states' candidate positions into the
+                # next frontier (new rows first, in candidate order).
+                nf_pos = jnp.where(is_new, m_pos, jnp.uint32(_SENT))
+                (nf_pos,) = lax.sort((nf_pos,), num_keys=1)
+                if M >= F:
+                    nf_pos = nf_pos[:F]
+                else:
+                    nf_pos = jnp.concatenate(
+                        [nf_pos, jnp.full(F - M, _SENT, jnp.uint32)]
+                    )
+                nf_valid = jnp.arange(F) < new_count
+                f_overflow = c["f_overflow"] | (new_count > F)
+                nf_row = jnp.where(nf_valid, nf_pos - 1, jnp.uint32(0))
+                state_rows, par_lo, par_hi, row_ebits = fetch(nf_row)
+                next_frontier = jnp.where(
+                    nf_valid[:, None], state_rows, jnp.uint32(0)
+                )
+                next_ebits = jnp.where(nf_valid, row_ebits, 0)
+
+                # Parent-log append: contiguous block write at the
+                # running offset (no scatter); rows past new_count are
+                # garbage that the next wave's block overwrites.
+                if track_paths:
+                    nc_lo = jnp.where(nf_valid, ck_lo[nf_row], 0)
+                    nc_hi = jnp.where(nf_valid, ck_hi[nf_row], 0)
+                    np_lo = jnp.where(nf_valid, par_lo, 0)
+                    np_hi = jnp.where(nf_valid, par_hi, 0)
+                    off = (c["pl_n"],)
+                    pl_child_lo = lax.dynamic_update_slice(
+                        c["pl_child_lo"], nc_lo, off
+                    )
+                    pl_child_hi = lax.dynamic_update_slice(
+                        c["pl_child_hi"], nc_hi, off
+                    )
+                    pl_par_lo = lax.dynamic_update_slice(
+                        c["pl_par_lo"], np_lo, off
+                    )
+                    pl_par_hi = lax.dynamic_update_slice(
+                        c["pl_par_hi"], np_hi, off
+                    )
+                    pl_n = c["pl_n"] + new_count.astype(jnp.uint32)
+                else:
+                    pl_child_lo = c["pl_child_lo"]
+                    pl_child_hi = c["pl_child_hi"]
+                    pl_par_lo = c["pl_par_lo"]
+                    pl_par_hi = c["pl_par_hi"]
+                    pl_n = c["pl_n"]
+
+                g = u64_add(
+                    U64(c["gen_lo"], c["gen_hi"]),
+                    U64(n_cand.astype(jnp.uint32), jnp.uint32(0)),
+                )
+                new = c["new"] + new_count.astype(jnp.uint32)
+                all_disc = (
+                    jnp.all(disc_found) if n_props else jnp.bool_(False)
+                )
+                if target_states is None:
+                    target_hit = jnp.bool_(False)
+                else:
+                    target_hit = new >= jnp.uint32(target_states)
+                cont = (
+                    (new_count > 0)
+                    & ~all_disc
+                    & ~target_hit
+                    & ~overflow
+                    & ~f_overflow
+                    & ~c_overflow
+                )
+                return dict(
+                    v_lo=v_lo_new,
+                    v_hi=v_hi_new,
+                    pl_child_lo=pl_child_lo,
+                    pl_child_hi=pl_child_hi,
+                    pl_par_lo=pl_par_lo,
+                    pl_par_hi=pl_par_hi,
+                    pl_n=pl_n,
+                    frontier=next_frontier,
+                    fval=nf_valid & cont,
+                    ebits=next_ebits,
+                    n_frontier=jnp.where(
+                        cont, new_count.astype(jnp.uint32), jnp.uint32(0)
+                    ),
+                    depth=jnp.where(cont, c["depth"] + 1, c["depth"]),
+                    wchunk=c["wchunk"] + 1,
+                    waves=c["waves"] + 1,
+                    gen_lo=g.lo,
+                    gen_hi=g.hi,
+                    new=new,
+                    disc_found=disc_found,
+                    disc_lo=disc_lo,
+                    disc_hi=disc_hi,
+                    overflow=overflow,
+                    f_overflow=f_overflow,
+                    c_overflow=c_overflow,
+                    max_cand=jnp.maximum(c["max_cand"], n_cand),
+                    max_tile_cand=max_tile_cand,
+                    done=~cont,
+                )
+
+            return merge
+
+        def make_wave(fc: int, v_class):
+            F_f, FK, NT, T, Bt, B_eff, compaction, full_flat = class_params(
+                fc
+            )
+
+            def wave(c):
+                if target_depth is None:
+                    expand = jnp.bool_(True)
+                else:
+                    expand = c["depth"] < target_depth
+
+                if full_flat:
+                    # Expand the whole class prefix at once; the F_f*K
+                    # successor tensor stays alive through the merge so
+                    # only the ≤F winning rows are ever gathered.
+                    frontier_f = c["frontier"][:F_f]
+                    fval_f = c["fval"][:F_f]
+                    ebits_f = c["ebits"][:F_f]
+                    ex = expand_frontier(
+                        enc, props, evt_idx, frontier_f, fval_f, ebits_f,
+                        expand, with_repeats=False,
+                    )
+                    disc_found, disc_lo, disc_hi = discovery_update(
+                        props, ex, fval_f,
+                        c["disc_found"], c["disc_lo"], c["disc_hi"],
+                    )
+                    flat, valid = ex["flat"], ex["v"]
+                    k_lo, k_hi = fingerprint_u32v(flat, jnp)
+                    k_lo, k_hi = clamp_keys(k_lo, k_hi)
+                    k_lo = jnp.where(valid, k_lo, jnp.uint32(_SENT))
+                    k_hi = jnp.where(valid, k_hi, jnp.uint32(_SENT))
+                    n_cand = jnp.sum(valid).astype(jnp.uint32)
+                    if compaction:
+                        # Tiled top-B key compaction (sort is
+                        # superlinear: NT small sorts beat one big one).
+                        def tile_body(t, acc):
+                            ck_lo, ck_hi, crow, c_ovf, tmax = acc
+                            off = t * (T * K)
+                            t_lo = lax.dynamic_slice(k_lo, (off,), (T * K,))
+                            t_hi = lax.dynamic_slice(k_hi, (off,), (T * K,))
+                            t_vd = lax.dynamic_slice(
+                                valid, (off,), (T * K,)
+                            )
+                            rows = off.astype(jnp.uint32) + jnp.arange(
+                                T * K, dtype=jnp.uint32
+                            )
+                            tc = jnp.sum(t_vd).astype(jnp.uint32)
+                            tmax = jnp.maximum(tmax, tc)
+                            c_ovf = c_ovf | (tc > Bt)
+                            s_hi, s_lo, s_row = lax.sort(
+                                (t_hi, t_lo, rows), num_keys=2
+                            )
+                            o = t * Bt
+                            ck_lo = lax.dynamic_update_slice(
+                                ck_lo, s_lo[:Bt], (o,)
+                            )
+                            ck_hi = lax.dynamic_update_slice(
+                                ck_hi, s_hi[:Bt], (o,)
+                            )
+                            crow = lax.dynamic_update_slice(
+                                crow, s_row[:Bt], (o,)
+                            )
+                            return ck_lo, ck_hi, crow, c_ovf, tmax
+
+                        ck_lo, ck_hi, crow, c_overflow, tile_max = (
+                            lax.fori_loop(
+                                0,
+                                NT,
+                                tile_body,
+                                (
+                                    jnp.full(B_eff, _SENT, jnp.uint32),
+                                    jnp.full(B_eff, _SENT, jnp.uint32),
+                                    jnp.zeros(B_eff, jnp.uint32),
+                                    c["c_overflow"],
+                                    jnp.uint32(0),
+                                ),
+                            )
+                        )
+                    else:
+                        ck_lo, ck_hi = k_lo, k_hi
+                        crow = jnp.arange(FK, dtype=jnp.uint32)
+                        c_overflow = c["c_overflow"]
+                        tile_max = n_cand
+
+                    def fetch(nf_row):
+                        srow = crow[nf_row]
+                        prow = srow // jnp.uint32(K)
+                        return (
+                            flat[srow],
+                            ex["f_lo"][prow] if track_paths else None,
+                            ex["f_hi"][prow] if track_paths else None,
+                            ex["ebits"][prow],
+                        )
+
+                    cand_B = B_eff if compaction else FK
+                    return lax.switch(
+                        v_class,
+                        [
+                            make_merge(
+                                c, vc, cand_B, ck_lo, ck_hi, fetch,
+                                n_cand, disc_found, disc_lo, disc_hi,
+                                c_overflow,
+                                jnp.maximum(c["max_tile_cand"], tile_max),
+                            )
+                            for vc in range(len(v_ladder))
+                        ],
+                        0,
+                    )
+
+                # Per-tile payload path (successor tensor too big to
+                # keep): expansion, fingerprinting, compaction, and a
+                # Bt-row payload gather all happen inside each tile.
+                def tile_body(t, acc):
+                    (
+                        ck_lo, ck_hi, cst, cplo, cphi, ceb,
+                        dfound, dlo, dhi, n_cand, c_ovf, tmax,
+                    ) = acc
+                    off = t * T
+                    tf = lax.dynamic_slice(c["frontier"], (off, 0), (T, W))
+                    tfv = lax.dynamic_slice(c["fval"], (off,), (T,))
+                    teb = lax.dynamic_slice(c["ebits"], (off,), (T,))
+                    ex = expand_frontier(
+                        enc, props, evt_idx, tf, tfv, teb, expand,
+                        with_repeats=False,
+                    )
+                    dfound, dlo, dhi = discovery_update(
+                        props, ex, tfv, dfound, dlo, dhi
+                    )
+                    flat, valid = ex["flat"], ex["v"]
+                    k_lo, k_hi = fingerprint_u32v(flat, jnp)
+                    k_lo, k_hi = clamp_keys(k_lo, k_hi)
+                    k_lo = jnp.where(valid, k_lo, jnp.uint32(_SENT))
+                    k_hi = jnp.where(valid, k_hi, jnp.uint32(_SENT))
+                    t_cand = jnp.sum(valid)
+                    tmax = jnp.maximum(tmax, t_cand.astype(jnp.uint32))
+                    c_ovf = c_ovf | (t_cand > Bt)
+                    rows = jnp.arange(T * K, dtype=jnp.uint32)
+                    s_hi, s_lo, s_row = lax.sort(
+                        (k_hi, k_lo, rows), num_keys=2
+                    )
+                    s_hi, s_lo, s_row = s_hi[:Bt], s_lo[:Bt], s_row[:Bt]
+                    st = flat[s_row]
+                    prow = s_row // jnp.uint32(K)
+                    o = t * Bt
+                    ck_lo = lax.dynamic_update_slice(ck_lo, s_lo, (o,))
+                    ck_hi = lax.dynamic_update_slice(ck_hi, s_hi, (o,))
+                    cst = lax.dynamic_update_slice(cst, st, (o, 0))
+                    if track_paths:
+                        cplo = lax.dynamic_update_slice(
+                            cplo, ex["f_lo"][prow], (o,)
+                        )
+                        cphi = lax.dynamic_update_slice(
+                            cphi, ex["f_hi"][prow], (o,)
+                        )
+                    ceb = lax.dynamic_update_slice(
+                        ceb, ex["ebits"][prow], (o,)
+                    )
+                    return (
+                        ck_lo, ck_hi, cst, cplo, cphi, ceb,
+                        dfound, dlo, dhi,
+                        n_cand + t_cand.astype(jnp.uint32), c_ovf, tmax,
+                    )
+
+                (
+                    ck_lo, ck_hi, b_state, b_par_lo, b_par_hi, b_ebits,
+                    disc_found, disc_lo, disc_hi, n_cand, c_overflow,
+                    tile_max,
+                ) = lax.fori_loop(
+                    0,
+                    NT,
+                    tile_body,
+                    (
+                        jnp.full(B_eff, _SENT, jnp.uint32),
+                        jnp.full(B_eff, _SENT, jnp.uint32),
+                        jnp.zeros((B_eff, W), jnp.uint32),
+                        jnp.zeros(B_eff if track_paths else 0, jnp.uint32),
+                        jnp.zeros(B_eff if track_paths else 0, jnp.uint32),
+                        jnp.zeros(B_eff, jnp.uint32),
+                        c["disc_found"],
+                        c["disc_lo"],
+                        c["disc_hi"],
+                        jnp.uint32(0),
+                        c["c_overflow"],
+                        jnp.uint32(0),
+                    ),
+                )
+
+                def fetch(nf_row):
+                    return (
+                        b_state[nf_row],
+                        b_par_lo[nf_row] if track_paths else None,
+                        b_par_hi[nf_row] if track_paths else None,
+                        b_ebits[nf_row],
+                    )
+
+                return lax.switch(
+                    v_class,
+                    [
+                        make_merge(
+                            c, vc, B_eff, ck_lo, ck_hi, fetch,
+                            n_cand, disc_found, disc_lo, disc_hi,
+                            c_overflow,
+                            jnp.maximum(c["max_tile_cand"], tile_max),
+                        )
+                        for vc in range(len(v_ladder))
+                    ],
+                    0,
+                )
+
+            return wave
 
         def body(c):
-            if target_depth is None:
-                expand = jnp.bool_(True)
-            else:
-                expand = c["depth"] < target_depth
-
-            # Tiled expansion: each tile of T frontier rows expands,
-            # fingerprints, and sort#1-compacts its own candidates into
-            # a Bt-row segment of the shared candidate buffers
-            # (contiguous dynamic_update_slice writes — no scatter).
-            # Only the [T*K, W] tile tensor is ever materialized.
-            def tile_body(t, acc):
-                (
-                    ck_lo, ck_hi, cst, cplo, cphi, ceb,
-                    dfound, dlo, dhi, n_cand, c_overflow,
-                ) = acc
-                off = t * T
-                tf = lax.dynamic_slice(c["frontier"], (off, 0), (T, W))
-                tfv = lax.dynamic_slice(c["fval"], (off,), (T,))
-                teb = lax.dynamic_slice(c["ebits"], (off,), (T,))
-                ex = expand_frontier(
-                    enc, props, evt_idx, tf, tfv, teb, expand
-                )
-                dfound, dlo, dhi = discovery_update(
-                    props, ex, tfv, dfound, dlo, dhi
-                )
-                flat, valid = ex["flat"], ex["v"]
-                k_lo, k_hi = fingerprint_u32v(flat, jnp)
-                k_lo, k_hi = clamp_keys(k_lo, k_hi)
-                k_lo = jnp.where(valid, k_lo, jnp.uint32(_SENT))
-                k_hi = jnp.where(valid, k_hi, jnp.uint32(_SENT))
-                t_cand = jnp.sum(valid)
-                c_overflow = c_overflow | (t_cand > Bt)
-                # Sort#1 (per tile): valid keys have the Bt lowest
-                # values (invalid rows carry the sentinel key).
-                rows = jnp.arange(T * K, dtype=jnp.uint32)
-                s_hi, s_lo, s_row = lax.sort(
-                    (k_hi, k_lo, rows), num_keys=2
-                )
-                s_hi, s_lo, s_row = s_hi[:Bt], s_lo[:Bt], s_row[:Bt]
-                st = flat[s_row]
-                prow = s_row // jnp.uint32(K)
-                o = t * Bt
-                ck_lo = lax.dynamic_update_slice(ck_lo, s_lo, (o,))
-                ck_hi = lax.dynamic_update_slice(ck_hi, s_hi, (o,))
-                cst = lax.dynamic_update_slice(cst, st, (o, 0))
-                if track_paths:
-                    # Parent fingerprints are only needed for the log.
-                    cplo = lax.dynamic_update_slice(
-                        cplo, ex["f_lo"][prow], (o,)
-                    )
-                    cphi = lax.dynamic_update_slice(
-                        cphi, ex["f_hi"][prow], (o,)
-                    )
-                ceb = lax.dynamic_update_slice(
-                    ceb, ex["ebits"][prow], (o,)
-                )
-                return (
-                    ck_lo, ck_hi, cst, cplo, cphi, ceb,
-                    dfound, dlo, dhi, n_cand + t_cand.astype(jnp.uint32),
-                    c_overflow,
-                )
-
-            (
-                s_lo, s_hi, b_state, b_par_lo, b_par_hi, b_ebits,
-                disc_found, disc_lo, disc_hi, n_cand, c_overflow,
-            ) = lax.fori_loop(
-                0,
-                NT,
-                tile_body,
-                (
-                    jnp.full(B_eff, _SENT, jnp.uint32),
-                    jnp.full(B_eff, _SENT, jnp.uint32),
-                    jnp.zeros((B_eff, W), jnp.uint32),
-                    jnp.zeros(B_eff if track_paths else 0, jnp.uint32),
-                    jnp.zeros(B_eff if track_paths else 0, jnp.uint32),
-                    jnp.zeros(B_eff, jnp.uint32),
-                    c["disc_found"],
-                    c["disc_lo"],
-                    c["disc_hi"],
-                    jnp.uint32(0),
-                    c["c_overflow"],
-                ),
-            )
-
-            # Sort#2: merge with the visited array. Stable sort with
-            # the visited keys FIRST in the concatenation means the
-            # first element of every equal-key run is the visited
-            # entry when present — so is_new is first-of-run AND
-            # from-candidates, and intra-wave duplicates resolve to
-            # one winner for free.
-            m_hi = jnp.concatenate([c["v_hi"], s_hi])
-            m_lo = jnp.concatenate([c["v_lo"], s_lo])
-            m_pos = jnp.concatenate(
-                [
-                    jnp.zeros(C, jnp.uint32),
-                    jnp.arange(1, B_eff + 1, dtype=jnp.uint32),
-                ]
-            )
-            m_hi, m_lo, m_pos = lax.sort((m_hi, m_lo, m_pos), num_keys=2)
-            real = ~((m_hi == jnp.uint32(_SENT)) & (m_lo == jnp.uint32(_SENT)))
-            prev_same = jnp.concatenate(
-                [
-                    jnp.zeros(1, bool),
-                    (m_hi[1:] == m_hi[:-1]) & (m_lo[1:] == m_lo[:-1]),
-                ]
-            )
-            is_new = real & ~prev_same & (m_pos > 0)
-            new_count = jnp.sum(is_new)
-
-            # Sort#3: rebuild the visited array — duplicate-run losers
-            # become sentinels, then the C lowest keys are the new set.
-            # Overflow iff a real key lands beyond capacity.
-            u_hi = jnp.where(prev_same, jnp.uint32(_SENT), m_hi)
-            u_lo = jnp.where(prev_same, jnp.uint32(_SENT), m_lo)
-            u_hi, u_lo = lax.sort((u_hi, u_lo), num_keys=2)
-            overflow = c["overflow"] | ~(
-                (u_hi[C] == jnp.uint32(_SENT)) & (u_lo[C] == jnp.uint32(_SENT))
-            )
-            v_hi, v_lo = u_hi[:C], u_lo[:C]
-
-            # Sort#4: compact the new states' candidate positions into
-            # the next frontier (new rows first, in candidate order).
-            nf_pos = jnp.where(is_new, m_pos, jnp.uint32(_SENT))
-            (nf_pos,) = lax.sort((nf_pos,), num_keys=1)
-            nf_pos = nf_pos[:F]
-            nf_valid = jnp.arange(F) < new_count
-            f_overflow = c["f_overflow"] | (new_count > F)
-            nf_row = jnp.where(nf_valid, nf_pos - 1, jnp.uint32(0))
-            next_frontier = b_state[nf_row]
-            next_ebits = jnp.where(nf_valid, b_ebits[nf_row], 0)
-
-            # Parent-log append: contiguous block write at the running
-            # offset (no scatter); rows past new_count are garbage that
-            # the next wave's block overwrites.
-            if track_paths:
-                nc_lo = jnp.where(nf_valid, s_lo[nf_row], 0)
-                nc_hi = jnp.where(nf_valid, s_hi[nf_row], 0)
-                np_lo = jnp.where(nf_valid, b_par_lo[nf_row], 0)
-                np_hi = jnp.where(nf_valid, b_par_hi[nf_row], 0)
-                off = (c["pl_n"],)
-                pl_child_lo = lax.dynamic_update_slice(
-                    c["pl_child_lo"], nc_lo, off
-                )
-                pl_child_hi = lax.dynamic_update_slice(
-                    c["pl_child_hi"], nc_hi, off
-                )
-                pl_par_lo = lax.dynamic_update_slice(
-                    c["pl_par_lo"], np_lo, off
-                )
-                pl_par_hi = lax.dynamic_update_slice(
-                    c["pl_par_hi"], np_hi, off
-                )
-                pl_n = c["pl_n"] + new_count.astype(jnp.uint32)
-            else:
-                pl_child_lo = c["pl_child_lo"]
-                pl_child_hi = c["pl_child_hi"]
-                pl_par_lo = c["pl_par_lo"]
-                pl_par_hi = c["pl_par_hi"]
-                pl_n = c["pl_n"]
-
-            g = u64_add(
-                U64(c["gen_lo"], c["gen_hi"]),
-                U64(n_cand.astype(jnp.uint32), jnp.uint32(0)),
-            )
-            new = c["new"] + new_count.astype(jnp.uint32)
-            all_disc = (
-                jnp.all(disc_found) if n_props else jnp.bool_(False)
-            )
-            if target_states is None:
-                target_hit = jnp.bool_(False)
-            else:
-                target_hit = new >= jnp.uint32(target_states)
-            cont = (
-                (new_count > 0)
-                & ~all_disc
-                & ~target_hit
-                & ~overflow
-                & ~f_overflow
-                & ~c_overflow
-            )
-            return dict(
-                v_lo=v_lo,
-                v_hi=v_hi,
-                pl_child_lo=pl_child_lo,
-                pl_child_hi=pl_child_hi,
-                pl_par_lo=pl_par_lo,
-                pl_par_hi=pl_par_hi,
-                pl_n=pl_n,
-                frontier=next_frontier,
-                fval=nf_valid & cont,
-                ebits=next_ebits,
-                depth=jnp.where(cont, c["depth"] + 1, c["depth"]),
-                wchunk=c["wchunk"] + 1,
-                waves=c["waves"] + 1,
-                gen_lo=g.lo,
-                gen_hi=g.hi,
-                new=new,
-                disc_found=disc_found,
-                disc_lo=disc_lo,
-                disc_hi=disc_hi,
-                overflow=overflow,
-                f_overflow=f_overflow,
-                c_overflow=c_overflow,
-                done=~cont,
+            n_f = c["n_frontier"]
+            u = c["new"]
+            f_class = jnp.int32(0)
+            for F_i in f_ladder[:-1]:
+                f_class = f_class + (n_f > jnp.uint32(F_i)).astype(jnp.int32)
+            v_class = jnp.int32(0)
+            for V_i in v_ladder[:-1]:
+                v_class = v_class + (u > jnp.uint32(V_i)).astype(jnp.int32)
+            return lax.switch(
+                f_class,
+                [make_wave(fc, v_class) for fc in range(len(f_ladder))],
+                c,
             )
 
         def cond(c):
@@ -407,11 +683,17 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     c["disc_found"].astype(jnp.uint32),
                     c["disc_lo"],
                     c["disc_hi"],
+                    jnp.stack([c["max_cand"], c["max_tile_cand"]]),
                 ]
             )
             return c, stats
 
         return jax.jit(seed), jax.jit(chunk, donate_argnums=0)
+
+    def _consume_extra_stats(self, extra: np.ndarray) -> None:
+        if extra.size >= 2:
+            self.metrics["max_wave_candidates"] = int(extra[0])
+            self.metrics["max_tile_candidates"] = int(extra[1])
 
     # -- reconstruction ----------------------------------------------------
 

@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Per-stage / per-wave profile of the sort-merge engine (VERDICT r2 #1).
+
+Two parts:
+
+A. **Wave profile** — run 2pc rm=7/8 with ``waves_per_sync=1`` and a
+   reporter that records wall-clock + unique-count per chunk (= per
+   wave), so we see exactly which waves cost what and how much of the
+   run is peak-wave vs. tail-wave.
+
+B. **Primitive microbench** at the rm=8 shapes — lax.sort at the
+   engine's actual row counts, gathers, and a 2-limb binary-search
+   membership probe (the sort#2/#3 replacement candidate).
+
+The axon-tunneled TPU hides execution behind dispatch (~the same
+0.02ms shows for any op if timed naively) and a host readback costs
+hundreds of ms, so each measured op runs REPS times inside one jitted
+``fori_loop`` (inputs perturbed per iteration so XLA cannot CSE the
+repeats away) and the loop's scalar checksum is fetched once; reported
+time = (total - empty-loop baseline) / REPS.
+
+Usage: python tools/profile_sortmerge.py [--skip-wave] [--skip-micro] [--rm8]
+"""
+
+import argparse
+import time
+
+REPS = 16
+
+
+def _timed_loop(build_body, args, reps=REPS):
+    """Time one application of build_body's op, amortized over `reps`
+    sequential applications inside a single jitted program."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def run(*arrs):
+        def body(i, carry):
+            return build_body(i, carry)
+
+        out = lax.fori_loop(0, reps, body, arrs)
+        return sum(jnp.sum(a[..., :1].astype(jnp.uint32)) for a in out)
+
+    f = jax.jit(run)
+    s = f(*args)
+    float(s)  # warm + compile + fetch
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.monotonic()
+        float(f(*args))
+        best = min(best, time.monotonic() - t0)
+    return best
+
+
+def _baseline(args):
+    """Empty-loop + fetch cost with the same carry shapes."""
+    return _timed_loop(lambda i, c: c, args)
+
+
+def microbench():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    print("\n## primitive microbench (rm=8 shapes, per-op ms, "
+          f"amortized over {REPS} in-loop reps)")
+    key = jax.random.PRNGKey(0)
+
+    def rnd(shape, i=0):
+        return jax.random.bits(jax.random.fold_in(key, i), shape,
+                               dtype=jnp.uint32)
+
+    # lax.sort at engine row counts
+    for n, lanes, label in [
+        (1 << 21, 2, "sort C=2^21 2-lane"),
+        (6 << 20, 3, "sort C+B=6M 3-lane (sort#2)"),
+        (6 << 20, 2, "sort C+B=6M 2-lane (sort#3)"),
+        (22 << 20, 3, "sort F*K=22M 3-lane (sort#1 rm=8 tiles=1)"),
+        (1 << 22, 3, "sort B=4M 3-lane"),
+        (1 << 20, 3, "sort 1M 3-lane"),
+        (1 << 17, 3, "sort 128k 3-lane"),
+    ]:
+        arrs = tuple(rnd((n,), i) for i in range(lanes))
+
+        def body(i, c, lanes=lanes):
+            c0 = c[0] ^ i.astype(jnp.uint32)  # defeat CSE
+            out = lax.sort((c0,) + c[1:], num_keys=min(2, lanes))
+            return out
+
+        dt = _timed_loop(body, arrs) - _baseline(arrs)
+        print(f"  {label:48s} {dt/REPS*1000:8.2f} ms")
+
+    # gathers
+    for src_n, idx_n, w, label in [
+        (22 << 20, 1 << 22, 2, "gather 4M rows W=2 from 22M (st=flat[s_row])"),
+        (1 << 22, 1 << 19, 2, "gather 512k rows W=2 from 4M (next_frontier)"),
+        (1 << 21, 1 << 22, 1, "gather 4M scalars from 2M (binsearch step)"),
+    ]:
+        src = rnd((src_n, w) if w > 1 else (src_n,))
+        idx = jax.random.randint(key, (idx_n,), 0, src_n, dtype=jnp.int32)
+
+        def body(i, c, src_n=src_n):
+            src, idx = c
+            idx2 = (idx + i) % src_n  # defeat CSE
+            g = src[idx2]
+            # fold the gather back into idx so the loop is sequential
+            upd = (jnp.sum(g.astype(jnp.uint32)) & jnp.uint32(1)).astype(
+                jnp.int32)
+            return src, idx + upd
+
+        dt = _timed_loop(body, (src, idx)) - _baseline((src, idx))
+        print(f"  {label:48s} {dt/REPS*1000:8.2f} ms")
+
+    # 2-limb binary-search membership into sorted C=2^21
+    C = 1 << 21
+    v_hi = jnp.sort(rnd((C,), 1))
+    v_lo = rnd((C,), 2)
+    for B, label in [
+        (1 << 22, "binsearch 4M queries into sorted 2M (21 it)"),
+        (1 << 19, "binsearch 512k queries into sorted 2M (21 it)"),
+    ]:
+        q_hi, q_lo = rnd((B,), 3), rnd((B,), 4)
+
+        def body(i, c, B=B):
+            v_hi, v_lo, q_hi, q_lo = c
+            qh = q_hi ^ i.astype(jnp.uint32)
+            lo = jnp.zeros(B, jnp.int32)
+            hi = jnp.full(B, C, jnp.int32)
+
+            def step(_, lh):
+                lo, hi = lh
+                mid = (lo + hi) // 2
+                m_hi, m_lo = v_hi[mid], v_lo[mid]
+                lt = (m_hi < qh) | ((m_hi == qh) & (m_lo < q_lo))
+                return jnp.where(lt, mid + 1, lo), jnp.where(lt, hi, mid)
+
+            lo, hi = lax.fori_loop(0, 21, step, (lo, hi))
+            idx = jnp.clip(lo, 0, C - 1)
+            found = (v_hi[idx] == qh) & (v_lo[idx] == q_lo)
+            return (v_hi, v_lo, q_hi + found.astype(jnp.uint32), q_lo)
+
+        args = (v_hi, v_lo, q_hi, q_lo)
+        dt = _timed_loop(body, args) - _baseline(args)
+        print(f"  {label:48s} {dt/REPS*1000:8.2f} ms")
+
+
+def wave_profile(rm, capacity, frontier_capacity, cand_capacity):
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+    from stateright_tpu.report import Reporter
+
+    rows = []
+
+    class Rec(Reporter):
+        def __init__(self):
+            self.last = time.monotonic()
+
+        def delay(self):
+            return 0.0
+
+        def report_checking(self, data):
+            now = time.monotonic()
+            rows.append((now - self.last, data.unique_states, data.max_depth))
+            self.last = now
+
+    def spawn():
+        return TwoPhaseSys(rm_count=rm).checker().spawn_tpu_sortmerge(
+            track_paths=False,
+            capacity=capacity,
+            frontier_capacity=frontier_capacity,
+            cand_capacity=cand_capacity,
+            waves_per_sync=1,
+        )
+
+    spawn().join()  # warm run (compile)
+    rows.clear()
+    c2 = spawn()
+    rec = Rec()
+    t0 = time.monotonic()
+    c2._ensure_run(rec)
+    total = time.monotonic() - t0
+    print(f"\n## wave profile: 2pc rm={rm}  (total {total:.3f}s incl "
+          f"per-wave sync, unique={c2.unique_state_count()})")
+    prev_u = 0
+    for i, (dt, u, d) in enumerate(rows):
+        print(f"  wave {i:3d}: {dt*1000:8.1f} ms  new={u - prev_u:8d}  "
+              f"unique={u:8d} depth={d}")
+        prev_u = u
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-wave", action="store_true")
+    ap.add_argument("--skip-micro", action="store_true")
+    ap.add_argument("--rm8", action="store_true", help="include rm=8 profile")
+    args = ap.parse_args()
+
+    import jax
+
+    print(f"backend: {jax.devices()}")
+    if not args.skip_micro:
+        microbench()
+    if not args.skip_wave:
+        wave_profile(7, 1 << 19, 1 << 16, 1 << 19)
+        if args.rm8:
+            wave_profile(8, 1 << 21, 1 << 19, 1 << 22)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,962 @@
+"""The actor→encoding compiler: generic ``ActorModel`` → TPU encoding.
+
+This is the framework's counterpart of the reference's generic actor
+bridge (``/root/reference/src/actor/model.rs:214-649``), which is what
+lets *every* actor system run through one code path. Here the same
+genericity targets the TPU wave engines: given an :class:`ActorModel`,
+:func:`compile_actor_model` produces an
+:class:`~stateright_tpu.encoding.EncodedModel` — lane layout,
+``step_vec``, ``encode``, property lanes — with **zero hand-written
+device code** (SURVEY.md §7 step 5, the "#[derive(TpuState)]-style
+codegen").
+
+**How.** Actor systems factorize: a system state is (per-actor local
+states, network multiset, timer sets, crashed bits, history). Each
+component ranges over a domain that is exponentially smaller than the
+product state space. The compiler computes a **component closure** —
+the set of local states each actor can reach, the envelope universe,
+the timer universe, and the history domain — by running the REAL actor
+handlers (``on_start``/``on_msg``/``on_timeout``) and history hooks on
+the host over all (state, envelope) pairs to a fixpoint. The closure
+*overapproximates* per-component reachability (it pairs local states
+with envelopes that may never co-occur in a reachable system state),
+which is sound: unreachable table rows are simply never gathered.
+
+The device step function is then pure table lookups with STATIC
+action-slot layout, mirroring ``ActorModel.actions``/``next_state``
+(actor/model.rs:243-380):
+
+* one Deliver slot per envelope in the universe — valid iff present in
+  the network, dst alive, and the (state, envelope) pair is not a
+  no-op (the model.rs:317-319 pruning, precomputed);
+* one Drop slot per envelope on lossy networks;
+* one Timeout slot per (actor, timer-universe element) — the fired
+  timer's clear plus the handler's timer commands fold into one
+  precomputed mask pair;
+* one Crash slot per actor when ``max_crashes > 0``.
+
+Network sends become precomputed per-(state, envelope) lane deltas
+(OR-masks for duplicating-set semantics, field adds for the
+non-duplicating multiset); history transitions collapse to
+"effect classes" (distinct (incoming-envelope, send-sequence)
+signatures) so the history table is ``|H| × #classes``.
+
+**Properties and boundaries** are declared as *specs*: small functions
+``spec(ctx, jnp) -> bool`` where ``ctx`` offers component-tabulated
+values (:meth:`_SpecCtx.actor_values`, :meth:`_SpecCtx.history_value`,
+:meth:`_SpecCtx.network_any`). The referenced host functions run only
+at compile time, over component domains — never on device.
+
+**Limits** (explicit, checked):
+
+* Ordered (FIFO) networks are not yet compiled — use the hand-encoding
+  path or host checkers.
+* Component domains must close finitely; systems whose local closure
+  diverges under overapproximation (e.g. paxos ballots, which are
+  bounded only by *system*-level reachability) exceed ``max_domain``
+  and fail loudly — those keep hand-written encodings
+  (models/paxos_tpu.py).
+* Non-duplicating envelope counts ride in 8-bit fields (host ``encode``
+  raises past 255; a count that high means the closure bound is wrong).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+from ..encoding import EncodedModelBase
+from ..fingerprint import stable_hash
+from .base import CancelTimer, Cow, Id, Out, Send, SetTimer, is_no_op, \
+    is_no_op_with_timer
+from .model import ActorModel
+from .network import Envelope, Ordered, UnorderedDuplicating, \
+    UnorderedNonDuplicating
+
+
+# -- spec context --------------------------------------------------------
+
+
+class _SpecCtx:
+    """What a property/boundary spec can read, all component-tabulated.
+
+    The same spec body runs in two modes: at compile time the callbacks
+    are evaluated over whole component domains to build tables; at
+    trace time the tables are gathered by the state vector's component
+    indices. Spec authors only see jnp scalars/arrays.
+    """
+
+    def __init__(self, enc: "CompiledActorEncoding", vec, jnp):
+        self._enc = enc
+        self._vec = vec
+        self._jnp = jnp
+
+    def actor_values(self, fn: Callable[[int, Any], Any]):
+        """``jnp.int32[n_actors]`` — fn(i, local_state) per actor,
+        tabulated over each actor's closure domain."""
+        jnp = self._jnp
+        enc = self._enc
+        vals = []
+        for i in range(enc.n):
+            table = jnp.asarray(
+                np.array([int(fn(i, s)) for s in enc.S[i]], dtype=np.int32)
+            )
+            vals.append(table[enc._get_actor_idx(self._vec, i, jnp)])
+        return jnp.stack(vals)
+
+    def history_value(self, fn: Callable[[Any], Any]):
+        """``jnp.int32`` scalar — fn(history), tabulated over the
+        history domain."""
+        jnp = self._jnp
+        enc = self._enc
+        table = jnp.asarray(
+            np.array([int(fn(h)) for h in enc.H], dtype=np.int32)
+        )
+        return table[enc._get_field(self._vec, enc.f_history, jnp)]
+
+    def network_any(self, fn: Callable[[Envelope], bool]):
+        """``jnp.bool_`` — True iff any envelope matching ``fn`` is
+        currently deliverable."""
+        jnp = self._jnp
+        enc = self._enc
+        hit = jnp.bool_(False)
+        for k, env in enumerate(enc.E):
+            if fn(env):
+                hit = hit | (enc._net_count(self._vec, k, jnp) > 0)
+        return hit
+
+    def crashed_count(self):
+        jnp = self._jnp
+        enc = self._enc
+        total = jnp.uint32(0)
+        for i in range(enc.n):
+            total = total + enc._get_field(self._vec, enc.f_crashed[i], jnp)
+        return total
+
+
+PropertySpec = Callable[[_SpecCtx, Any], Any]
+
+
+# -- layout helpers ------------------------------------------------------
+
+
+class _Field:
+    """A bit field at (lane, shift, bits) in the uint32 state vector."""
+
+    __slots__ = ("lane", "shift", "bits")
+
+    def __init__(self, lane: int, shift: int, bits: int):
+        self.lane, self.shift, self.bits = lane, shift, bits
+
+    @property
+    def mask(self) -> int:
+        return ((1 << self.bits) - 1) << self.shift
+
+
+class _LayoutBuilder:
+    def __init__(self):
+        self.lane = 0
+        self.shift = 0
+
+    def add(self, bits: int) -> _Field:
+        if bits > 32:
+            raise ValueError(f"field too wide: {bits} bits")
+        if self.shift + bits > 32:  # fields never straddle lanes
+            self.lane += 1
+            self.shift = 0
+        f = _Field(self.lane, self.shift, bits)
+        self.shift += bits
+        if self.shift == 32:
+            self.lane += 1
+            self.shift = 0
+        return f
+
+    @property
+    def width(self) -> int:
+        return self.lane + (1 if self.shift else 0)
+
+
+def _bits_for(n: int) -> int:
+    return max(1, (n - 1).bit_length()) if n > 1 else 1
+
+
+def _domain_sort_key(value: Any):
+    return (stable_hash(value), repr(value))
+
+
+# -- the compiler --------------------------------------------------------
+
+
+def compile_actor_model(
+    model: ActorModel,
+    properties: Optional[dict[str, PropertySpec]] = None,
+    boundary: Optional[PropertySpec] = None,
+    closure: str = "overapprox",
+    closure_actor_bound: Optional[Callable[[int, Any], bool]] = None,
+    closure_history_bound: Optional[Callable[[Any], bool]] = None,
+    max_domain: int = 1 << 15,
+    closure_max_states: int = 1 << 21,
+) -> "CompiledActorEncoding":
+    """Compile ``model`` into a TPU :class:`EncodedModel`.
+
+    ``properties`` maps each host property name to its device spec;
+    every host property must have one (the encoding must discover the
+    identical property set). ``boundary`` is the device counterpart of
+    ``within_boundary_fn``. ``closure_*_bound`` stop the component
+    closure from expanding values that only occur beyond the boundary
+    (they are kept, unexpanded, so boundary evaluation still sees
+    them — mirroring bfs.rs:279-281, where out-of-boundary successors
+    are pruned before expansion in this engine and the host BFS alike).
+
+    ``closure`` selects how component domains are discovered:
+
+    * ``"overapprox"`` (default) — the fixpoint over all (state,
+      envelope) pairs. No host exploration: the device does ALL the
+      search work. Requires the per-component closure to converge
+      (pass ``closure_*_bound`` for components only bounded by the
+      boundary).
+    * ``"reachable"`` — harvest domains and co-occurring pairs from a
+      host breadth-first exploration at compile time. Always
+      converges when the model does, with minimal domains — the right
+      mode for protocols whose local closure diverges under
+      overapproximation (e.g. ABD timestamps, which are bounded only
+      by system-level reachability). The host explores once; use it
+      as the bootstrap / differential mode, not the scale mode.
+    """
+    return CompiledActorEncoding(
+        model,
+        properties or {},
+        boundary,
+        closure,
+        closure_actor_bound,
+        closure_history_bound,
+        max_domain,
+        closure_max_states,
+    )
+
+
+class CompiledActorEncoding(EncodedModelBase):
+    def __init__(
+        self,
+        model: ActorModel,
+        property_specs: dict[str, PropertySpec],
+        boundary_spec: Optional[PropertySpec],
+        closure_mode: str,
+        closure_actor_bound,
+        closure_history_bound,
+        max_domain: int,
+        closure_max_states: int,
+    ):
+        if closure_mode not in ("overapprox", "reachable"):
+            raise ValueError(f"unknown closure mode {closure_mode!r}")
+        if isinstance(model._init_network, Ordered):
+            raise ValueError(
+                "compile_actor_model does not yet support ordered (FIFO) "
+                "networks; use the host checkers or a hand encoding"
+            )
+        self.model = model
+        self.host_model = model
+        self.n = len(model.actors)
+        self.dup = isinstance(model._init_network, UnorderedDuplicating)
+        self.lossy = model.lossy_network
+        self.max_crashes = model.max_crashes
+        self.max_domain = max_domain
+        self.closure_mode = closure_mode
+        self.closure_max_states = closure_max_states
+        self._actor_bound = closure_actor_bound or (lambda i, s: True)
+        self._history_bound = closure_history_bound or (lambda h: True)
+        self.property_specs = property_specs
+        self.boundary_spec = boundary_spec
+
+        host_props = [p.name for p in model.properties()]
+        missing = [p for p in host_props if p not in property_specs]
+        if missing:
+            raise ValueError(
+                f"no device spec for host properties {missing}; "
+                "compile_actor_model needs a spec per property"
+            )
+
+        self._close()
+        self._build_layout()
+        self._build_tables()
+
+    def cache_key(self):
+        """Identity for compiled-program sharing. Includes the property
+        and boundary spec BODIES (bytecode + captured cell values), not
+        just their names — two compilations with identical domains but
+        different specs must not share a jitted chunk program. A spec
+        whose captured values lack a stable repr over-distinguishes,
+        which costs a recompile, never a wrong verdict."""
+        def spec_fp(fn):
+            if fn is None:
+                return None
+            code = getattr(fn, "__code__", None)
+            if code is None:
+                return repr(fn)
+            cells = tuple(
+                repr(c.cell_contents) for c in (fn.__closure__ or ())
+            )
+            return (code.co_code, repr(code.co_consts), cells)
+
+        return (
+            "actor-compile",
+            self.n,
+            self.dup,
+            self.lossy,
+            self.max_crashes,
+            tuple(tuple(stable_hash(s) for s in S) for S in self.S),
+            tuple(stable_hash(e) for e in self.E),
+            tuple(stable_hash(h) for h in self.H),
+            tuple(
+                (name, spec_fp(fn))
+                for name, fn in sorted(self.property_specs.items())
+            ),
+            spec_fp(self.boundary_spec),
+        )
+
+    # -- closure ---------------------------------------------------------
+
+    def _close(self) -> None:
+        model = self.model
+        init_states = list(model.init_states())
+        if len(init_states) != 1:
+            raise ValueError("ActorModel must have exactly one init state")
+        init = init_states[0]
+        self._init_state = init
+
+        # Domains under construction (dict preserves insertion order;
+        # sorted canonically after the fixpoint).
+        S: list[dict] = [dict() for _ in range(self.n)]
+        E: dict = {}
+        T: list[dict] = [dict() for _ in range(self.n)]
+        H: dict = {}
+        expandable_s: list[dict] = [dict() for _ in range(self.n)]
+        expandable_h: dict = {}
+        work: deque = deque()
+
+        def add_actor_state(i: int, s: Any) -> None:
+            if s not in S[i]:
+                if len(S[i]) >= self.max_domain:
+                    raise RuntimeError(
+                        f"actor {i} local-state closure exceeded "
+                        f"{self.max_domain} values — the component closure "
+                        "diverges (overapproximation pairs states with "
+                        "envelopes that never co-occur; see module "
+                        "docstring). Pass closure_actor_bound, use "
+                        'closure="reachable", raise max_domain, or use '
+                        "a hand encoding."
+                    )
+                S[i][s] = len(S[i])
+                expandable_s[i][s] = bool(self._actor_bound(i, s))
+                work.append(("s", i, s))
+
+        def add_envelope(env: Envelope) -> None:
+            if env not in E:
+                if len(E) >= self.max_domain:
+                    raise RuntimeError(
+                        f"envelope-universe closure exceeded "
+                        f"{self.max_domain} values — see the actor-state "
+                        "divergence notes in the module docstring"
+                    )
+                E[env] = len(E)
+                work.append(("e", env))
+
+        def add_timer(i: int, t: Any) -> None:
+            if t not in T[i]:
+                T[i][t] = len(T[i])
+                work.append(("t", i, t))
+
+        def add_history(h: Any) -> None:
+            if h not in H:
+                if len(H) >= self.max_domain:
+                    raise RuntimeError(
+                        f"history closure exceeded {self.max_domain} values "
+                        "— pass closure_history_bound (mirroring the "
+                        "boundary) or use a hand encoding"
+                    )
+                H[h] = len(H)
+                expandable_h[h] = bool(self._history_bound(h))
+                work.append(("h", h))
+
+        for i, s in enumerate(init.actor_states):
+            add_actor_state(i, s)
+        for env in init.network.iter_deliverable():
+            add_envelope(env)
+        for i, timers in enumerate(init.timers_set):
+            for t in timers:
+                add_timer(i, t)
+        add_history(init.history)
+
+        # Memoized handler transitions, filled during the fixpoint.
+        self._msg_tr: dict = {}    # (i, s, env) -> (s2, noop, sends, tmap)
+        self._tmo_tr: dict = {}    # (i, s, t)  -> (s2, noop, sends, tmap)
+        self._hist_tr: dict = {}   # (h, env|None, sends) -> h2
+
+        def run_msg(i: int, s: Any, env: Envelope):
+            key = (i, s, env)
+            if key in self._msg_tr:
+                return
+            cow = Cow(s)
+            out = Out()
+            try:
+                model.actors[i].on_msg(Id(i), cow, env.src, env.msg, out)
+            except Exception:
+                # The closure overapproximates: this (state, envelope)
+                # pair can be system-unreachable, in which case the
+                # handler may legitimately reject it. Record a no-op
+                # row; if the pair IS reachable the host model crashes
+                # identically and the differential replay flags it.
+                self._msg_tr[key] = (s, True, (), {})
+                return
+            noop = is_no_op(cow, out)
+            sends, tmap = self._fold_commands(Id(i), out)
+            self._msg_tr[key] = (cow.value, noop, sends, tmap)
+            if not noop:
+                add_actor_state(i, cow.value)
+                for send in sends:
+                    add_envelope(send)
+                for t, armed in tmap.items():
+                    if armed:
+                        add_timer(i, t)
+
+        def run_timeout(i: int, s: Any, t: Any):
+            key = (i, s, t)
+            if key in self._tmo_tr:
+                return
+            cow = Cow(s)
+            out = Out()
+            try:
+                model.actors[i].on_timeout(Id(i), cow, t, out)
+            except Exception:
+                self._tmo_tr[key] = (s, True, (), {})
+                return
+            noop = is_no_op_with_timer(cow, out, t)
+            sends, tmap = self._fold_commands(Id(i), out)
+            self._tmo_tr[key] = (cow.value, noop, sends, tmap)
+            if not noop:
+                add_actor_state(i, cow.value)
+                for send in sends:
+                    add_envelope(send)
+                for t2, armed in tmap.items():
+                    if armed:
+                        add_timer(i, t2)
+
+        def run_history(h: Any, env: Optional[Envelope],
+                        sends: tuple) -> None:
+            key = (h, env, sends)
+            if key in self._hist_tr:
+                return
+            h2 = h
+            try:
+                if env is not None:
+                    nh = model._record_msg_in(model.cfg, h2, env)
+                    if nh is not None:
+                        h2 = nh
+                for send in sends:
+                    nh = model._record_msg_out(model.cfg, h2, send)
+                    if nh is not None:
+                        h2 = nh
+            except Exception:
+                # Overapproximated (history, event) pair — e.g. a
+                # double-invoke the real system cannot produce. Self-
+                # loop; unreachable rows are never gathered.
+                h2 = h
+            self._hist_tr[key] = h2
+            add_history(h2)
+
+        if self.closure_mode == "reachable":
+            self._harvest_reachable(
+                model, init, add_actor_state, add_envelope, add_timer,
+                add_history, run_msg, run_timeout, run_history,
+            )
+            work.clear()
+        # Fixpoint: drain the worklist (actor-state / envelope / timer
+        # cross-products), then close the history domain against the
+        # current effect classes; repeat until neither grows.
+        while self.closure_mode == "overapprox":
+            while work:
+                kind, *rest = work.popleft()
+                if kind == "s":
+                    i, s = rest
+                    if not expandable_s[i][s]:
+                        continue
+                    for env in list(E):
+                        if int(env.dst) == i:
+                            run_msg(i, s, env)
+                    for t in list(T[i]):
+                        run_timeout(i, s, t)
+                elif kind == "e":
+                    (env,) = rest
+                    i = int(env.dst)
+                    if i < self.n:
+                        for s in list(S[i]):
+                            if expandable_s[i][s]:
+                                run_msg(i, s, env)
+                elif kind == "t":
+                    i, t = rest
+                    for s in list(S[i]):
+                        if expandable_s[i][s]:
+                            run_timeout(i, s, t)
+                # "h" items just mark domain growth; the history
+                # cross-product runs against effect classes below.
+            classes = self._effect_classes()
+            grew = False
+            for h in list(H):
+                if not expandable_h[h]:
+                    continue
+                for cls in classes:
+                    if (h, cls[0], cls[1]) not in self._hist_tr:
+                        run_history(h, cls[0], cls[1])
+                        grew = True
+            if not work and not grew:
+                break
+
+        self.S = [
+            sorted(S[i], key=_domain_sort_key) for i in range(self.n)
+        ]
+        self.sidx = [
+            {s: k for k, s in enumerate(self.S[i])} for i in range(self.n)
+        ]
+        self.E = sorted(E, key=lambda e: (_domain_sort_key(e)))
+        self.eidx = {e: k for k, e in enumerate(self.E)}
+        self.T = [sorted(T[i], key=_domain_sort_key) for i in range(self.n)]
+        self.tidx = [
+            {t: k for k, t in enumerate(self.T[i])} for i in range(self.n)
+        ]
+        self.H = sorted(H, key=_domain_sort_key)
+        self.hidx = {h: k for k, h in enumerate(self.H)}
+        self._expandable_s = expandable_s
+        self._expandable_h = expandable_h
+
+    def _harvest_reachable(self, model, init, add_actor_state,
+                           add_envelope, add_timer, add_history,
+                           run_msg, run_timeout, run_history) -> None:
+        """Breadth-first host exploration; harvest component domains
+        and exactly the (state, event) pairs that co-occur in reachable
+        system states. Sound for the device engine because it explores
+        the same space: only harvested pairs are ever gathered."""
+        seen = {init}
+        queue = deque([init])
+        while queue:
+            st = queue.popleft()
+            for i, s in enumerate(st.actor_states):
+                add_actor_state(i, s)
+            for env in set(st.network.iter_all()):
+                add_envelope(env)
+            for i, timers in enumerate(st.timers_set):
+                for t in timers:
+                    add_timer(i, t)
+            add_history(st.history)
+            for env in st.network.iter_deliverable():
+                i = int(env.dst)
+                if i < self.n and not st.crashed[i]:
+                    run_msg(i, st.actor_states[i], env)
+                    tr = self._msg_tr[(i, st.actor_states[i], env)]
+                    if not tr[1]:
+                        run_history(st.history, env, tr[2])
+            for i, timers in enumerate(st.timers_set):
+                for t in timers:
+                    run_timeout(i, st.actor_states[i], t)
+                    tr = self._tmo_tr[(i, st.actor_states[i], t)]
+                    if not tr[1]:
+                        run_history(st.history, None, tr[2])
+            for action in model.actions(st):
+                ns = model.next_state(st, action)
+                if ns is None or not model.within_boundary(ns):
+                    continue
+                if ns not in seen:
+                    if len(seen) >= self.closure_max_states:
+                        raise RuntimeError(
+                            f"reachable closure exceeded "
+                            f"{self.closure_max_states} system states; "
+                            "raise closure_max_states or use overapprox "
+                            "mode with bounds"
+                        )
+                    seen.add(ns)
+                    queue.append(ns)
+
+    def _fold_commands(self, id: Id, out: Out):
+        """Sends in emission order + net timer effect (last op wins,
+        mirroring _process_commands's sequential set algebra)."""
+        sends: list[Envelope] = []
+        tmap: dict[Any, bool] = {}
+        for cmd in out.commands:
+            if isinstance(cmd, Send):
+                sends.append(Envelope(id, cmd.dst, cmd.msg))
+            elif isinstance(cmd, SetTimer):
+                tmap[cmd.timer] = True
+            elif isinstance(cmd, CancelTimer):
+                tmap[cmd.timer] = False
+            else:
+                raise TypeError(f"unknown command {cmd!r}")
+        return tuple(sends), tmap
+
+    def _effect_classes(self) -> list:
+        """Distinct (env_in | None, sends) history-event signatures."""
+        seen = {}
+        for (i, s, env), (s2, noop, sends, tmap) in self._msg_tr.items():
+            if not noop:
+                seen.setdefault((env, sends), None)
+        for (i, s, t), (s2, noop, sends, tmap) in self._tmo_tr.items():
+            if not noop:
+                seen.setdefault((None, sends), None)
+        return list(seen)
+
+    # -- layout ----------------------------------------------------------
+
+    def _build_layout(self) -> None:
+        lb = _LayoutBuilder()
+        self.f_actor = [lb.add(_bits_for(len(self.S[i]))) for i in
+                        range(self.n)]
+        self.f_history = lb.add(_bits_for(len(self.H)))
+        self.f_crashed = [lb.add(1) for _ in range(self.n)]
+        self.f_timer = [
+            [lb.add(1) for _ in self.T[i]] for i in range(self.n)
+        ]
+        # Network: 1 bit per envelope (duplicating set) or an 8-bit
+        # count per envelope (non-duplicating multiset).
+        bits = 1 if self.dup else 8
+        self.f_net = [lb.add(bits) for _ in self.E]
+        self.width = lb.width
+        # Per-lane mask of every count field's TOP bit: a successor
+        # with any count ≥ 128 is treated as beyond an implicit bound
+        # and pruned (valid=False) rather than risking a carry into
+        # the neighboring field — the device-side counterpart of
+        # encode()'s loud 8-bit check. Closure-bounded systems stay
+        # far below this.
+        self._net_top_mask = np.zeros(self.width, np.uint32)
+        if not self.dup:
+            for f in self.f_net:
+                self._net_top_mask[f.lane] |= np.uint32(
+                    1 << (f.shift + bits - 1)
+                )
+
+        # Action slots: delivers, drops, timeouts, crashes.
+        self.deliver_slots = [
+            k for k, e in enumerate(self.E) if int(e.dst) < self.n
+        ]
+        self.drop_slots = list(range(len(self.E))) if self.lossy else []
+        self.timeout_slots = [
+            (i, j) for i in range(self.n) for j in range(len(self.T[i]))
+        ]
+        self.crash_slots = (
+            list(range(self.n)) if self.max_crashes > 0 else []
+        )
+        self.max_actions = (
+            len(self.deliver_slots)
+            + len(self.drop_slots)
+            + len(self.timeout_slots)
+            + len(self.crash_slots)
+        )
+        if self.max_actions == 0:
+            self.max_actions = 1  # engines require K >= 1
+
+    # -- tables ----------------------------------------------------------
+
+    def _tr_effects(self, i: int, tr, fired_timer=None):
+        """(next_state_idx, noop, net_delta[W], timer_and[W], timer_or[W],
+        hclass) for one transition record."""
+        s2, noop, sends, tmap = tr
+        next_idx = self.sidx[i][s2] if not noop else 0
+        net_delta = np.zeros(self.width, np.uint32)
+        if not noop:
+            for env in sends:
+                f = self.f_net[self.eidx[env]]
+                if self.dup:
+                    net_delta[f.lane] |= np.uint32(1 << f.shift)
+                else:
+                    net_delta[f.lane] += np.uint32(1 << f.shift)
+        t_and = np.full(self.width, 0xFFFFFFFF, np.uint32)
+        t_or = np.zeros(self.width, np.uint32)
+        if fired_timer is not None:
+            f = self.f_timer[i][self.tidx[i][fired_timer]]
+            t_and[f.lane] &= ~np.uint32(1 << f.shift)
+        if not noop:
+            for t, armed in tmap.items():
+                f = self.f_timer[i][self.tidx[i][t]]
+                if armed:
+                    t_or[f.lane] |= np.uint32(1 << f.shift)
+                    t_and[f.lane] |= np.uint32(1 << f.shift)
+                else:
+                    t_and[f.lane] &= ~np.uint32(1 << f.shift)
+                    t_or[f.lane] &= ~np.uint32(1 << f.shift)
+        return next_idx, noop, net_delta, t_and, t_or
+
+    def _build_tables(self) -> None:
+        classes = self._effect_classes()
+        cls_idx = {c: k for k, c in enumerate(classes)}
+        n_cls = max(1, len(classes))
+
+        # Per deliver slot: tables indexed by the dst actor's state idx.
+        self.tbl_deliver = []
+        for k in self.deliver_slots:
+            env = self.E[k]
+            i = int(env.dst)
+            ns = len(self.S[i])
+            nxt = np.zeros(ns, np.uint32)
+            noop = np.ones(ns, bool)
+            ndl = np.zeros((ns, self.width), np.uint32)
+            tan = np.full((ns, self.width), 0xFFFFFFFF, np.uint32)
+            tor = np.zeros((ns, self.width), np.uint32)
+            hcl = np.zeros(ns, np.uint32)
+            for si, s in enumerate(self.S[i]):
+                tr = self._msg_tr.get((i, s, env))
+                if tr is None:
+                    continue  # unexpandable state: row never used
+                nxt[si], noop[si], ndl[si], tan[si], tor[si] = (
+                    self._tr_effects(i, tr)
+                )
+                if not noop[si]:
+                    hcl[si] = cls_idx[(env, tr[2])]
+            self.tbl_deliver.append((i, k, nxt, noop, ndl, tan, tor, hcl))
+
+        self.tbl_timeout = []
+        for (i, j) in self.timeout_slots:
+            t = self.T[i][j]
+            ns = len(self.S[i])
+            nxt = np.zeros(ns, np.uint32)
+            noop = np.ones(ns, bool)
+            ndl = np.zeros((ns, self.width), np.uint32)
+            tan = np.full((ns, self.width), 0xFFFFFFFF, np.uint32)
+            tor = np.zeros((ns, self.width), np.uint32)
+            hcl = np.zeros(ns, np.uint32)
+            for si, s in enumerate(self.S[i]):
+                tr = self._tmo_tr.get((i, s, t))
+                if tr is None:
+                    continue
+                nxt[si], noop[si], ndl[si], tan[si], tor[si] = (
+                    self._tr_effects(i, tr, fired_timer=t)
+                )
+                if not noop[si]:
+                    hcl[si] = cls_idx[(None, tr[2])]
+            self.tbl_timeout.append((i, j, nxt, noop, ndl, tan, tor, hcl))
+
+        # History table: H × effect classes.
+        self.tbl_history = np.zeros((len(self.H), n_cls), np.uint32)
+        for hi, h in enumerate(self.H):
+            for ci, cls in enumerate(classes):
+                h2 = self._hist_tr.get((h, cls[0], cls[1]))
+                if h2 is not None:
+                    self.tbl_history[hi, ci] = self.hidx[h2]
+
+    # -- field access (host + device) ------------------------------------
+
+    def _get_field(self, vec, f: _Field, xp):
+        return (vec[f.lane] >> xp.uint32(f.shift)) & xp.uint32(
+            (1 << f.bits) - 1
+        )
+
+    def _set_field(self, vec, f: _Field, value, jnp):
+        cleared = vec[f.lane] & ~jnp.uint32(f.mask)
+        return vec.at[f.lane].set(
+            cleared | (value.astype(jnp.uint32) << jnp.uint32(f.shift))
+        )
+
+    def _get_actor_idx(self, vec, i: int, xp):
+        return self._get_field(vec, self.f_actor[i], xp)
+
+    def _net_count(self, vec, k: int, xp):
+        return self._get_field(vec, self.f_net[k], xp)
+
+    # -- host side --------------------------------------------------------
+
+    def encode(self, state) -> np.ndarray:
+        vec = np.zeros(self.width, np.uint32)
+
+        def put(f: _Field, value: int):
+            if value >= (1 << f.bits):
+                raise ValueError(
+                    f"field overflow: {value} in {f.bits} bits (an envelope "
+                    "count above 255 means the closure bounds are wrong)"
+                )
+            vec[f.lane] |= np.uint32(value << f.shift)
+
+        for i, s in enumerate(state.actor_states):
+            try:
+                put(self.f_actor[i], self.sidx[i][s])
+            except KeyError:
+                raise KeyError(
+                    f"actor {i} state outside closure: {s!r}"
+                ) from None
+        put(self.f_history, self.hidx[state.history])
+        for i, crashed in enumerate(state.crashed):
+            put(self.f_crashed[i], int(crashed))
+        for i, timers in enumerate(state.timers_set):
+            for t in timers:
+                put(self.f_timer[i][self.tidx[i][t]], 1)
+        if self.dup:
+            for env in state.network.envelopes:
+                put(self.f_net[self.eidx[env]], 1)
+        else:
+            for env, count in state.network.counts.items():
+                if count >= 128:
+                    raise ValueError(
+                        f"envelope count {count} for {env!r} exceeds the "
+                        "compiled encoding's implicit bound of 127 (the "
+                        "device prunes successors past it)"
+                    )
+                put(self.f_net[self.eidx[env]], count)
+        return vec
+
+    def decode(self, vec):
+        from dataclasses import replace
+
+        vec = np.asarray(vec, dtype=np.uint32)
+        actor_states = tuple(
+            self.S[i][int(self._get_actor_idx(vec, i, np))]
+            for i in range(self.n)
+        )
+        history = self.H[int(self._get_field(vec, self.f_history, np))]
+        crashed = tuple(
+            bool(self._get_field(vec, self.f_crashed[i], np))
+            for i in range(self.n)
+        )
+        timers = tuple(
+            frozenset(
+                t for j, t in enumerate(self.T[i])
+                if self._get_field(vec, self.f_timer[i][j], np)
+            )
+            for i in range(self.n)
+        )
+        if self.dup:
+            net = UnorderedDuplicating(frozenset(
+                e for k, e in enumerate(self.E)
+                if self._net_count(vec, k, np)
+            ))
+        else:
+            net = UnorderedNonDuplicating({
+                e: int(self._net_count(vec, k, np))
+                for k, e in enumerate(self.E)
+                if self._net_count(vec, k, np)
+            })
+        return replace(
+            self._init_state,
+            actor_states=actor_states,
+            network=net,
+            timers_set=timers,
+            crashed=crashed,
+            history=history,
+        )
+
+    def init_vecs(self) -> np.ndarray:
+        return np.stack(
+            [self.encode(s) for s in self.model.init_states()]
+        )
+
+    # -- device side ------------------------------------------------------
+
+    def step_vec(self, vec):
+        import jax.numpy as jnp
+
+        succs, valids = [], []
+        n_crashed = jnp.uint32(0)
+        for i in range(self.n):
+            n_crashed = n_crashed + self._get_field(
+                vec, self.f_crashed[i], jnp
+            )
+        h_idx = self._get_field(vec, self.f_history, jnp)
+        h_table = jnp.asarray(self.tbl_history)
+
+        def apply_transition(i, nxt, noop, ndl, tan, tor, hcl,
+                             extra_net=None):
+            s_idx = self._get_actor_idx(vec, i, jnp)
+            t_noop = jnp.asarray(noop)[s_idx]
+            s = self._set_field(vec, self.f_actor[i],
+                                jnp.asarray(nxt)[s_idx], jnp)
+            delta = jnp.asarray(ndl)[s_idx]
+            if self.dup:
+                s = s | delta
+            else:
+                s = s + delta
+            s = (s & jnp.asarray(tan)[s_idx]) | jnp.asarray(tor)[s_idx]
+            h2 = h_table[h_idx, jnp.asarray(hcl)[s_idx]]
+            s = self._set_field(s, self.f_history, h2, jnp)
+            if extra_net is not None:
+                s = extra_net(s)
+            if not self.dup:
+                poisoned = jnp.any(
+                    (s & jnp.asarray(self._net_top_mask)) != 0
+                )
+                t_noop = t_noop | poisoned
+            return s, t_noop
+
+        # Deliver slots (model.rs:299-351).
+        for (i, k, nxt, noop, ndl, tan, tor, hcl) in self.tbl_deliver:
+            f = self.f_net[k]
+            present = self._net_count(vec, k, jnp) > 0
+            crashed = self._get_field(vec, self.f_crashed[i], jnp) != 0
+
+            def dec_net(s, f=f):
+                if self.dup:
+                    return s  # redeliverable (network.rs:204-206)
+                return self._set_field(
+                    s, f, self._get_field(s, f, jnp) - 1, jnp
+                )
+
+            s, t_noop = apply_transition(
+                i, nxt, noop, ndl, tan, tor, hcl, extra_net=dec_net
+            )
+            succs.append(s)
+            valids.append(present & ~crashed & ~t_noop)
+
+        # Drop slots — lossy networks only (model.rs:246-249).
+        for k in self.drop_slots:
+            f = self.f_net[k]
+            present = self._net_count(vec, k, jnp) > 0
+            if self.dup:
+                s = vec.at[f.lane].set(vec[f.lane] & ~jnp.uint32(f.mask))
+            else:
+                s = self._set_field(
+                    vec, f, self._get_field(vec, f, jnp) - 1, jnp
+                )
+            succs.append(s)
+            valids.append(present)
+
+        # Timeout slots (model.rs:352-371).
+        for idx, (i, j, nxt, noop, ndl, tan, tor, hcl) in enumerate(
+            self.tbl_timeout
+        ):
+            f = self.f_timer[i][j]
+            armed = self._get_field(vec, f, jnp) != 0
+            s, t_noop = apply_transition(i, nxt, noop, ndl, tan, tor, hcl)
+            succs.append(s)
+            valids.append(armed & ~t_noop)
+
+        # Crash slots (model.rs:372-380).
+        for i in self.crash_slots:
+            crashed = self._get_field(vec, self.f_crashed[i], jnp) != 0
+            s = self._set_field(vec, self.f_crashed[i], jnp.uint32(1), jnp)
+            for j in range(len(self.T[i])):
+                f = self.f_timer[i][j]
+                s = s.at[f.lane].set(s[f.lane] & ~jnp.uint32(f.mask))
+            succs.append(s)
+            valids.append(
+                ~crashed & (n_crashed < jnp.uint32(self.max_crashes))
+            )
+
+        if not succs:  # degenerate: no possible actions
+            succs.append(vec)
+            valids.append(jnp.bool_(False))
+        return jnp.stack(succs), jnp.stack(valids)
+
+    def property_conditions_vec(self, vec):
+        import jax.numpy as jnp
+
+        ctx = _SpecCtx(self, vec, jnp)
+        conds = [
+            jnp.asarray(self.property_specs[p.name](ctx, jnp), dtype=bool)
+            for p in self.model.properties()
+        ]
+        if not conds:
+            return jnp.zeros((0,), dtype=bool)
+        return jnp.stack(conds)
+
+    def within_boundary_vec(self, vec):
+        if self.boundary_spec is None:
+            return True
+        import jax.numpy as jnp
+
+        ctx = _SpecCtx(self, vec, jnp)
+        return jnp.asarray(self.boundary_spec(ctx, jnp), dtype=bool)

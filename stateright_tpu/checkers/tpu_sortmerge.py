@@ -101,7 +101,9 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
 
     ``cand_capacity`` is the per-wave candidate budget for the LARGEST
     frontier class; smaller classes use min(F_c*K, cand_capacity).
-    Overflow is detected per expansion tile, never silent.
+    Overflow is never silent: the full-flat path checks the whole-wave
+    candidate count against the budget (packed tile append has no
+    per-tile budget); the per-tile-payload fallback checks per tile.
 
     ``tiles`` forces at least that many expansion tiles on the largest
     frontier class (smaller classes tile automatically so no single
@@ -157,10 +159,11 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
 
     def _cand_overflow_message(self) -> str:
         return (
-            "candidate-buffer overflow: an expansion tile generated more "
-            "valid successors than its per-tile budget "
-            f"(cand_capacity={self.cand_capacity}); re-run with a larger "
-            "cand_capacity or fewer tiles"
+            "candidate-buffer overflow: a wave generated more valid "
+            f"successors than cand_capacity={self.cand_capacity} (or, on "
+            "the per-tile-payload fallback path, one tile exceeded its "
+            "slice of that budget); re-run with a larger cand_capacity — "
+            "the max_wave_candidates metric reports the observed peak"
         )
 
     # -- device programs ---------------------------------------------------
@@ -263,17 +266,21 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 want_tiles = max(want_tiles, self.tiles)
             NT = _divisor_at_least(F_f, want_tiles)
             T = F_f // NT
-            # Per-tile budget gets slack over the even split (25% plus
-            # a floor): candidates skew across tiles, and cand_capacity
-            # is a WHOLE-WAVE contract — a tile must not overflow where
-            # the untiled engine wouldn't. Capped at the lossless T*K.
+            # Non-full-flat (per-tile payload) path: per-tile budget
+            # gets slack over the even split (25% plus a floor) —
+            # candidates skew across tiles. Capped at the lossless T*K.
             Bt = -(-B_class // NT)
             if NT > 1:
                 Bt += max(8192, Bt // 4)
             Bt = min(Bt, T * K)
             B_eff = Bt * NT
+            # Full-flat path: packed tile append needs one tile of
+            # headroom past the whole-wave budget and has NO per-tile
+            # overflow mode.
+            Ba = (B_class + T * K) if compaction else FK
             full_flat = FK * W * 4 <= self.flat_budget_bytes
-            return F_f, FK, NT, T, Bt, B_eff, compaction, full_flat
+            return (F_f, FK, NT, T, Bt, B_eff, Ba, B_class, compaction,
+                    full_flat)
 
         def make_merge(c, vc, B_eff, ck_lo, ck_hi, fetch, n_cand,
                        disc_found, disc_lo, disc_hi, c_overflow,
@@ -432,9 +439,10 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             return merge
 
         def make_wave(fc: int, v_class):
-            F_f, FK, NT, T, Bt, B_eff, compaction, full_flat = class_params(
-                fc
-            )
+            (
+                F_f, FK, NT, T, Bt, B_eff, Ba, B_class, compaction,
+                full_flat,
+            ) = class_params(fc)
 
             def wave(c):
                 if target_depth is None:
@@ -464,10 +472,18 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     k_hi = jnp.where(valid, k_hi, jnp.uint32(_SENT))
                     n_cand = jnp.sum(valid).astype(jnp.uint32)
                     if compaction:
-                        # Tiled top-B key compaction (sort is
-                        # superlinear: NT small sorts beat one big one).
+                        # Tiled key compaction via PACKED APPEND: each
+                        # tile sorts its keys (sort is superlinear: NT
+                        # small sorts beat one big one; sentinel keys
+                        # sort last, so valid rows lead) and writes its
+                        # FULL sorted block at the running valid-count
+                        # offset. Successive contiguous writes overlap
+                        # the previous tile's sentinel tail, so valid
+                        # candidates pack densely and no per-tile
+                        # budget exists to overflow — only the
+                        # whole-wave cand_capacity contract remains.
                         def tile_body(t, acc):
-                            ck_lo, ck_hi, crow, c_ovf, tmax = acc
+                            ck_lo, ck_hi, crow, app_off, tmax = acc
                             off = t * (T * K)
                             t_lo = lax.dynamic_slice(k_lo, (off,), (T * K,))
                             t_hi = lax.dynamic_slice(k_hi, (off,), (T * K,))
@@ -479,35 +495,31 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                             )
                             tc = jnp.sum(t_vd).astype(jnp.uint32)
                             tmax = jnp.maximum(tmax, tc)
-                            c_ovf = c_ovf | (tc > Bt)
                             s_hi, s_lo, s_row = lax.sort(
                                 (t_hi, t_lo, rows), num_keys=2
                             )
-                            o = t * Bt
-                            ck_lo = lax.dynamic_update_slice(
-                                ck_lo, s_lo[:Bt], (o,)
-                            )
-                            ck_hi = lax.dynamic_update_slice(
-                                ck_hi, s_hi[:Bt], (o,)
-                            )
-                            crow = lax.dynamic_update_slice(
-                                crow, s_row[:Bt], (o,)
-                            )
-                            return ck_lo, ck_hi, crow, c_ovf, tmax
+                            o = (app_off,)
+                            ck_lo = lax.dynamic_update_slice(ck_lo, s_lo, o)
+                            ck_hi = lax.dynamic_update_slice(ck_hi, s_hi, o)
+                            crow = lax.dynamic_update_slice(crow, s_row, o)
+                            return ck_lo, ck_hi, crow, app_off + tc, tmax
 
-                        ck_lo, ck_hi, crow, c_overflow, tile_max = (
+                        ck_lo, ck_hi, crow, _app_off, tile_max = (
                             lax.fori_loop(
                                 0,
                                 NT,
                                 tile_body,
                                 (
-                                    jnp.full(B_eff, _SENT, jnp.uint32),
-                                    jnp.full(B_eff, _SENT, jnp.uint32),
-                                    jnp.zeros(B_eff, jnp.uint32),
-                                    c["c_overflow"],
+                                    jnp.full(Ba, _SENT, jnp.uint32),
+                                    jnp.full(Ba, _SENT, jnp.uint32),
+                                    jnp.zeros(Ba, jnp.uint32),
+                                    jnp.uint32(0),
                                     jnp.uint32(0),
                                 ),
                             )
+                        )
+                        c_overflow = c["c_overflow"] | (
+                            n_cand > jnp.uint32(B_class)
                         )
                     else:
                         ck_lo, ck_hi = k_lo, k_hi
@@ -525,7 +537,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                             ex["ebits"][prow],
                         )
 
-                    cand_B = B_eff if compaction else FK
+                    cand_B = Ba
                     return lax.switch(
                         v_class,
                         [

@@ -1,0 +1,230 @@
+"""The actor→encoding compiler (actor/compile.py), proven by
+REGENERATING workloads that have hand encodings or reference-pinned
+counts and diffing results (VERDICT r2 item 2 / SURVEY §7 step 5):
+
+* ping-pong: 14 (lossy dup, max 1), 4,094 (lossy dup, max 5, boundary),
+  11 (lossless nondup, max 5) — reference actor/model.rs:688, 847, 887
+* single-copy register 2c/1s: 93 — examples/single-copy-register.rs:110,
+  diffed against the hand encoding models/single_copy_register_tpu.py
+* ABD linearizable register 2c/2s: 544 —
+  examples/linearizable-register.rs:286 (no hand encoding exists: this
+  is "a new actor workload gets check-tpu with zero hand-written
+  device code")
+
+All device runs go through spawn_tpu_sortmerge on the CPU mesh and are
+compared engine-to-host on unique counts AND discovered property sets.
+"""
+
+import pytest
+
+from stateright_tpu.actor import Network
+from stateright_tpu.actor.compile import compile_actor_model
+from stateright_tpu.actor.register import Get, GetOk, Put, PutOk
+from stateright_tpu.models.ping_pong import PingPongCfg, ping_pong_model
+
+
+def ping_pong_specs(cfg):
+    counts = lambda ctx: ctx.actor_values(lambda i, s: s)
+
+    def in_le_out(ctx, jnp):
+        return ctx.history_value(lambda h: int(h[0] <= h[1])) == 1
+
+    def out_le_in1(ctx, jnp):
+        return ctx.history_value(lambda h: int(h[1] <= h[0] + 1)) == 1
+
+    return dict(
+        properties={
+            "delta within 1": lambda ctx, jnp: (
+                jnp.max(counts(ctx)) - jnp.min(counts(ctx)) <= 1
+            ),
+            "can reach max": lambda ctx, jnp: jnp.any(
+                counts(ctx) == cfg.max_nat
+            ),
+            "must reach max": lambda ctx, jnp: jnp.any(
+                counts(ctx) == cfg.max_nat
+            ),
+            "must exceed max": lambda ctx, jnp: jnp.any(
+                counts(ctx) == cfg.max_nat + 1
+            ),
+            "#in <= #out": in_le_out,
+            "#out <= #in + 1": out_le_in1,
+        },
+        boundary=lambda ctx, jnp: jnp.all(counts(ctx) <= cfg.max_nat),
+        closure_actor_bound=lambda i, s: s <= cfg.max_nat,
+        # History counters only advance on non-no-op deliveries, which
+        # the actor-state bound caps at max_nat+1 per actor; beyond
+        # that the (in, out) pairs only occur outside the boundary.
+        closure_history_bound=lambda h: max(h) <= 2 * (cfg.max_nat + 2),
+    )
+
+
+def spawn_compiled(model, enc, **kw):
+    kw.setdefault("capacity", 1 << 13)
+    kw.setdefault("frontier_capacity", 1 << 10)
+    kw.setdefault("cand_capacity", 1 << 12)
+    return model.checker().spawn_tpu_sortmerge(encoded=enc, **kw)
+
+
+def assert_matches_host(model, enc, expected_unique):
+    host = model.checker().spawn_bfs().join()
+    assert host.unique_state_count() == expected_unique
+    tpu = spawn_compiled(model, enc).join()
+    assert tpu.unique_state_count() == expected_unique
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries())
+    # Discovery paths replay through the host model (materializing a
+    # Path already replays the trace — the differential check that the
+    # compiled step_vec agrees with the actor handlers). The last state
+    # must witness the discovery: satisfy a sometimes, violate an
+    # always; an eventually counterexample is a terminal path on which
+    # the condition never held.
+    from stateright_tpu.model import Expectation
+
+    for name, path in tpu.discoveries().items():
+        prop = model.property_by_name(name)
+        if prop.expectation == Expectation.SOMETIMES:
+            assert prop.condition(model, path.last_state())
+        elif prop.expectation == Expectation.ALWAYS:
+            assert not prop.condition(model, path.last_state())
+        else:
+            assert all(
+                not prop.condition(model, s) for s, _ in path.steps
+            )
+    return host, tpu
+
+
+@pytest.mark.parametrize(
+    "cfg_kw,lossy,network,expected",
+    [
+        (dict(max_nat=1, maintains_history=True), True, None, 14),
+        (dict(max_nat=5, maintains_history=True), True, None, 4094),
+        (
+            dict(max_nat=5, maintains_history=True),
+            False,
+            Network.new_unordered_nonduplicating(),
+            11,
+        ),
+    ],
+)
+def test_ping_pong_regenerated(cfg_kw, lossy, network, expected):
+    cfg = PingPongCfg(**cfg_kw)
+    model = ping_pong_model(cfg)
+    if network is not None:
+        model.init_network(network)
+    model.set_lossy_network(lossy)
+    enc = compile_actor_model(model, **ping_pong_specs(cfg))
+    assert_matches_host(model, enc, expected)
+
+
+def test_ping_pong_crashes_regenerated():
+    """Crash slots: lossless nondup max 2 with one allowed crash."""
+    cfg = PingPongCfg(max_nat=2, maintains_history=True)
+    model = (
+        ping_pong_model(cfg)
+        .init_network(Network.new_unordered_nonduplicating())
+        .set_max_crashes(1)
+    )
+    enc = compile_actor_model(model, **ping_pong_specs(cfg))
+    host = model.checker().spawn_bfs().join()
+    tpu = spawn_compiled(model, enc).join()
+    assert tpu.unique_state_count() == host.unique_state_count()
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries())
+
+
+def register_specs(default_value):
+    def linearizable(ctx, jnp):
+        return (
+            ctx.history_value(
+                lambda h: int(h.serialized_history() is not None)
+            )
+            == 1
+        )
+
+    def value_chosen(ctx, jnp):
+        return ctx.network_any(
+            lambda env: isinstance(env.msg, GetOk)
+            and env.msg.value != default_value
+        )
+
+    return {"linearizable": linearizable, "value chosen": value_chosen}
+
+
+def test_single_copy_regenerated_matches_hand_encoding():
+    from stateright_tpu.actor.register import DEFAULT_VALUE
+    from stateright_tpu.models.single_copy_register import (
+        SingleCopyRegisterCfg,
+        single_copy_register_model,
+    )
+    from stateright_tpu.models.single_copy_register_tpu import (
+        SingleCopyEncoded,
+    )
+
+    cfg = SingleCopyRegisterCfg(client_count=2)
+    model = single_copy_register_model(cfg)
+    enc = compile_actor_model(
+        model,
+        properties=register_specs(DEFAULT_VALUE),
+        # Each client performs at most put_count+1 operations.
+        closure_history_bound=lambda h: len(h)
+        <= cfg.client_count * (cfg.put_count + 1),
+    )
+    host, tpu = assert_matches_host(model, enc, 93)
+
+    # Diff against the HAND encoding: same counts, same discoveries.
+    hand = (
+        single_copy_register_model(cfg)
+        .checker()
+        .spawn_tpu_sortmerge(
+            encoded=SingleCopyEncoded(cfg),
+            capacity=1 << 10,
+            frontier_capacity=256,
+            cand_capacity=1 << 11,
+        )
+        .join()
+    )
+    assert hand.unique_state_count() == tpu.unique_state_count() == 93
+    assert sorted(hand.discoveries()) == sorted(tpu.discoveries())
+
+
+def test_abd_regenerated_544():
+    """ABD gets check-tpu with zero hand-written device code
+    (examples/linearizable-register.rs:286 pins 544 states)."""
+    from stateright_tpu.actor.register import DEFAULT_VALUE
+    from stateright_tpu.models.linearizable_register import (
+        AbdModelCfg,
+        abd_model,
+    )
+
+    cfg = AbdModelCfg(client_count=2, server_count=2)
+    model = abd_model(cfg)
+    # ABD's logical clocks are bounded only by system reachability, so
+    # the overapprox closure diverges (like paxos ballots) — harvest
+    # from host exploration instead.
+    enc = compile_actor_model(
+        model,
+        properties=register_specs(DEFAULT_VALUE),
+        closure="reachable",
+    )
+    assert_matches_host(model, enc, 544)
+
+
+def test_compiler_refuses_ordered_network():
+    cfg = PingPongCfg(max_nat=1)
+    model = ping_pong_model(cfg).init_network(Network.new_ordered())
+    with pytest.raises(ValueError, match="ordered"):
+        compile_actor_model(model, **ping_pong_specs(cfg))
+
+
+def test_compiler_requires_specs_for_all_properties():
+    cfg = PingPongCfg(max_nat=1)
+    model = ping_pong_model(cfg)
+    with pytest.raises(ValueError, match="no device spec"):
+        compile_actor_model(model, properties={})
+
+
+def test_closure_divergence_detected():
+    cfg = PingPongCfg(max_nat=5)
+    model = ping_pong_model(cfg)
+    specs = ping_pong_specs(cfg)
+    specs.pop("closure_actor_bound")  # counters now unbounded
+    with pytest.raises(RuntimeError, match="closure"):
+        compile_actor_model(model, max_domain=64, **specs)

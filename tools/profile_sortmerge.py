@@ -179,6 +179,10 @@ def wave_profile(rm, capacity, frontier_capacity, cand_capacity):
     t0 = time.monotonic()
     c2._ensure_run(rec)
     total = time.monotonic() - t0
+    # The sync loop breaks on done before reporting, so the final wave
+    # never reaches the reporter — append it from the checker's state.
+    rows.append((time.monotonic() - rec.last, c2.unique_state_count(),
+                 c2.max_depth()))
     print(f"\n## wave profile: 2pc rm={rm}  (total {total:.3f}s incl "
           f"per-wave sync, unique={c2.unique_state_count()})")
     prev_u = 0

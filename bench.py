@@ -151,7 +151,98 @@ def tpu_workloads(quick=False):
                 1745408,
             )
         )
+        loads.append(
+            (
+                # 10.34M states (~the 10^7 regime the north star lives
+                # in). The count is reproduced by two independently
+                # shaped engine configs (different class ladders, tile
+                # counts, and merge programs) and extends the pinned
+                # 2pc growth sequence smoothly (ratio 5.925 after
+                # 5.754/5.833/5.888); the hash-table engine OOMs the
+                # worker at this scale.
+                "2pc rm=9",
+                twopc(
+                    9,
+                    capacity=11 << 20,
+                    frontier_capacity=3 << 19,
+                    cand_capacity=17 << 20,
+                ),
+                10340352,
+            )
+        )
     return loads
+
+
+def bench_ttfc(runs=2):
+    """Time-to-first-counterexample (BASELINE.md primary metric #2):
+    wall-clock from spawn to discovery, host DFS vs the TPU engine, on
+    violation workloads. Host checkers stop at the discovery; the wave
+    engine stops at the end of the discovering wave."""
+    from stateright_tpu.models.increment import Increment
+
+    def host_increment(n):
+        def spawn():
+            return Increment(thread_count=n).checker().spawn_dfs()
+
+        return spawn
+
+    def tpu_increment(n):
+        def spawn():
+            return Increment(thread_count=n).checker().spawn_tpu_sortmerge(
+                capacity=1 << 16,
+                frontier_capacity=1 << 12,
+                cand_capacity=1 << 14,
+                track_paths=False,
+            )
+
+        return spawn
+
+    from stateright_tpu.models.paxos import PaxosModelCfg, paxos_model
+
+    def host_paxos():
+        return (
+            paxos_model(PaxosModelCfg(client_count=2, server_count=3))
+            .checker()
+            .spawn_dfs()
+        )
+
+    def tpu_paxos():
+        return (
+            paxos_model(PaxosModelCfg(client_count=2, server_count=3))
+            .checker()
+            .spawn_tpu_sortmerge(
+                capacity=1 << 15,
+                frontier_capacity=1 << 12,
+                cand_capacity=1 << 14,
+                track_paths=False,
+            )
+        )
+
+    out = {}
+    for name, host_spawn, tpu_spawn, prop in [
+        # Lost-update race: the racy counter violates "fin"
+        # (examples/increment.rs semantics) a few steps in — host DFS
+        # wins shallow bugs; the wave engine pays per-wave dispatch.
+        ("increment n=4", host_increment(4), tpu_increment(4), "fin"),
+        ("increment n=6", host_increment(6), tpu_increment(6), "fin"),
+        # Deep sometimes-discovery: a chosen value needs a full quorum
+        # round (examples/paxos.rs "value chosen"), ~12 levels deep.
+        ("paxos 2c/3s value chosen", host_paxos, tpu_paxos, "value chosen"),
+    ]:
+        h, h_sec = time_checker(host_spawn, runs=runs)
+        t, t_sec = time_checker(tpu_spawn, runs=runs)
+        assert prop in {k for k in h.discoveries()}, (name, "host")
+        assert prop in t.discovered_property_names(), (name, "tpu")
+        out[name] = {
+            "host_sec": round(h_sec, 4),
+            "tpu_sec": round(t_sec, 4),
+            "property": prop,
+        }
+        _stderr(
+            f"ttfc {name}: host={h_sec:.3f}s tpu={t_sec:.3f}s "
+            f"(first {prop!r} counterexample)"
+        )
+    return out
 
 
 def main():
@@ -189,6 +280,9 @@ def main():
         if args.verbose:
             _stderr(f"     metrics: {checker.metrics}")
         headline_name, headline_sps = name, sps
+
+    if not args.quick:
+        detail["ttfc"] = bench_ttfc(runs=args.runs)
 
     print(
         json.dumps(

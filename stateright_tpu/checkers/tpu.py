@@ -358,7 +358,8 @@ class TpuBfsChecker(Checker):
             lo0, hi0 = fingerprint_u32v(init_rows, jnp)
             table = DeviceHashSet.empty(capacity, jnp)
             table, _, pending, _ = insert(
-                table, lo0, hi0, jnp.ones(n0, dtype=bool), jnp
+                table, lo0, hi0, jnp.ones(n0, dtype=bool), jnp,
+                rounds=probe_rounds,
             )
             return dict(
                 t_lo=table.lo,
@@ -702,6 +703,8 @@ class TpuBfsChecker(Checker):
                 "probe failures become likely past ~85% — consider a "
                 "larger capacity",
                 RuntimeWarning,
+                # 3 = the user's spawn/join call site for the direct
+                # _run depth; engine subclasses share that depth today.
                 stacklevel=3,
             )
 

@@ -702,6 +702,18 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
 
         return jax.jit(seed), jax.jit(chunk, donate_argnums=0)
 
+    def _vec_fp(self, row) -> int:
+        """Host fingerprints use the same all-ones clamp as the device
+        keys (clamp_keys): the parent log stores clamped child
+        fingerprints, so host and device keys must be defined
+        identically or a state whose true 64-bit fingerprint is
+        all-ones (p ~ 2^-64, same class as the NonZero convention)
+        would fail path reconstruction."""
+        fp = super()._vec_fp(row)
+        if fp == 0xFFFFFFFFFFFFFFFF:
+            fp = (0xFFFFFFFE << 32) | 0xFFFFFFFF
+        return fp
+
     def _consume_extra_stats(self, extra: np.ndarray) -> None:
         if extra.size >= 2:
             self.metrics["max_wave_candidates"] = int(extra[0])

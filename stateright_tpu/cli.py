@@ -71,16 +71,18 @@ def _2pc(sub: str, args: list[str]) -> None:
             f"Checking two phase commit with {rm_count} resource managers "
             "on the TPU wave engine."
         )
-        # The 2pc space grows ~2.53 bits/RM (288 @ 3 → 296,448 @ 7);
-        # size the visited table to <= ~15% occupancy.
+        # The 2pc space grows ~2.53 bits/RM (288 @ 3 → 296,448 @ 7).
+        # The sort-merge visited array has no load-factor pressure, so
+        # a snug capacity works; this is the engine bench.py records
+        # (the hash-table engine measured ~10x slower on chip, PERF.md).
         import math
 
-        capacity = 1 << max(12, math.ceil(2.6 * rm_count + 2.5))
+        capacity = 1 << max(10, math.ceil(2.6 * rm_count + 1.5))
         _report(
-            sys_model.checker().spawn_tpu(
+            sys_model.checker().spawn_tpu_sortmerge(
                 capacity=capacity,
-                frontier_capacity=capacity // 8,
-                cand_capacity=capacity // 4,
+                frontier_capacity=max(256, capacity // 4),
+                cand_capacity=max(1024, capacity),
             )
         )
     elif sub == "explore":
@@ -248,6 +250,20 @@ def _linearizable(sub: str, args: list[str]) -> None:
             "clients."
         )
         _report(abd_model(cfg, network).checker().spawn_dfs())
+    elif sub == "check-tpu":
+        print(
+            f"Model checking a linearizable register with {client_count} "
+            "clients on the TPU wave engine (compiled actor encoding)."
+        )
+        _report(
+            abd_model(cfg)
+            .checker()
+            .spawn_tpu_sortmerge(
+                capacity=1 << (9 + 2 * client_count),
+                frontier_capacity=1 << (7 + client_count),
+                cand_capacity=1 << (9 + client_count),
+            )
+        )
     elif sub == "explore":
         address = _opt(args, 1, "localhost:3000", parse=str)
         network = _network(args, 2)
@@ -270,7 +286,7 @@ _MODELS = {
     "increment": (_increment, ["check", "check-sym", "check-tpu", "explore"]),
     "increment-lock": (_increment_lock, ["check", "check-sym", "check-tpu", "explore"]),
     "single-copy-register": (_single_copy, ["check", "check-tpu", "explore", "spawn"]),
-    "linearizable-register": (_linearizable, ["check", "explore", "spawn"]),
+    "linearizable-register": (_linearizable, ["check", "check-tpu", "explore", "spawn"]),
 }
 
 

@@ -217,14 +217,48 @@ def abd_model(cfg: AbdModelCfg, network: Network | None = None) -> ActorModel:
         RegisterClient(put_count=cfg.put_count, server_count=cfg.server_count)
         for _ in range(cfg.client_count)
     )
-    return (
-        model.init_network(network)
-        .property(
-            Expectation.ALWAYS,
-            "linearizable",
-            lambda m, s: s.history.serialized_history() is not None,
+    model.init_network(network)
+    model.property(
+        Expectation.ALWAYS,
+        "linearizable",
+        lambda m, s: s.history.serialized_history() is not None,
+    )
+    model.property(Expectation.SOMETIMES, "value chosen", value_chosen)
+    model.record_msg_in(record_returns)
+    model.record_msg_out(record_invocations)
+    model.to_encoded = lambda: abd_encoded(model)
+    return model
+
+
+def abd_encoded(model: ActorModel):
+    """TPU encoding via the generic actor→encoding compiler — ABD has
+    no hand-written device code at all. ABD's logical clocks are
+    bounded only by system reachability (a write bumps the max quorum
+    clock), so the overapproximating closure diverges; the "reachable"
+    mode harvests component domains from a host exploration instead
+    (see actor/compile.py).
+    """
+    from ..actor.compile import compile_actor_model
+
+    def linearizable(ctx, jnp):
+        return (
+            ctx.history_value(
+                lambda h: int(h.serialized_history() is not None)
+            )
+            == 1
         )
-        .property(Expectation.SOMETIMES, "value chosen", value_chosen)
-        .record_msg_in(record_returns)
-        .record_msg_out(record_invocations)
+
+    def value_chosen_vec(ctx, jnp):
+        return ctx.network_any(
+            lambda env: isinstance(env.msg, GetOk)
+            and env.msg.value != DEFAULT_VALUE
+        )
+
+    return compile_actor_model(
+        model,
+        properties={
+            "linearizable": linearizable,
+            "value chosen": value_chosen_vec,
+        },
+        closure="reachable",
     )

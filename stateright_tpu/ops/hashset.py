@@ -124,7 +124,6 @@ def insert(
     lo, hi = table.lo, table.hi
     lo, hi = lo.copy(), hi.copy()  # keep numpy path functional too
     inserted = xp.zeros(n, dtype=bool)
-    found = xp.zeros(n, dtype=bool)
     overflow = xp.zeros(n, dtype=bool)
     slot = xp.zeros(n, dtype=xp.uint32)
     pending = active
@@ -138,9 +137,7 @@ def insert(
         slot_hi = hi[idx]
         is_empty = (slot_lo == 0) & (slot_hi == 0)
         is_match = (slot_lo == key_lo) & (slot_hi == key_hi)
-        newly_found = pending & is_match
-        found = found | newly_found
-        slot = xp.where(newly_found, idx, slot)
+        slot = xp.where(pending & is_match, idx, slot)
         pending = pending & ~is_match
         # Claim empty slots: scatter-max row ids, winners re-read.
         want = pending & is_empty

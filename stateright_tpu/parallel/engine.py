@@ -128,7 +128,10 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         import jax.numpy as jnp
         from jax import lax
         from jax.sharding import PartitionSpec as P
-        from jax.experimental.shard_map import shard_map
+        try:
+            from jax import shard_map  # jax >= 0.8
+        except ImportError:  # pragma: no cover - older jax
+            from jax.experimental.shard_map import shard_map
 
         enc = self.encoded
         props = list(self.model.properties())
@@ -190,7 +193,9 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             fval = jnp.arange(F) < n_mine
             ebits = jnp.where(fval, jnp.uint32(ebits_init), jnp.uint32(0))
             table = DeviceHashSet.empty(capacity, jnp)
-            table, _, pending, _ = insert(table, lo0, hi0, mine, jnp)
+            table, _, pending, _ = insert(
+                table, lo0, hi0, mine, jnp, rounds=probe_rounds
+            )
             overflow = bool_any(jnp.any(pending))
             return dict(
                 t_lo=table.lo,

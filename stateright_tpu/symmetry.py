@@ -24,7 +24,16 @@ inverse permutation — see stateright_tpu/ops.
 
 from __future__ import annotations
 
+import enum
+from dataclasses import fields, is_dataclass
+from dataclasses import replace as dc_replace
 from typing import Any, Iterable, Protocol, Sequence, TypeVar, runtime_checkable
+
+#: lazily bound by rewrite_value (import cycle: utils.hashable is free of
+#: cycles, actor.base imports nothing from here — but keep symmetry
+#: importable without the actor package)
+_ID_TYPE = None
+_HASHABLE_TYPES = None
 
 T = TypeVar("T")
 
@@ -78,9 +87,82 @@ def sorted_representative_key(values: Iterable[Any]) -> tuple:
     return tuple(sorted(values))
 
 
+def rewrite_value(value: Any, plan: RewritePlan) -> Any:
+    """Recursively rewrite every embedded :class:`~stateright_tpu.actor.Id`
+    inside ``value`` — the counterpart of the reference's ``Rewrite``
+    trait impls (rewrite.rs:24-163): scalars pass through, containers
+    and (frozen) dataclasses recurse, ``Id``s map through the plan.
+
+    Soundness note (shared with the reference): an actor id stored as a
+    PLAIN int is indistinguishable from data and passes through
+    unrewritten — models must use the ``Id`` type for embedded ids, as
+    the reference must use its ``Id`` newtype. Types this function does
+    not understand raise rather than silently passing through; give
+    them a ``_rewrite_ids_(plan)`` method.
+    """
+    global _ID_TYPE, _HASHABLE_TYPES
+    if _ID_TYPE is None:
+        from .actor.base import Id
+
+        _ID_TYPE = Id
+    Id = _ID_TYPE
+
+    hook = getattr(value, "_rewrite_ids_", None)
+    if hook is not None:
+        return hook(plan)
+    if isinstance(value, Id):
+        return Id(plan.rewrite(int(value)))
+    if isinstance(value, enum.Enum) or isinstance(
+        value, (bool, int, float, complex, str, bytes, type(None))
+    ):
+        return value
+    if is_dataclass(value) and not isinstance(value, type):
+        return dc_replace(
+            value,
+            **{
+                f.name: rewrite_value(getattr(value, f.name), plan)
+                for f in fields(value)
+            },
+        )
+    if isinstance(value, tuple):
+        return tuple(rewrite_value(v, plan) for v in value)
+    if isinstance(value, list):
+        return [rewrite_value(v, plan) for v in value]
+    if isinstance(value, (set, frozenset)):
+        return frozenset(rewrite_value(v, plan) for v in value)
+    if isinstance(value, dict):
+        return {
+            rewrite_value(k, plan): rewrite_value(v, plan)
+            for k, v in value.items()
+        }
+    if _HASHABLE_TYPES is None:
+        from .utils.hashable import HashableMap, HashableSet
+
+        globals()["_HASHABLE_TYPES"] = (HashableMap, HashableSet)
+    HashableMap, HashableSet = _HASHABLE_TYPES
+    if isinstance(value, HashableMap):
+        return HashableMap(
+            {
+                rewrite_value(k, plan): rewrite_value(v, plan)
+                for k, v in value.items()
+            }
+        )
+    if isinstance(value, HashableSet):
+        return HashableSet(rewrite_value(v, plan) for v in value)
+    raise TypeError(
+        f"cannot rewrite actor ids inside {type(value).__name__!r}; "
+        "generic actor symmetry would silently collapse distinct states "
+        "— implement _rewrite_ids_(plan) on the type or use a "
+        "model-specific representative"
+    )
+
+
 def actor_state_representative(state):
     """Canonicalize an ``ActorModelState`` by sorting actor states and
-    rewriting ids embedded in the network/timers (model_state.rs:115-132).
+    rewriting ids embedded EVERYWHERE — actor states, message payloads,
+    network endpoints, timers, and history — mirroring the reference's
+    recursive ``Rewrite`` (model_state.rs:115-132, rewrite.rs:146-163,
+    network.rs:311-324).
 
     Requires all actors to be interchangeable; models with distinct
     roles (e.g. servers vs clients) should define their own
@@ -89,7 +171,12 @@ def actor_state_representative(state):
     from dataclasses import replace
 
     from .actor.model_state import ActorModelState
-    from .actor.network import Envelope
+    from .actor.network import (
+        Envelope,
+        Ordered,
+        UnorderedDuplicating,
+        UnorderedNonDuplicating,
+    )
     from .fingerprint import stable_hash
 
     assert isinstance(state, ActorModelState)
@@ -97,36 +184,28 @@ def actor_state_representative(state):
         [stable_hash(s) for s in state.actor_states]
     )
 
-    def rewrite_id(id_):
-        return type(id_)(plan.rewrite(int(id_)))
+    def rw(value):
+        return rewrite_value(value, plan)
 
     network = state.network
-    new_network = type(network).__new__(type(network))
-    # Rebuild the network with rewritten envelope endpoints.
-    from .actor.network import (
-        Ordered,
-        UnorderedDuplicating,
-        UnorderedNonDuplicating,
-    )
-
     if isinstance(network, UnorderedDuplicating):
         new_network = UnorderedDuplicating(
             frozenset(
-                Envelope(rewrite_id(e.src), rewrite_id(e.dst), e.msg)
+                Envelope(rw(e.src), rw(e.dst), rw(e.msg))
                 for e in network.envelopes
             )
         )
     elif isinstance(network, UnorderedNonDuplicating):
         new_network = UnorderedNonDuplicating(
             {
-                Envelope(rewrite_id(e.src), rewrite_id(e.dst), e.msg): n
+                Envelope(rw(e.src), rw(e.dst), rw(e.msg)): n
                 for e, n in network.counts.items()
             }
         )
     elif isinstance(network, Ordered):
         new_network = Ordered(
             {
-                (rewrite_id(src), rewrite_id(dst)): msgs
+                (rw(src), rw(dst)): tuple(rw(m) for m in msgs)
                 for (src, dst), msgs in network.flows.items()
             }
         )
@@ -135,8 +214,12 @@ def actor_state_representative(state):
 
     return replace(
         state,
-        actor_states=tuple(plan.reindex(state.actor_states)),
-        timers_set=tuple(plan.reindex(state.timers_set)),
+        actor_states=tuple(rw(s) for s in plan.reindex(state.actor_states)),
+        timers_set=tuple(
+            frozenset(rw(t) for t in ts)
+            for ts in plan.reindex(state.timers_set)
+        ),
         crashed=tuple(plan.reindex(state.crashed)),
         network=new_network,
+        history=rw(state.history),
     )

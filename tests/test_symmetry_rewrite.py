@@ -1,0 +1,166 @@
+"""Recursive id rewriting for generic actor symmetry (VERDICT r2 #7).
+
+The round-2 ``actor_state_representative`` rewrote only envelope
+src/dst; ids INSIDE message payloads, actor states, and history stayed
+stale, silently collapsing distinct states for any protocol whose
+messages carry ids — reproduced here by the claim protocol, then shown
+fixed: symmetry verdicts match the unsymmetrized run (reference
+rewrite.rs:146-163, network.rs:311-324 semantics).
+"""
+
+import pytest
+
+from stateright_tpu.actor import Actor, ActorModel, Id, Network, Out
+from stateright_tpu.model import Expectation
+from stateright_tpu.symmetry import (
+    RewritePlan,
+    actor_state_representative,
+    rewrite_value,
+)
+
+
+class Claimer(Actor):
+    """Each actor broadcasts ('claim', own_id); state = ids seen.
+
+    Both the message payload and the actor state embed Ids, so a
+    representative that rewrites only envelope endpoints maps states in
+    DIFFERENT orbits to the same key.
+    """
+
+    def __init__(self, n: int):
+        self.n = n
+
+    def on_start(self, id: Id, out: Out):
+        for peer in range(self.n):
+            if peer != int(id):
+                out.send(Id(peer), ("claim", id))
+        return frozenset()
+
+    def on_msg(self, id: Id, state, src: Id, msg, out: Out) -> None:
+        if isinstance(msg, tuple) and msg[0] == "claim":
+            if msg[1] not in state.value:
+                state.set(state.value | {msg[1]})
+
+
+def claim_model(n: int) -> ActorModel:
+    model = ActorModel()
+    for _ in range(n):
+        model.actor(Claimer(n))
+    model.init_network(Network.new_unordered_nonduplicating())
+    model.property(
+        Expectation.SOMETIMES,
+        "someone saw everyone",
+        lambda m, s: any(len(a) == n - 1 for a in s.actor_states),
+    )
+    model.property(
+        Expectation.ALWAYS,
+        "never sees self",
+        lambda m, s: all(
+            Id(i) not in a for i, a in enumerate(s.actor_states)
+        ),
+    )
+    return model
+
+
+def test_rewrite_value_recurses_into_payloads_and_containers():
+    plan = RewritePlan([2, 0, 1])  # old->new: 0->1, 1->2, 2->0
+    assert rewrite_value(Id(0), plan) == Id(1)
+    assert rewrite_value(("claim", Id(2)), plan) == ("claim", Id(0))
+    assert rewrite_value(frozenset({Id(0), Id(1)}), plan) == frozenset(
+        {Id(1), Id(2)}
+    )
+    assert rewrite_value({Id(1): "x"}, plan) == {Id(2): "x"}
+    # Plain data passes through untouched.
+    assert rewrite_value(("data", 7, "s"), plan) == ("data", 7, "s")
+
+
+def test_rewrite_value_refuses_unknown_types():
+    class Opaque:
+        pass
+
+    with pytest.raises(TypeError, match="rewrite actor ids"):
+        rewrite_value(Opaque(), RewritePlan([0]))
+
+
+def _apply_permutation(state, perm):
+    """π(state) for an old→new actor permutation: reindex the per-actor
+    tuples and rewrite every embedded id — ground truth for orbits."""
+    from dataclasses import replace
+
+    from stateright_tpu.actor.network import (
+        Envelope,
+        UnorderedNonDuplicating,
+    )
+
+    # RewritePlan wants new-position → old-index; invert the mapping.
+    inv = [0] * len(perm)
+    for old, new in enumerate(perm):
+        inv[new] = old
+    plan = RewritePlan(inv)
+    net = UnorderedNonDuplicating(
+        {
+            Envelope(
+                rewrite_value(e.src, plan),
+                rewrite_value(e.dst, plan),
+                rewrite_value(e.msg, plan),
+            ): c
+            for e, c in state.network.counts.items()
+        }
+    )
+    return replace(
+        state,
+        actor_states=tuple(
+            rewrite_value(s, plan)
+            for s in plan.reindex(state.actor_states)
+        ),
+        timers_set=tuple(plan.reindex(state.timers_set)),
+        crashed=tuple(plan.reindex(state.crashed)),
+        network=net,
+    )
+
+
+def test_representative_stays_in_orbit():
+    """THE soundness invariant (and the round-2 regression): the
+    representative must be a genuine member of the state's symmetry
+    orbit. The envelope-only rewrite produced hybrids — actor states
+    re-sorted but payload/state ids stale — that lie OUTSIDE the orbit,
+    collapsing states from different orbits (silent under-exploration,
+    the most dangerous checker failure mode)."""
+    from itertools import permutations
+
+    from stateright_tpu.actor.model import Deliver
+
+    model = claim_model(3)
+    [init] = model.init_states()
+    s1 = model.next_state(init, Deliver(Id(1), Id(0), ("claim", Id(1))))
+    s2 = model.next_state(init, Deliver(Id(2), Id(0), ("claim", Id(2))))
+    assert s1 != s2
+    for s in (init, s1, s2):
+        orbit = {_apply_permutation(s, perm)
+                 for perm in permutations(range(3))}
+        assert actor_state_representative(s) in orbit
+    # States whose orbits differ keep distinct representatives.
+    assert actor_state_representative(init) != actor_state_representative(
+        s1
+    )
+    # s1 and s2 are in the SAME orbit (swap actors 1 and 2 carries one
+    # to the other, payloads included).
+    assert s2 in {
+        _apply_permutation(s1, perm) for perm in permutations(range(3))
+    }
+
+
+def test_symmetry_matches_unsymmetrized_verdicts():
+    host = claim_model(3).checker().spawn_dfs().join()
+    sym = (
+        claim_model(3)
+        .checker()
+        .symmetry_fn(actor_state_representative)
+        .spawn_dfs()
+        .join()
+    )
+    assert sorted(sym.discoveries()) == sorted(host.discoveries())
+    sym.assert_properties()
+    host.assert_properties()
+    # Symmetry visits no more states, and at least the orbit count.
+    assert sym.unique_state_count() <= host.unique_state_count()

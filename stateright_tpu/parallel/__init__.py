@@ -11,5 +11,6 @@ and no shared mutable state exists at all. Termination and counters are
 """
 
 from .engine import ShardedTpuBfsChecker
+from .engine_sortmerge import ShardedSortMergeTpuBfsChecker
 
-__all__ = ["ShardedTpuBfsChecker"]
+__all__ = ["ShardedTpuBfsChecker", "ShardedSortMergeTpuBfsChecker"]

@@ -1,0 +1,602 @@
+"""The sharded SORT-MERGE wave engine: multi-chip BFS on the fast path.
+
+Round 2 shipped two engines pulling in opposite directions: the
+single-chip sort-merge engine (checkers/tpu_sortmerge.py) — dedup via
+``lax.sort`` merges, ~10x faster on chip than scatter-based open
+addressing — and a sharded engine (parallel/engine.py) whose
+owner-local dedup still used the hash-table scatters. This module
+closes that gap (VERDICT r2 item 4): the scale-out path now runs the
+same sorted-visited-array dedup the repo benchmarks and recommends.
+
+Per wave, inside one ``shard_map``-wrapped ``lax.while_loop``:
+
+1. each shard expands its local frontier block (vmap step → property
+   bitmaps → fingerprints),
+2. a 4-lane ``lax.sort`` keyed ``(owner, fp_hi, fp_lo)`` groups valid
+   candidates by destination shard — routing and compaction in ONE
+   sort, no per-destination scatters (the job_market.rs:66-147
+   replacement, with the communication pattern chosen for ICI),
+3. each destination's contiguous run is sliced into its fixed-size
+   tile of the send buffer (``dynamic_slice`` at the run offset —
+   contiguous copies, never scatters) and one ``lax.all_to_all`` swaps
+   tiles so every candidate lands on the shard owning
+   ``fp_lo % n_shards``,
+4. owner-local dedup is the sort-merge: one stable merge sort against
+   the shard's sorted visited array (visited-first ⇒ first-of-run
+   wins; intra-wave duplicates resolve for free), a rebuild sort, and
+   a frontier-compaction sort — the role DashMap sharding plays in the
+   reference BFS (bfs.rs:28-29) with zero cross-shard contention by
+   construction,
+5. the parent forest is a per-shard append-only (child, parent) log
+   written with ``dynamic_update_slice`` — no scatters — drained
+   lazily on the host only when a counterexample path is
+   reconstructed,
+6. termination, counters, discovery folding, and overflow flags are
+   ``psum``/``pmin`` reductions: every device agrees on ``done``
+   without touching the host.
+
+Shapes are per-shard and fixed (the adaptive class ladders of the
+single-chip engine don't pay for themselves inside shard_map yet —
+multi-chip waves are sized by the workload's peak via the same
+``max_wave_candidates`` metric). On one device the shuffle degenerates
+to the identity and results are state-identical to the single-chip
+engines; tests pin identical results for shard counts 1/2/8 on the
+CPU mesh, with ``track_paths=True`` paths replaying through the host
+model.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..checker import CheckerBuilder
+from ..encoding import EncodedModel
+from ..model import Expectation
+from ..ops.fingerprint import fingerprint_u32v
+from ..ops.u64 import U64, u64_add
+from ..checkers.tpu import expand_frontier, wave_hits
+from ..checkers.tpu_sortmerge import SortMergeTpuBfsChecker
+
+_SENT = 0xFFFFFFFF
+
+
+class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
+    """``CheckerBuilder.spawn_tpu_sharded_sortmerge()`` — the sort-merge
+    wave engine over a ``jax.sharding.Mesh``. Inherits the result /
+    reconstruction surface (including the clamped host fingerprints)
+    from the single-chip sort-merge engine; the device programs and the
+    parent-log layout differ."""
+
+    def __init__(
+        self,
+        builder: CheckerBuilder,
+        encoded: Optional[EncodedModel] = None,
+        mesh=None,
+        n_shards: Optional[int] = None,
+        capacity: int = 1 << 13,
+        frontier_capacity: Optional[int] = None,
+        track_paths: bool = True,
+        waves_per_sync: int = 16,
+        cand_capacity: Optional[int] = None,
+        bucket_capacity: Optional[int] = None,
+    ):
+        import jax
+        from jax.sharding import Mesh
+
+        if mesh is None:
+            devices = jax.devices()
+            if n_shards is None:
+                n_shards = len(devices)
+            if n_shards > len(devices):
+                raise ValueError(
+                    f"n_shards={n_shards} > {len(devices)} available devices"
+                )
+            mesh = Mesh(np.array(devices[:n_shards]), ("shard",))
+        if len(mesh.axis_names) != 1:
+            raise ValueError(
+                f"expected a 1-axis mesh, got axes {mesh.axis_names}"
+            )
+        self.mesh = mesh
+        self.n_shards = int(mesh.devices.size)
+        super().__init__(
+            builder,
+            encoded=encoded,
+            capacity=capacity,
+            frontier_capacity=frontier_capacity,
+            track_paths=track_paths,
+            waves_per_sync=waves_per_sync,
+            cand_capacity=cand_capacity,
+        )
+        self.total_capacity = capacity * self.n_shards
+        self.bucket_capacity = bucket_capacity
+
+    def _cache_extras(self) -> tuple:
+        return (
+            "sharded-sortmerge",
+            self.n_shards,
+            self.bucket_capacity,
+            self.mesh,
+        )
+
+    def _cand_overflow_message(self) -> str:
+        return (
+            "candidate/bucket overflow: a wave generated more successors "
+            f"than fit the per-shard buffers (cand_capacity="
+            f"{self.cand_capacity}, bucket_capacity={self.bucket_capacity});"
+            " re-run with larger capacities — the max_wave_candidates "
+            "metric reports the observed per-shard peak"
+        )
+
+    def _consume_extra_stats(self, extra: np.ndarray) -> None:
+        if extra.size >= 3:
+            self.metrics["shuffle_volume"] = int(extra[0]) | (
+                int(extra[1]) << 32
+            )
+            self.metrics["max_wave_candidates"] = int(extra[2])
+
+    # -- device programs ---------------------------------------------------
+
+    def _build_programs(self, n0: int):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+        try:
+            from jax import shard_map  # jax >= 0.8
+        except ImportError:  # pragma: no cover - older jax
+            from jax.experimental.shard_map import shard_map
+
+        enc = self.encoded
+        props = list(self.model.properties())
+        n_props = len(props)
+        evt_idx = [
+            i for i, p in enumerate(props)
+            if p.expectation == Expectation.EVENTUALLY
+        ]
+        if evt_idx and max(evt_idx) >= 32:
+            raise ValueError(
+                "the TPU engine supports eventually properties only at "
+                "property indices < 32; reorder properties() so eventually "
+                f"properties come first (got index {max(evt_idx)})"
+            )
+        K, W, F = enc.max_actions, enc.width, self.frontier_capacity
+        S = self.n_shards
+        C = self.capacity
+        B = min(self.cand_capacity or F * K, F * K)
+        if self.bucket_capacity is not None:
+            Bd = min(self.bucket_capacity, B)
+        elif S == 1:
+            Bd = B
+        else:
+            # Near-uniform fingerprint split: 4x the expected share.
+            Bd = min(B, max(128, (4 * B + S - 1) // S))
+        R = S * Bd  # rows received per shard per wave
+        if n0 > C:
+            raise ValueError(
+                f"per-shard capacity {C} < {n0} init states"
+            )
+        target_states = self.builder._target_state_count
+        target_depth = self.builder._target_max_depth
+        waves_per_sync = self.waves_per_sync
+        ebits_init = self._eventually_bits_init()
+        track_paths = self.track_paths
+        # Per-shard parent-log rows: every unique state a shard owns
+        # (≤ C) gets one entry; the append block is F rows (the
+        # next-frontier width), so headroom must cover max(F, R) or a
+        # clamped dynamic_update_slice would silently overwrite earlier
+        # log entries.
+        L = C + max(F, R) if track_paths else 0
+        # Payload lanes: state + (parent fp) + ebits + own fp (owners
+        # don't re-hash after the shuffle). All-zero fp lanes mark
+        # unused bucket slots (fingerprints are never 0).
+        E = W + 3 if track_paths else W + 1
+        EB = E - 1
+        E2 = E + 2
+        mesh = self.mesh
+
+        def bool_any(x):
+            return lax.psum(x.astype(jnp.uint32), "shard") > 0
+
+        def clamp_keys(lo, hi):
+            both = (lo == jnp.uint32(_SENT)) & (hi == jnp.uint32(_SENT))
+            return lo, jnp.where(both, jnp.uint32(_SENT - 1), hi)
+
+        def seed_local(init_rows):
+            me = lax.axis_index("shard").astype(jnp.uint32)
+            lo0, hi0 = fingerprint_u32v(init_rows, jnp)
+            lo0, hi0 = clamp_keys(lo0, hi0)
+            mine = (lo0 % jnp.uint32(S)) == me
+            pos = jnp.cumsum(mine) - 1
+            sp = jnp.where(mine, pos, F)
+            frontier = jnp.zeros((F, W), dtype=jnp.uint32).at[sp].set(
+                init_rows, mode="drop"
+            )
+            n_mine = jnp.sum(mine)
+            fval = jnp.arange(F) < n_mine
+            ebits = jnp.where(fval, jnp.uint32(ebits_init), jnp.uint32(0))
+            v_hi = jnp.where(mine, hi0, jnp.uint32(_SENT))
+            v_lo = jnp.where(mine, lo0, jnp.uint32(_SENT))
+            pad = C - v_hi.shape[0]
+            v_hi = jnp.concatenate([v_hi, jnp.full(pad, _SENT, jnp.uint32)])
+            v_lo = jnp.concatenate([v_lo, jnp.full(pad, _SENT, jnp.uint32)])
+            v_hi, v_lo = lax.sort((v_hi, v_lo), num_keys=2)
+            return dict(
+                v_lo=v_lo,
+                v_hi=v_hi,
+                pl_child_lo=jnp.zeros(L, jnp.uint32),
+                pl_child_hi=jnp.zeros(L, jnp.uint32),
+                pl_par_lo=jnp.zeros(L, jnp.uint32),
+                pl_par_hi=jnp.zeros(L, jnp.uint32),
+                pl_n=jnp.zeros(1, jnp.uint32),
+                frontier=frontier,
+                fval=fval,
+                ebits=ebits,
+                depth=jnp.int32(1),
+                wchunk=jnp.int32(0),
+                waves=jnp.uint32(0),
+                gen_lo=jnp.uint32(n0),
+                gen_hi=jnp.uint32(0),
+                new=jnp.uint32(n0),
+                sent_lo=jnp.uint32(0),
+                sent_hi=jnp.uint32(0),
+                max_cand=jnp.uint32(0),
+                disc_found=jnp.zeros(n_props, dtype=bool),
+                disc_lo=jnp.zeros(n_props, dtype=jnp.uint32),
+                disc_hi=jnp.zeros(n_props, dtype=jnp.uint32),
+                overflow=jnp.bool_(n0 > C),
+                f_overflow=jnp.bool_(False),
+                c_overflow=jnp.bool_(False),
+                done=jnp.bool_(n0 == 0),
+            )
+
+        def body(c):
+            ebits = c["ebits"]
+            fval = c["fval"]
+            me = lax.axis_index("shard").astype(jnp.uint32)
+
+            if target_depth is None:
+                expand = jnp.bool_(True)
+            else:
+                expand = c["depth"] < target_depth
+
+            ex = expand_frontier(
+                enc, props, evt_idx, c["frontier"], fval, ebits, expand,
+                with_repeats=False,
+            )
+
+            # Discoveries: local per-wave hits, globally folded (the
+            # lowest hitting shard index wins, mirroring whichever
+            # racing thread lands first in the reference).
+            if n_props:
+                hits, los, his = wave_hits(props, ex, fval)
+                ghits = bool_any(hits)
+                pri = jnp.where(hits, me, jnp.uint32(S))
+                winner = lax.pmin(pri, "shard")
+                sel = hits & (pri == winner)
+                g_lo = lax.psum(jnp.where(sel, los, jnp.uint32(0)), "shard")
+                g_hi = lax.psum(jnp.where(sel, his, jnp.uint32(0)), "shard")
+                fresh = ghits & ~c["disc_found"]
+                disc_found = c["disc_found"] | ghits
+                disc_lo = jnp.where(fresh, g_lo, c["disc_lo"])
+                disc_hi = jnp.where(fresh, g_hi, c["disc_hi"])
+            else:
+                disc_found = c["disc_found"]
+                disc_lo = c["disc_lo"]
+                disc_hi = c["disc_hi"]
+
+            flat, valid = ex["flat"], ex["v"]
+            n_cand = jnp.sum(valid).astype(jnp.uint32)
+            k_lo, k_hi = fingerprint_u32v(flat, jnp)
+            k_lo, k_hi = clamp_keys(k_lo, k_hi)
+            owner = jnp.where(
+                valid, k_lo % jnp.uint32(S), jnp.uint32(S)
+            )
+
+            # Route+compact in ONE sort: order by (owner, key); valid
+            # candidates form S contiguous destination runs (invalid
+            # rows carry owner=S and sort last).
+            rows = jnp.arange(F * K, dtype=jnp.uint32)
+            s_owner, s_hi, s_lo, s_row = lax.sort(
+                (owner, k_hi, k_lo, rows), num_keys=3
+            )
+            # s_owner is sorted: all destination-run boundaries in one
+            # searchsorted pass (S scans of the F*K array otherwise).
+            edges = jnp.searchsorted(
+                s_owner, jnp.arange(S + 1, dtype=jnp.uint32)
+            ).astype(jnp.uint32)
+            starts = edges[:-1]
+            counts = edges[1:] - starts
+            route_ovf = jnp.any(counts > jnp.uint32(Bd))
+            c_overflow = c["c_overflow"] | bool_any(
+                route_ovf | (n_cand > jnp.uint32(B))
+            )
+
+            # Payload rows for the send buffer, fetched per destination
+            # run: state lanes, parent fp, ebits, own fp.
+            prow_all = s_row // jnp.uint32(K)
+
+            def dest_tile(d):
+                start = starts[d]
+                cnt = counts[d]
+                live = jnp.arange(Bd, dtype=jnp.uint32) < cnt
+                idx = jnp.clip(
+                    start + jnp.arange(Bd, dtype=jnp.uint32),
+                    0,
+                    jnp.uint32(F * K - 1),
+                )
+                srow = s_row[idx]
+                prow = prow_all[idx]
+                parts = [flat[srow]]
+                if track_paths:
+                    parts += [
+                        ex["f_lo"][prow][:, None],
+                        ex["f_hi"][prow][:, None],
+                    ]
+                parts.append(ex["ebits"][prow][:, None])
+                parts += [
+                    jnp.where(live, s_lo[idx], 0)[:, None],
+                    jnp.where(live, s_hi[idx], 0)[:, None],
+                ]
+                tile = jnp.concatenate(parts, axis=1)
+                return jnp.where(live[:, None], tile, jnp.uint32(0))
+
+            send = jnp.concatenate([dest_tile(d) for d in range(S)], axis=0)
+            cross = n_cand - counts[me]
+            g_cross = lax.psum(cross.astype(jnp.uint32), "shard")
+            sent = u64_add(
+                U64(c["sent_lo"], c["sent_hi"]), U64(g_cross, jnp.uint32(0))
+            )
+
+            recv = lax.all_to_all(
+                send, "shard", split_axis=0, concat_axis=0, tiled=True
+            )
+
+            # Owner-local sort-merge dedup (the DashMap-shard role,
+            # bfs.rs:28-29, on the TPU-fast path): stable merge with
+            # the visited prefix first, so first-of-run wins and
+            # intra-wave duplicates resolve for free.
+            r_lo = recv[:, E]
+            r_hi = recv[:, E + 1]
+            r_val = (r_lo != 0) | (r_hi != 0)
+            ck_lo = jnp.where(r_val, r_lo, jnp.uint32(_SENT))
+            ck_hi = jnp.where(r_val, r_hi, jnp.uint32(_SENT))
+
+            m_hi = jnp.concatenate([c["v_hi"], ck_hi])
+            m_lo = jnp.concatenate([c["v_lo"], ck_lo])
+            m_pos = jnp.concatenate(
+                [
+                    jnp.zeros(C, jnp.uint32),
+                    jnp.arange(1, R + 1, dtype=jnp.uint32),
+                ]
+            )
+            m_hi, m_lo, m_pos = lax.sort((m_hi, m_lo, m_pos), num_keys=2)
+            real = ~(
+                (m_hi == jnp.uint32(_SENT)) & (m_lo == jnp.uint32(_SENT))
+            )
+            prev_same = jnp.concatenate(
+                [
+                    jnp.zeros(1, bool),
+                    (m_hi[1:] == m_hi[:-1]) & (m_lo[1:] == m_lo[:-1]),
+                ]
+            )
+            is_new = real & ~prev_same & (m_pos > 0)
+            new_count = jnp.sum(is_new)
+
+            u_hi = jnp.where(prev_same, jnp.uint32(_SENT), m_hi)
+            u_lo = jnp.where(prev_same, jnp.uint32(_SENT), m_lo)
+            u_hi, u_lo = lax.sort((u_hi, u_lo), num_keys=2)
+            overflow = c["overflow"] | bool_any(
+                ~(
+                    (u_hi[C] == jnp.uint32(_SENT))
+                    & (u_lo[C] == jnp.uint32(_SENT))
+                )
+            )
+            v_hi_new, v_lo_new = u_hi[:C], u_lo[:C]
+
+            nf_pos = jnp.where(is_new, m_pos, jnp.uint32(_SENT))
+            (nf_pos,) = lax.sort((nf_pos,), num_keys=1)
+            if C + R >= F:
+                nf_pos = nf_pos[:F]
+            else:
+                nf_pos = jnp.concatenate(
+                    [nf_pos, jnp.full(F - (C + R), _SENT, jnp.uint32)]
+                )
+            nf_valid = jnp.arange(F) < new_count
+            f_overflow = c["f_overflow"] | bool_any(new_count > F)
+            nf_row = jnp.where(nf_valid, nf_pos - 1, jnp.uint32(0))
+            next_fe = recv[nf_row]
+            next_frontier = jnp.where(
+                nf_valid[:, None], next_fe[:, :W], jnp.uint32(0)
+            )
+            next_ebits = jnp.where(nf_valid, next_fe[:, EB], 0)
+
+            if track_paths:
+                nc_lo = jnp.where(nf_valid, next_fe[:, E], 0)
+                nc_hi = jnp.where(nf_valid, next_fe[:, E + 1], 0)
+                np_lo = jnp.where(nf_valid, next_fe[:, W], 0)
+                np_hi = jnp.where(nf_valid, next_fe[:, W + 1], 0)
+                off = (c["pl_n"][0],)
+                pl_child_lo = lax.dynamic_update_slice(
+                    c["pl_child_lo"], nc_lo, off
+                )
+                pl_child_hi = lax.dynamic_update_slice(
+                    c["pl_child_hi"], nc_hi, off
+                )
+                pl_par_lo = lax.dynamic_update_slice(
+                    c["pl_par_lo"], np_lo, off
+                )
+                pl_par_hi = lax.dynamic_update_slice(
+                    c["pl_par_hi"], np_hi, off
+                )
+                pl_n = c["pl_n"] + new_count.astype(jnp.uint32)
+            else:
+                pl_child_lo = c["pl_child_lo"]
+                pl_child_hi = c["pl_child_hi"]
+                pl_par_lo = c["pl_par_lo"]
+                pl_par_hi = c["pl_par_hi"]
+                pl_n = c["pl_n"]
+
+            g_new = lax.psum(new_count.astype(jnp.uint32), "shard")
+            g_cand = lax.psum(n_cand, "shard")
+            g = u64_add(
+                U64(c["gen_lo"], c["gen_hi"]), U64(g_cand, jnp.uint32(0))
+            )
+            new = c["new"] + g_new
+            max_cand = jnp.maximum(
+                c["max_cand"], lax.pmax(n_cand, "shard")
+            )
+
+            all_disc = (
+                jnp.all(disc_found) if n_props else jnp.bool_(False)
+            )
+            if target_states is None:
+                target_hit = jnp.bool_(False)
+            else:
+                target_hit = new >= jnp.uint32(target_states)
+            cont = (
+                (g_new > 0)
+                & ~all_disc
+                & ~target_hit
+                & ~overflow
+                & ~f_overflow
+                & ~c_overflow
+            )
+            return dict(
+                v_lo=v_lo_new,
+                v_hi=v_hi_new,
+                pl_child_lo=pl_child_lo,
+                pl_child_hi=pl_child_hi,
+                pl_par_lo=pl_par_lo,
+                pl_par_hi=pl_par_hi,
+                pl_n=pl_n,
+                frontier=next_frontier,
+                fval=nf_valid & cont,
+                ebits=next_ebits,
+                depth=jnp.where(cont, c["depth"] + 1, c["depth"]),
+                wchunk=c["wchunk"] + 1,
+                waves=c["waves"] + 1,
+                gen_lo=g.lo,
+                gen_hi=g.hi,
+                new=new,
+                sent_lo=sent.lo,
+                sent_hi=sent.hi,
+                max_cand=max_cand,
+                disc_found=disc_found,
+                disc_lo=disc_lo,
+                disc_hi=disc_hi,
+                overflow=overflow,
+                f_overflow=f_overflow,
+                c_overflow=c_overflow,
+                done=~cont,
+            )
+
+        def cond(c):
+            return ~c["done"] & (c["wchunk"] < waves_per_sync)
+
+        def chunk(carry):
+            c = dict(carry, wchunk=jnp.int32(0))
+            c = lax.while_loop(cond, body, c)
+            frontier_total = lax.psum(
+                jnp.sum(c["fval"]).astype(jnp.uint32), "shard"
+            )
+            scalars = jnp.stack(
+                [
+                    c["done"].astype(jnp.uint32),
+                    c["overflow"].astype(jnp.uint32),
+                    c["f_overflow"].astype(jnp.uint32),
+                    c["depth"].astype(jnp.uint32),
+                    c["waves"],
+                    frontier_total,
+                    c["gen_lo"],
+                    c["gen_hi"],
+                    c["new"],
+                    c["c_overflow"].astype(jnp.uint32),
+                ]
+            )
+            stats = jnp.concatenate(
+                [
+                    scalars,
+                    c["disc_found"].astype(jnp.uint32),
+                    c["disc_lo"],
+                    c["disc_hi"],
+                    jnp.stack(
+                        [c["sent_lo"], c["sent_hi"], c["max_cand"]]
+                    ),
+                ]
+            )
+            return c, stats
+
+        P_shard = P("shard")
+        specs = dict(
+            v_lo=P_shard,
+            v_hi=P_shard,
+            pl_child_lo=P_shard,
+            pl_child_hi=P_shard,
+            pl_par_lo=P_shard,
+            pl_par_hi=P_shard,
+            pl_n=P_shard,
+            frontier=P("shard", None),
+            fval=P_shard,
+            ebits=P_shard,
+            depth=P(),
+            wchunk=P(),
+            waves=P(),
+            gen_lo=P(),
+            gen_hi=P(),
+            new=P(),
+            sent_lo=P(),
+            sent_hi=P(),
+            max_cand=P(),
+            disc_found=P(),
+            disc_lo=P(),
+            disc_hi=P(),
+            overflow=P(),
+            f_overflow=P(),
+            c_overflow=P(),
+            done=P(),
+        )
+        seed_sm = shard_map(
+            seed_local, mesh=mesh, in_specs=P(), out_specs=specs
+        )
+        chunk_sm = shard_map(
+            chunk, mesh=mesh, in_specs=(specs,), out_specs=(specs, P())
+        )
+        return jax.jit(seed_sm), jax.jit(chunk_sm, donate_argnums=0)
+
+    # -- reconstruction ----------------------------------------------------
+
+    def _capture_final(self, carry) -> None:
+        self._final_tables = (
+            carry["pl_child_lo"],
+            carry["pl_child_hi"],
+            carry["pl_par_lo"],
+            carry["pl_par_hi"],
+            carry["pl_n"],
+        )
+
+    def _build_generated(self):
+        """Concatenate each shard's append-only (child, parent) log.
+        Per-shard arrays are laid out [S, L] after shard_map; pl_n[s]
+        rows of shard s are live."""
+        if self.generated is None:
+            c_lo, c_hi, p_lo, p_hi, pl_n = (
+                np.asarray(a) for a in self._final_tables
+            )
+            S = self.n_shards
+            L = c_lo.shape[0] // S
+            generated: dict = {}
+            for s in range(S):
+                n = int(pl_n[s])
+                sl = slice(s * L, s * L + n)
+                child = (
+                    c_hi[sl].astype(np.uint64) << np.uint64(32)
+                ) | c_lo[sl].astype(np.uint64)
+                parent = (
+                    p_hi[sl].astype(np.uint64) << np.uint64(32)
+                ) | p_lo[sl].astype(np.uint64)
+                for ch, pa in zip(child.tolist(), parent.tolist()):
+                    generated[int(ch)] = int(pa) if pa else None
+            self.generated = generated
+        return self.generated

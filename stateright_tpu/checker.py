@@ -129,6 +129,15 @@ class CheckerBuilder:
 
         return ShardedTpuBfsChecker(self, **kwargs)
 
+    def spawn_tpu_simulation(self, **kwargs) -> "Checker":
+        """Spawn the device simulation checker: N parallel random walks
+        under vmap, advancing in lockstep inside one jitted loop — the
+        accelerator re-design of the reference's simulation checker
+        (see checkers/tpu_simulation.py for semantics deltas)."""
+        from .checkers.tpu_simulation import TpuSimulationChecker
+
+        return TpuSimulationChecker(self, **kwargs)
+
     def spawn_tpu_sharded_sortmerge(self, **kwargs) -> "Checker":
         """Spawn the multi-chip SORT-MERGE wave engine: the all-to-all
         routing of spawn_tpu_sharded with owner-local dedup on the

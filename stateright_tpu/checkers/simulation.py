@@ -9,8 +9,8 @@ state, a cycle (per-trace fingerprint set, simulation.rs:207, 250-261),
 or the boundary. ``unique_state_count`` is approximate — it equals
 ``state_count`` (simulation.rs:380-384).
 
-The TPU analog of this engine is N-parallel random walks under ``vmap``;
-see :mod:`stateright_tpu.checkers.tpu`.
+The TPU analog of this engine is N-parallel random walks under ``vmap``:
+``CheckerBuilder.spawn_tpu_simulation`` (checkers/tpu_simulation.py).
 """
 
 from __future__ import annotations

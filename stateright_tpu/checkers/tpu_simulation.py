@@ -1,0 +1,349 @@
+"""Device-side simulation: N parallel random walks under ``vmap``.
+
+The accelerator re-design of the reference's simulation checker
+(src/checker/simulation.rs): where one host thread walks one trace at
+a time from init to terminal/cycle/boundary, the device advances N
+walks per step in lockstep — ``vmap`` over the encoded ``step_vec``,
+a per-walk uniform choice among the valid successors, and property
+bitmaps folded into per-property discovery flags, all inside a jitted
+``lax.fori_loop`` so the host reads back one packed stats vector per
+run.
+
+Semantics relative to the reference:
+
+* Walks that reach a terminal state (no valid successor) check
+  surviving EventuallyBits (an eventually-counterexample,
+  checker.rs:559-566) and then RESTART from an init state with a fresh
+  ebits mask — the device analog of simulation.rs:180-364's
+  trace-per-iteration loop.
+* Per-trace cycle detection (simulation.rs:207, 250-261 keeps a host
+  HashSet per trace) is replaced by the ``max_steps`` walk bound:
+  cycles simply burn steps until the bound restarts the walk. Cycles
+  are therefore treated as non-terminal for eventually properties —
+  the same documented false-negative class as the reference's
+  revisit behavior (bfs.rs:285-303).
+* ``unique_state_count`` is approximate and equals ``state_count``,
+  exactly as in the reference (simulation.rs:380-384).
+
+Randomness is counter-based (splitmix64 over (seed, step) folded with
+the walk index), so runs are reproducible for a fixed seed and walk
+count, mirroring the derived per-trace seeds of simulation.rs:114-167.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..checker import Checker, CheckerBuilder
+from ..encoding import EncodedModel
+from ..model import Expectation
+from ..ops.fingerprint import fingerprint_u32v
+from ..path import Path
+from ..report import ReportData, Reporter
+from .tpu import TpuBfsChecker, _fp_int
+
+
+class TpuSimulationChecker(TpuBfsChecker):
+    """``CheckerBuilder.spawn_tpu_simulation()`` — N vmapped random
+    walks. Reuses the wave engine's result surface (discovery
+    fingerprints, host-replay path reconstruction via parent-free
+    re-walk is NOT available: simulation reports discovery
+    fingerprints and example/counterexample existence, as the
+    reference's simulation checker reports discovered paths only for
+    the traces it kept)."""
+
+    def __init__(
+        self,
+        builder: CheckerBuilder,
+        encoded: Optional[EncodedModel] = None,
+        n_walks: int = 1024,
+        max_steps: int = 64,
+        rounds: int = 4,
+        seed: int = 0,
+    ):
+        super().__init__(
+            builder,
+            encoded=encoded,
+            capacity=1,
+            frontier_capacity=1,
+            track_paths=False,
+        )
+        self.n_walks = n_walks
+        self.max_steps = max_steps
+        self.rounds = rounds
+        self.seed = seed
+
+    def _cache_extras(self) -> tuple:
+        return ("tpu-sim", self.n_walks, self.max_steps, self.rounds,
+                self.seed)
+
+    def discoveries(self):
+        raise RuntimeError(
+            "the device simulation checker reports discovery existence "
+            "and fingerprints only (discovered_property_names / "
+            "discovery_fingerprints); use spawn_simulation or an "
+            "exhaustive checker for counterexample paths"
+        )
+
+    # -- device program ----------------------------------------------------
+
+    def _build_programs(self, n0: int):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        enc = self.encoded
+        props = list(self.model.properties())
+        n_props = len(props)
+        evt_idx = [
+            i for i, p in enumerate(props)
+            if p.expectation == Expectation.EVENTUALLY
+        ]
+        if evt_idx and max(evt_idx) >= 32:
+            raise ValueError(
+                "the TPU engine supports eventually properties only at "
+                "property indices < 32; reorder properties() so eventually "
+                f"properties come first (got index {max(evt_idx)})"
+            )
+        K, W = enc.max_actions, enc.width
+        N = self.n_walks
+        max_steps = self.max_steps
+        rounds = self.rounds
+        seed = self.seed
+        ebits_init = self._eventually_bits_init()
+
+        def rand_bits(step, salt):
+            """Counter-based per-walk uniform bits: splitmix over
+            (seed, step, salt) mixed with the walk index."""
+            base = jnp.uint32(seed) ^ (
+                step.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+            ) ^ jnp.uint32((salt * 0x85EBCA6B) & 0xFFFFFFFF)
+            rows = jnp.stack(
+                [
+                    jnp.broadcast_to(base, (N,)),
+                    jnp.arange(N, dtype=jnp.uint32),
+                ],
+                axis=1,
+            )
+            lo, _ = fingerprint_u32v(rows, jnp)
+            return lo
+
+        def seed_fn(init_rows):
+            # Each walk starts at a (cyclically assigned) init state.
+            idx = jnp.arange(N, dtype=jnp.uint32) % jnp.uint32(n0)
+            walks = init_rows[idx]
+            ebits = jnp.full(N, jnp.uint32(ebits_init))
+            return dict(
+                walks=walks,
+                ebits=ebits,
+                walk_depth=jnp.ones(N, jnp.uint32),
+                steps=jnp.uint32(0),
+                states=jnp.uint32(N),  # init states count as visited
+                depth=jnp.uint32(1),
+                disc_found=jnp.zeros(n_props, dtype=bool),
+                disc_lo=jnp.zeros(n_props, dtype=jnp.uint32),
+                disc_hi=jnp.zeros(n_props, dtype=jnp.uint32),
+                init=init_rows,
+            )
+
+        def eval_block(walks, ebits, c):
+            """Property bitmap + discovery folding over a walk block;
+            returns (succs, valid, terminal, ebits', disc triple)."""
+            f_lo, f_hi = fingerprint_u32v(walks, jnp)
+            if n_props:
+                cond = jax.vmap(enc.property_conditions_vec)(walks)
+            else:
+                cond = jnp.zeros((N, 0), dtype=bool)
+            for i in evt_idx:
+                ebits = jnp.where(
+                    cond[:, i], ebits & ~jnp.uint32(1 << i), ebits
+                )
+
+            succs, valid = jax.vmap(enc.step_vec)(walks)
+            bound = jax.vmap(
+                lambda row: jax.vmap(enc.within_boundary_vec)(row)
+            )(succs)
+            valid = valid & bound
+            n_valid = jnp.sum(valid, axis=1)
+            terminal = n_valid == 0
+
+            disc_found = c["disc_found"]
+            disc_lo, disc_hi = c["disc_lo"], c["disc_hi"]
+            for i, p in enumerate(props):
+                if p.expectation == Expectation.ALWAYS:
+                    mask = ~cond[:, i]
+                elif p.expectation == Expectation.SOMETIMES:
+                    mask = cond[:, i]
+                else:
+                    mask = terminal & (
+                        (ebits & jnp.uint32(1 << i)) != 0
+                    )
+                hit = jnp.any(mask)
+                row = jnp.argmax(mask)
+                fresh = hit & ~disc_found[i]
+                disc_found = disc_found.at[i].set(disc_found[i] | hit)
+                disc_lo = disc_lo.at[i].set(
+                    jnp.where(fresh, f_lo[row], disc_lo[i])
+                )
+                disc_hi = disc_hi.at[i].set(
+                    jnp.where(fresh, f_hi[row], disc_hi[i])
+                )
+            return (succs, valid, n_valid, terminal, ebits,
+                    disc_found, disc_lo, disc_hi)
+
+        def step_once(step, c, salt):
+            walks = c["walks"]
+            (
+                succs, valid, n_valid, terminal, ebits,
+                disc_found, disc_lo, disc_hi,
+            ) = eval_block(walks, c["ebits"], c)
+
+            # Uniform choice among the valid successors of each walk.
+            r = rand_bits(step, salt)
+            pick = r % jnp.maximum(n_valid, 1).astype(jnp.uint32)
+            csum = jnp.cumsum(valid, axis=1)
+            choice = jnp.argmax(csum > pick[:, None], axis=1)
+            nxt = jnp.take_along_axis(
+                succs, choice[:, None, None], axis=1
+            )[:, 0]
+
+            # Terminal walks restart from a (rotating) init state with
+            # fresh ebits (simulation.rs trace-per-iteration).
+            restart_idx = (
+                jnp.arange(N, dtype=jnp.uint32)
+                + step.astype(jnp.uint32)
+            ) % jnp.uint32(n0)
+            restart = c["init"][restart_idx]
+            nxt = jnp.where(terminal[:, None], restart, nxt)
+            ebits = jnp.where(
+                terminal, jnp.uint32(ebits_init), ebits
+            )
+            # Per-walk depth: +1 per transition, reset on restart; the
+            # reported max_depth is the deepest TRACE, not the loop
+            # step counter.
+            walk_depth = jnp.where(
+                terminal, jnp.uint32(1), c["walk_depth"] + 1
+            )
+            return dict(
+                walks=nxt,
+                ebits=ebits,
+                walk_depth=walk_depth,
+                steps=c["steps"] + 1,
+                states=c["states"] + jnp.uint32(N),
+                depth=jnp.maximum(c["depth"], jnp.max(walk_depth)),
+                disc_found=disc_found,
+                disc_lo=disc_lo,
+                disc_hi=disc_hi,
+                init=c["init"],
+            )
+
+        def run(init_rows):
+            c = seed_fn(init_rows)
+            for salt in range(rounds):
+                # Each round is one bounded walk segment; walks restart
+                # between rounds for trace diversity.
+                c = lax.fori_loop(
+                    0,
+                    max_steps,
+                    lambda s, cc: step_once(s, cc, salt),
+                    c,
+                )
+                # The round's FINAL states were generated and counted
+                # inside the loop but not yet property-checked —
+                # evaluate them before restarting the walks.
+                (_, _, _, _, _, disc_found, disc_lo, disc_hi) = (
+                    eval_block(c["walks"], c["ebits"], c)
+                )
+                idx = (
+                    jnp.arange(N, dtype=jnp.uint32)
+                    + jnp.uint32(salt)
+                ) % jnp.uint32(n0)
+                c = dict(
+                    c,
+                    walks=init_rows[idx],
+                    ebits=jnp.full(N, jnp.uint32(ebits_init)),
+                    walk_depth=jnp.ones(N, jnp.uint32),
+                    disc_found=disc_found,
+                    disc_lo=disc_lo,
+                    disc_hi=disc_hi,
+                )
+            stats = jnp.concatenate(
+                [
+                    jnp.stack(
+                        [
+                            c["states"],
+                            c["depth"],
+                        ]
+                    ),
+                    c["disc_found"].astype(jnp.uint32),
+                    c["disc_lo"],
+                    c["disc_hi"],
+                ]
+            )
+            return stats
+
+        return jax.jit(run), None
+
+    # -- host orchestration ------------------------------------------------
+
+    def _run(self, reporter: Optional[Reporter] = None) -> None:
+        import jax.numpy as jnp
+
+        enc = self.encoded
+        props = list(self.model.properties())
+        n_props = len(props)
+        init = np.asarray(enc.init_vecs(), dtype=np.uint32).reshape(
+            -1, enc.width
+        )
+        n0 = init.shape[0]
+        if n0 == 0:
+            return
+        if self._programs is None:
+            from .tpu import _CHUNK_CACHE, _enable_persistent_cache
+
+            _enable_persistent_cache()
+            key_fn = getattr(enc, "cache_key", None)
+            if key_fn is not None:
+                cache_key = (
+                    type(self),
+                    self._cache_extras(),
+                    type(enc),
+                    key_fn(),
+                    enc.width,
+                    enc.max_actions,
+                    n0,
+                    tuple(
+                        (p.name, p.expectation)
+                        for p in self.model.properties()
+                    ),
+                )
+                if cache_key not in _CHUNK_CACHE:
+                    _CHUNK_CACHE[cache_key] = self._build_programs(n0)
+                self._programs = _CHUNK_CACHE[cache_key]
+            else:
+                self._programs = self._build_programs(n0)
+        run_fn, _ = self._programs
+        stats = np.asarray(run_fn(jnp.asarray(init)))
+        self._total_states = int(stats[0])
+        self._unique_states = int(stats[0])  # approximate, as reference
+        self._max_depth = int(stats[1])
+        disc_found = stats[2 : 2 + n_props]
+        disc_lo = stats[2 + n_props : 2 + 2 * n_props]
+        disc_hi = stats[2 + 2 * n_props : 2 + 3 * n_props]
+        for i, prop in enumerate(props):
+            if disc_found[i]:
+                self._discovered_fps[prop.name] = _fp_int(
+                    disc_lo[i], disc_hi[i]
+                )
+        if reporter is not None:
+            reporter.report_checking(
+                ReportData(
+                    total_states=self._total_states,
+                    unique_states=self._unique_states,
+                    max_depth=self._max_depth,
+                    duration_sec=self.duration_sec(),
+                    done=True,
+                )
+            )

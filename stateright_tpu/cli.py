@@ -280,6 +280,47 @@ def _linearizable(sub: str, args: list[str]) -> None:
         _usage("linearizable-register")
 
 
+def _timers(sub: str, args: list[str]) -> None:
+    from .models.timers import PingerModelCfg, pinger_model
+
+    server_count = _opt(args, 0, 3)
+    cfg = PingerModelCfg(server_count=server_count)
+    if sub == "check":
+        network = _network(args, 1)
+        print("Model checking Pingers")
+        # The pinger space is unbounded (timers.rs runs it the same
+        # way); interrupt or pass a depth bound via `explore`.
+        _report(pinger_model(cfg, network).checker().spawn_dfs())
+    elif sub == "explore":
+        address = _opt(args, 1, "localhost:3000", parse=str)
+        network = _network(args, 2)
+        print(f"Exploring state space for Pingers on {address}.")
+        pinger_model(cfg, network).checker().serve(address)
+    else:
+        _usage("timers")
+
+
+def _interaction(sub: str, args: list[str]) -> None:
+    from .models.interaction import interaction_model
+
+    if sub == "check":
+        # interaction.rs:44 bounds the loosely-bounded space at depth
+        # 30; an optional DEPTH argument trades coverage for time (the
+        # reference explores this space with a Rust thread pool).
+        depth = _opt(args, 0, 30)
+        checker = (
+            interaction_model().checker().target_max_depth(depth).spawn_bfs()
+        )
+        _report(checker)
+        checker.assert_properties()
+    elif sub == "explore":
+        address = _opt(args, 0, "localhost:3000", parse=str)
+        print(f"Exploring the interaction model on {address}.")
+        interaction_model().checker().target_max_depth(30).serve(address)
+    else:
+        _usage("interaction")
+
+
 _MODELS = {
     "2pc": (_2pc, ["check", "check-sym", "check-tpu", "explore"]),
     "paxos": (_paxos, ["check", "check-tpu", "explore", "spawn"]),
@@ -287,6 +328,8 @@ _MODELS = {
     "increment-lock": (_increment_lock, ["check", "check-sym", "check-tpu", "explore"]),
     "single-copy-register": (_single_copy, ["check", "check-tpu", "explore", "spawn"]),
     "linearizable-register": (_linearizable, ["check", "check-tpu", "explore", "spawn"]),
+    "timers": (_timers, ["check", "explore"]),
+    "interaction": (_interaction, ["check", "explore"]),
 }
 
 

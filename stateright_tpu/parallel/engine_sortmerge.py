@@ -193,7 +193,6 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
         # unused bucket slots (fingerprints are never 0).
         E = W + 3 if track_paths else W + 1
         EB = E - 1
-        E2 = E + 2
         mesh = self.mesh
 
         def bool_any(x):
@@ -308,10 +307,11 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             ).astype(jnp.uint32)
             starts = edges[:-1]
             counts = edges[1:] - starts
+            # Only the per-destination tile size is a physical limit
+            # here (the routing sort spans the full F*K tensor);
+            # cand_capacity shapes the Bd default above.
             route_ovf = jnp.any(counts > jnp.uint32(Bd))
-            c_overflow = c["c_overflow"] | bool_any(
-                route_ovf | (n_cand > jnp.uint32(B))
-            )
+            c_overflow = c["c_overflow"] | bool_any(route_ovf)
 
             # Payload rows for the send buffer, fetched per destination
             # run: state lanes, parent fp, ebits, own fp.

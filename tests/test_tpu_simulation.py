@@ -1,0 +1,65 @@
+"""Device simulation checker (checkers/tpu_simulation.py): vmapped
+random walks discover the same property set the exhaustive engines do
+on violation workloads, and never discover anything the host doesn't."""
+
+from stateright_tpu.models.increment import Increment, IncrementLock
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+
+def test_tpu_simulation_finds_lost_update():
+    host = Increment(thread_count=3).checker().spawn_bfs().join()
+    sim = (
+        Increment(thread_count=3)
+        .checker()
+        .spawn_tpu_simulation(n_walks=256, max_steps=16, rounds=2)
+        .join()
+    )
+    assert sim.discovered_property_names() == set(host.discoveries())
+    # Discovery fingerprints correspond to real encoded states: the
+    # violated always property was seen at a specific state.
+    assert "fin" in sim.discovery_fingerprints()
+
+
+def test_tpu_simulation_no_false_discoveries():
+    """increment_lock has no violations; simulation must not invent
+    any (always/eventually undiscovered), and reports approximate
+    counts like the reference (state_count == unique_state_count)."""
+    sim = (
+        IncrementLock(thread_count=2)
+        .checker()
+        .spawn_tpu_simulation(n_walks=128, max_steps=24, rounds=2)
+        .join()
+    )
+    assert sim.discovered_property_names() == set()
+    assert sim.state_count() == sim.unique_state_count()
+    sim.assert_properties()
+
+
+def test_tpu_simulation_finds_sometimes_example():
+    host = TwoPhaseSys(rm_count=3).checker().spawn_bfs().join()
+    sim = (
+        TwoPhaseSys(rm_count=3)
+        .checker()
+        .spawn_tpu_simulation(n_walks=512, max_steps=24, rounds=4)
+        .join()
+    )
+    assert sim.discovered_property_names() <= set(host.discoveries())
+    # With 2k traces over a 288-state space the sometimes examples are
+    # found with overwhelming probability.
+    assert sim.discovered_property_names() == set(host.discoveries())
+
+
+def test_tpu_simulation_reproducible():
+    a = (
+        Increment(thread_count=3)
+        .checker()
+        .spawn_tpu_simulation(n_walks=128, max_steps=12, seed=7)
+        .join()
+    )
+    b = (
+        Increment(thread_count=3)
+        .checker()
+        .spawn_tpu_simulation(n_walks=128, max_steps=12, seed=7)
+        .join()
+    )
+    assert a.discovery_fingerprints() == b.discovery_fingerprints()

@@ -581,35 +581,7 @@ class TpuBfsChecker(Checker):
             raise ValueError(f"frontier capacity {F} < {n0} init states")
 
         if self._programs is None:
-            _enable_persistent_cache()
-            # Share compiled programs between checkers only when the
-            # encoding declares an identity (cache_key): shapes alone
-            # can't distinguish different transition functions.
-            key_fn = getattr(enc, "cache_key", None)
-            if key_fn is not None:
-                cache_key = (
-                    type(self),
-                    self._cache_extras(),
-                    type(enc),
-                    key_fn(),
-                    enc.width,
-                    enc.max_actions,
-                    F,
-                    self.capacity,
-                    self.cand_capacity,
-                    self.probe_rounds,
-                    self.waves_per_sync,
-                    self.track_paths,
-                    n0,
-                    self.builder._target_state_count,
-                    self.builder._target_max_depth,
-                    tuple((p.name, p.expectation) for p in props),
-                )
-                if cache_key not in _CHUNK_CACHE:
-                    _CHUNK_CACHE[cache_key] = self._build_programs(n0)
-                self._programs = _CHUNK_CACHE[cache_key]
-            else:
-                self._programs = self._build_programs(n0)
+            self._programs = self._lookup_programs(n0)
         seed_fn, chunk_fn = self._programs
 
         carry = seed_fn(jnp.asarray(init))  # the run's one upload
@@ -671,6 +643,42 @@ class TpuBfsChecker(Checker):
                 self._discovered_fps[prop.name] = fp
                 if self.track_paths:
                     self._discoveries[prop.name] = self._reconstruct(fp)
+
+    def _lookup_programs(self, n0: int):
+        """Build-or-fetch the compiled device programs. Programs are
+        shared between checkers only when the encoding declares an
+        identity (cache_key): shapes alone can't distinguish different
+        transition functions. Engine variants reuse this helper so the
+        cache key stays defined in exactly one place."""
+        _enable_persistent_cache()
+        enc = self.encoded
+        key_fn = getattr(enc, "cache_key", None)
+        if key_fn is None:
+            return self._build_programs(n0)
+        cache_key = (
+            type(self),
+            self._cache_extras(),
+            type(enc),
+            key_fn(),
+            enc.width,
+            enc.max_actions,
+            self.frontier_capacity,
+            self.capacity,
+            self.cand_capacity,
+            self.probe_rounds,
+            self.waves_per_sync,
+            self.track_paths,
+            n0,
+            self.builder._target_state_count,
+            self.builder._target_max_depth,
+            tuple(
+                (p.name, p.expectation)
+                for p in self.model.properties()
+            ),
+        )
+        if cache_key not in _CHUNK_CACHE:
+            _CHUNK_CACHE[cache_key] = self._build_programs(n0)
+        return _CHUNK_CACHE[cache_key]
 
     def _consume_extra_stats(self, extra: np.ndarray) -> None:
         """Hook for engine variants that append metric lanes after the

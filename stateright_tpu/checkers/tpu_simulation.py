@@ -80,6 +80,9 @@ class TpuSimulationChecker(TpuBfsChecker):
                 self.seed)
 
     def discoveries(self):
+        self._ensure_run()
+        if not self._discovered_fps:
+            return {}
         raise RuntimeError(
             "the device simulation checker reports discovery existence "
             "and fingerprints only (discovered_property_names / "
@@ -301,29 +304,7 @@ class TpuSimulationChecker(TpuBfsChecker):
         if n0 == 0:
             return
         if self._programs is None:
-            from .tpu import _CHUNK_CACHE, _enable_persistent_cache
-
-            _enable_persistent_cache()
-            key_fn = getattr(enc, "cache_key", None)
-            if key_fn is not None:
-                cache_key = (
-                    type(self),
-                    self._cache_extras(),
-                    type(enc),
-                    key_fn(),
-                    enc.width,
-                    enc.max_actions,
-                    n0,
-                    tuple(
-                        (p.name, p.expectation)
-                        for p in self.model.properties()
-                    ),
-                )
-                if cache_key not in _CHUNK_CACHE:
-                    _CHUNK_CACHE[cache_key] = self._build_programs(n0)
-                self._programs = _CHUNK_CACHE[cache_key]
-            else:
-                self._programs = self._build_programs(n0)
+            self._programs = self._lookup_programs(n0)
         run_fn, _ = self._programs
         stats = np.asarray(run_fn(jnp.asarray(init)))
         self._total_states = int(stats[0])

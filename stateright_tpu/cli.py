@@ -110,13 +110,24 @@ def _paxos(sub: str, args: list[str]) -> None:
             f"Model checking Single Decree Paxos with {client_count} "
             "clients on the TPU wave engine."
         )
+        # Measured spaces: 1c=265, 2c=16,668, 3c=1,194,428 (~71x per
+        # client); 4c is estimated ~85M — runnable on a 16GB chip in
+        # fingerprint-only mode, sized accordingly.
+        caps = {
+            1: (1 << 10, 1 << 8, 1 << 10),
+            2: (1 << 15, 1 << 12, 1 << 14),
+            3: (5 << 18, 1 << 18, 1 << 19),
+            4: (7 << 24, 1 << 22, 1 << 24),
+        }
+        cap, fcap, ccap = caps.get(client_count, caps[4])
         _report(
             paxos_model(cfg)
             .checker()
             .spawn_tpu_sortmerge(
-                capacity=1 << 15,
-                frontier_capacity=1 << 12,
-                cand_capacity=1 << 14,
+                capacity=cap,
+                frontier_capacity=fcap,
+                cand_capacity=ccap,
+                track_paths=client_count <= 2,
             )
         )
     elif sub == "explore":

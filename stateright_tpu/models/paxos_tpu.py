@@ -69,18 +69,22 @@ from .paxos import (
 )
 
 # -- lane layout ---------------------------------------------------------
-# Server lane (one per server):
-_B_BALLOT, _W_BALLOT = 0, 3       # ballot enum
-_B_PROP, _W_PROP = 3, 2           # proposal code (0 = None)
-_B_ACC, _W_ACC = 5, 4             # accepted la-code (0 = None)
-_B_DEC = 9                        # is_decided
-_B_ACCEPTS, _W_ACCEPTS = 10, 3    # accepts id-mask
-_B_PREP, _W_PREP = 13, 4          # prepares[i]: 0 = absent, else 1+la
-# Client/history lane: per client j at bit j*6:
-#   +0 (2b) actor phase: 0 awaiting PutOk, 1 awaiting GetOk, 2 done
-#   +2 (2b) history phase: 0 W-inflight, 1 W-done, 2 +R-inflight, 3 done
-#   +4 (2b) read value code (0 '\x00', 1+ value index)
+# The layout is COMPUTED per configuration (bit widths grow with the
+# client count): each server gets a main lane [ballot enum | proposal
+# code | accepted la-code | is_decided | accepts id-mask | prepares]
+# and, when the prepares map no longer fits (client_count=4), a second
+# per-server lane holding prepares alone. The client/history lane packs
+# per client j at bit j*stride:
+#   +0 (2b)     actor phase: 0 awaiting PutOk, 1 awaiting GetOk, 2 done
+#   +2 (2b)     history phase: 0 W-inflight, 1 W-done, 2 +R-inflight,
+#               3 done
+#   +4 (W_RV b) read value code (0 '\x00', 1+ value index)
 _B_POISON = 30
+
+
+def _bits(n: int) -> int:
+    """Bits to hold values 0..n."""
+    return max(1, n.bit_length())
 
 
 def _field(lane, shift, width, xp):
@@ -112,8 +116,12 @@ class EnvSpec:
 class PaxosEncoded(EncodedModelBase):
     """EncodedModel for ``paxos_model(PaxosModelCfg(...))``.
 
-    Supports the reference benchmark shape: 3 servers, 1 put per
-    client, 1-2 clients (examples/paxos.rs:325 pins 2c/3s = 16,668).
+    Supports the reference benchmark shapes: 3 servers, 1 put per
+    client, 1-4 clients (``paxos check N``, examples/paxos.rs:352-465;
+    2c/3s pinned at 16,668, paxos.rs:325). The lane layout, ballot
+    universe, and coexistence closure are computed per configuration —
+    client_count=4 puts two proposals on leader 0 and moves the
+    prepares maps to dedicated per-server lanes.
     """
 
     def __init__(self, cfg: PaxosModelCfg, network=None):
@@ -122,9 +130,9 @@ class PaxosEncoded(EncodedModelBase):
                 "PaxosEncoded supports server_count=3, put_count=1 "
                 f"(got {cfg})"
             )
-        if not (1 <= cfg.client_count <= 2):
+        if not (1 <= cfg.client_count <= 4):
             raise ValueError(
-                f"PaxosEncoded supports 1-2 clients (got {cfg.client_count})"
+                f"PaxosEncoded supports 1-4 clients (got {cfg.client_count})"
             )
         if network is not None and type(network).__name__ != (
             "UnorderedNonDuplicating"
@@ -146,15 +154,13 @@ class PaxosEncoded(EncodedModelBase):
         ]
         self.P = len(self.proposals)
 
-        # Ballots. Leaders = put-target servers (client i -> i % S).
-        # With 1 leader, rounds stop at 1. With 2 leaders l0<l1 the
-        # reachable ballots are (1,l0) (1,l1) (2,l0) (2,l1) — a server
-        # Putting at round r requires its ballot to have been raised by
-        # the *other* leader's round-r ballot, and each server Puts at
-        # most once, so rounds cap at the leader count. Coexistence:
-        # (2,l0) implies l0 Put after adopting (1,l1), excluding (1,l0)
-        # — so {(1,l0),(1,l1)}, {(1,l0),(2,l1)}, {(1,l1),(2,l0)} are
-        # the only co-reachable pairs.
+        # Ballots. Leaders = put-target servers (client i -> i % S);
+        # with 4 clients on 3 servers, leader 0 serves two clients but
+        # still Puts at most once (proposal-None guard), so each leader
+        # owns exactly one put-ballot and rounds cap at the LEADER
+        # count: a Put at round r requires the server to have adopted
+        # some round-(r-1) ballot first, and the support chain
+        # 1, 2, ..., r needs r distinct leaders.
         self.leaders = sorted({i % self.S for i in self.clients})
         ballots = [(r, l) for r in range(1, len(self.leaders) + 1)
                    for l in self.leaders]
@@ -166,16 +172,43 @@ class PaxosEncoded(EncodedModelBase):
             self.ballot_enum[(r, Id(l))] = n + 1
         self.NB = len(ballots)
 
+        # Joint feasibility: an assignment round[l] (or None = l never
+        # Put) is realizable iff every assigned round r >= 2 is
+        # supported by some OTHER leader assigned exactly r-1. Two
+        # ballots coexist iff some realizable assignment contains both
+        # — computed by brute force over the <= (R+1)^|leaders|
+        # assignments instead of a hand-derived pair rule (the round-2
+        # rule was specific to two leaders).
+        import itertools as _it
+
+        R = len(self.leaders)
+        feasible_pairs: set = set()
+        for rounds_assign in _it.product(
+            [None] + list(range(1, R + 1)), repeat=R
+        ):
+            ok = True
+            for l_idx, r in enumerate(rounds_assign):
+                if r is not None and r >= 2 and not any(
+                    r2 == r - 1
+                    for l2_idx, r2 in enumerate(rounds_assign)
+                    if l2_idx != l_idx and r2 is not None
+                ):
+                    ok = False
+                    break
+            if not ok:
+                continue
+            assigned = [
+                self.ballot_enum[(r, Id(self.leaders[l_idx]))]
+                for l_idx, r in enumerate(rounds_assign)
+                if r is not None
+            ]
+            for b1 in assigned:
+                for b2 in assigned:
+                    feasible_pairs.add((b1, b2))
+
         def coexists(b1: int, b2: int) -> bool:
             """May ballot enums b1 < b2 both exist in one run?"""
-            (r1, l1), (r2, l2) = ballots[b1 - 1], ballots[b2 - 1]
-            if l1 == l2:
-                return False  # one Put per server: one ballot per leader
-            if r1 == r2:
-                return r1 == 1
-            # (higher round, l2) requires l2's Put at (r2-1, l1)=b1's
-            # round; only coexists when b1 is that raising ballot.
-            return r2 == r1 + 1
+            return (b1, b2) in feasible_pairs
 
         # choosable(b): proposals a leader can drive under ballot b —
         # its own put, or any adoptable last_accepted from a lower
@@ -199,13 +232,48 @@ class PaxosEncoded(EncodedModelBase):
         self.choosable = {b: sorted(ch) for b, ch in choosable.items()}
         self.la_universe = la_universe
 
+        # -- computed lane layout (widths scale with NB and P) -----------
+        la_max = self.NB * self.P          # la codes 0..la_max
+        self.W_BALLOT = _bits(self.NB)
+        self.W_PROP = _bits(self.P)
+        self.W_ACC = _bits(la_max)
+        self.W_ACCEPTS = self.S
+        self.W_PREP = _bits(1 + la_max)    # prepares entry: 0 | 1+la
+        self.B_BALLOT = 0
+        self.B_PROP = self.B_BALLOT + self.W_BALLOT
+        self.B_ACC = self.B_PROP + self.W_PROP
+        self.B_DEC = self.B_ACC + self.W_ACC
+        self.B_ACCEPTS = self.B_DEC + 1
+        main_bits = self.B_ACCEPTS + self.W_ACCEPTS
+        # prepares ride in the main lane when they fit, else each
+        # server gets a dedicated prepares lane (client_count=4).
+        self.two_lane = main_bits + self.S * self.W_PREP > 32
+        self.B_PREP = 0 if self.two_lane else main_bits
+        #: client/history lane stride and read-value width
+        self.W_RV = _bits(self.P)
+        self.CST = 4 + self.W_RV
+        if self.CST * self.C > _B_POISON:
+            raise ValueError("client lane overflow")
+        #: linearizability-table radix per client: phase * TBV + rv
+        self.TBV = self.P + 1
+        self.TB = 4 * self.TBV
+
         self.universe = self._build_universe()
         self.index = {self._env_key(e): k for k, e in enumerate(self.universe)}
         self.K = len(self.universe)
         self.net_lanes = (self.K + 31) // 32
-        self.width = self.S + 1 + self.net_lanes
+        self.n_state_lanes = self.S * (2 if self.two_lane else 1) + 1
+        self.width = self.n_state_lanes + self.net_lanes
         self.max_actions = self.K
         self._lin_table = self._build_lin_table()
+
+    # -- computed-layout accessors ----------------------------------------
+
+    def _clane_index(self) -> int:
+        return self.S * (2 if self.two_lane else 1)
+
+    def _prep_lane(self, server: int) -> int:
+        return self.S + server if self.two_lane else server
 
     def cache_key(self):
         return (self.C, self.S, self.cfg.put_count)
@@ -326,17 +394,24 @@ class PaxosEncoded(EncodedModelBase):
         for i in range(self.S):
             s = state.actor_states[i].state
             lane = 0
-            lane |= self._ballot_code(s.ballot) << _B_BALLOT
-            lane |= self._prop_code(s.proposal) << _B_PROP
-            lane |= self._la_code(s.accepted) << _B_ACC
-            lane |= (1 if s.is_decided else 0) << _B_DEC
+            lane |= self._ballot_code(s.ballot) << self.B_BALLOT
+            lane |= self._prop_code(s.proposal) << self.B_PROP
+            lane |= self._la_code(s.accepted) << self.B_ACC
+            lane |= (1 if s.is_decided else 0) << self.B_DEC
             mask = 0
             for sid in s.accepts:
                 mask |= 1 << int(sid)
-            lane |= mask << _B_ACCEPTS
+            lane |= mask << self.B_ACCEPTS
+            prep = 0
             for sid, la in s.prepares.items():
-                lane |= (1 + self._la_code(la)) << (_B_PREP + _W_PREP * int(sid))
-            vec[i] = lane
+                prep |= (1 + self._la_code(la)) << (
+                    self.B_PREP + self.W_PREP * int(sid)
+                )
+            if self.two_lane:
+                vec[self._prep_lane(i)] = prep
+                vec[i] = lane
+            else:
+                vec[i] = lane | prep
         clane = 0
         for j, c in enumerate(self.clients):
             cs = state.actor_states[c]
@@ -349,10 +424,10 @@ class PaxosEncoded(EncodedModelBase):
             else:
                 raise ValueError(f"client state outside universe: {cs!r}")
             hphase, rval = self._history_phase(state.history, Id(c))
-            clane |= phase << (j * 6)
-            clane |= hphase << (j * 6 + 2)
-            clane |= rval << (j * 6 + 4)
-        vec[self.S] = clane
+            clane |= phase << (j * self.CST)
+            clane |= hphase << (j * self.CST + 2)
+            clane |= rval << (j * self.CST + 4)
+        vec[self._clane_index()] = clane
         for env, count in self._network_items(state.network):
             if count != 1:
                 raise ValueError(
@@ -362,7 +437,7 @@ class PaxosEncoded(EncodedModelBase):
             k = self.index.get(key)
             if k is None:
                 raise ValueError(f"envelope outside universe: {env!r}")
-            vec[self.S + 1 + k // 32] |= np.uint32(1 << (k % 32))
+            vec[self.n_state_lanes + k // 32] |= np.uint32(1 << (k % 32))
         if any(state.crashed) or any(t for t in state.timers_set):
             raise ValueError("crashes/timers outside the paxos universe")
         return vec
@@ -431,16 +506,18 @@ class PaxosEncoded(EncodedModelBase):
         """
         from ..semantics import LinearizabilityTester, Register
 
-        size = (4 * 3) ** self.C
+        size = self.TB ** self.C
         table = np.zeros(size, dtype=bool)
         import itertools
 
-        for combo in itertools.product(range(4), range(3), repeat=self.C):
+        for combo in itertools.product(
+            range(4), range(self.TBV), repeat=self.C
+        ):
             phases = combo[0::2]
             rvals = combo[1::2]
             idx = 0
             for ph, rv in zip(phases, rvals):
-                idx = idx * 12 + ph * 3 + rv
+                idx = idx * self.TB + ph * self.TBV + rv
             if sum(1 for p in phases if p > 0) > 1 or any(
                 rv > self.P for rv in rvals
             ):
@@ -468,20 +545,20 @@ class PaxosEncoded(EncodedModelBase):
     # -- device step -------------------------------------------------------
 
     def _bit(self, vec, k, xp):
-        lane = vec[self.S + 1 + k // 32]
+        lane = vec[self.n_state_lanes + k // 32]
         return ((lane >> xp.uint32(k % 32)) & xp.uint32(1)) != 0
 
     def _net_update(self, vec, clear_k, send_masks, xp):
         """Clear bit ``clear_k``; OR per-lane ``send_masks`` in."""
         out = vec
         for ln in range(self.net_lanes):
-            lane = vec[self.S + 1 + ln]
+            lane = vec[self.n_state_lanes + ln]
             if clear_k // 32 == ln:
                 lane = lane & ~xp.uint32(1 << (clear_k % 32))
             m = send_masks.get(ln)
             if m is not None:
                 lane = lane | m
-            out = out.at[self.S + 1 + ln].set(lane)
+            out = out.at[self.n_state_lanes + ln].set(lane)
         return out
 
     def _const_mask(self, keys) -> dict:
@@ -513,10 +590,10 @@ class PaxosEncoded(EncodedModelBase):
 
     def _on_put(self, vec, k, e: EnvSpec, xp):
         lane = vec[e.dst]
-        decided = _field(lane, _B_DEC, 1, xp) != 0
-        prop = _field(lane, _B_PROP, _W_PROP, xp)
-        ballot = _field(lane, _B_BALLOT, _W_BALLOT, xp)
-        acc = _field(lane, _B_ACC, _W_ACC, xp)
+        decided = _field(lane, self.B_DEC, 1, xp) != 0
+        prop = _field(lane, self.B_PROP, self.W_PROP, xp)
+        ballot = _field(lane, self.B_BALLOT, self.W_BALLOT, xp)
+        acc = _field(lane, self.B_ACC, self.W_ACC, xp)
         handled = (~decided) & (prop == 0)
         # New ballot: (round+1, dst). Rounds for this leader:
         rounds = sorted(
@@ -533,10 +610,11 @@ class PaxosEncoded(EncodedModelBase):
             nb = xp.where(hit, xp.uint32(self.ballot_enum[(r, Id(e.dst))]), nb)
             poison = poison & ~hit
         new_lane = xp.uint32(0)
-        new_lane = new_lane | (nb << _B_BALLOT)
-        new_lane = new_lane | (xp.uint32(e.prop) << _B_PROP)
-        new_lane = new_lane | (acc << _B_ACC)
-        new_lane = new_lane | ((acc + 1) << xp.uint32(_B_PREP + _W_PREP * e.dst))
+        new_lane = new_lane | (nb << self.B_BALLOT)
+        new_lane = new_lane | (xp.uint32(e.prop) << self.B_PROP)
+        new_lane = new_lane | (acc << self.B_ACC)
+        # Put RESETS prepares to {self: accepted} (paxos.rs:160-176).
+        prep = (acc + 1) << xp.uint32(self.W_PREP * e.dst)
         # Sends: Prepare(nb) to both peers — select the mask by round.
         masks: dict = {}
         for r in rounds:
@@ -552,15 +630,21 @@ class PaxosEncoded(EncodedModelBase):
                 masks[ln] = masks.get(ln, xp.uint32(0)) | xp.where(
                     hit, xp.uint32(m), xp.uint32(0)
                 )
-        out = vec.at[e.dst].set(xp.where(handled, new_lane, lane))
+        if self.two_lane:
+            out = vec.at[e.dst].set(xp.where(handled, new_lane, lane))
+            pl = self._prep_lane(e.dst)
+            out = out.at[pl].set(xp.where(handled, prep, vec[pl]))
+        else:
+            new_lane = new_lane | (prep << xp.uint32(self.B_PREP))
+            out = vec.at[e.dst].set(xp.where(handled, new_lane, lane))
         out = self._poison(out, poison, xp)
         out = self._net_update(out, k, masks, xp)
         return out, handled
 
     def _on_get(self, vec, k, e: EnvSpec, xp):
         lane = vec[e.dst]
-        decided = _field(lane, _B_DEC, 1, xp) != 0
-        acc = _field(lane, _B_ACC, _W_ACC, xp)
+        decided = _field(lane, self.B_DEC, 1, xp) != 0
+        acc = _field(lane, self.B_ACC, self.W_ACC, xp)
         handled = decided
         # Reply GetOk(value of accepted proposal).
         val = xp.where(acc > 0, ((acc - 1) % xp.uint32(self.P)) + 1, 0)
@@ -580,13 +664,16 @@ class PaxosEncoded(EncodedModelBase):
 
     def _on_putok(self, vec, k, e: EnvSpec, xp):
         j = self.clients.index(e.dst)
-        lane = vec[self.S]
-        phase = _field(lane, j * 6, 2, xp)
+        cl = self._clane_index()
+        lane = vec[cl]
+        phase = _field(lane, j * self.CST, 2, xp)
         handled = phase == 0
-        new_lane = _set_field(lane, j * 6, 2, xp.uint32(1), xp)
+        new_lane = _set_field(lane, j * self.CST, 2, xp.uint32(1), xp)
         # History: W returns, R invoked (phases 0 -> 2).
-        new_lane = _set_field(new_lane, j * 6 + 2, 2, xp.uint32(2), xp)
-        out = vec.at[self.S].set(xp.where(handled, new_lane, lane))
+        new_lane = _set_field(
+            new_lane, j * self.CST + 2, 2, xp.uint32(2), xp
+        )
+        out = vec.at[cl].set(xp.where(handled, new_lane, lane))
         get_key = (e.dst, (e.dst + 1) % self.S, "get", 0, 0, 0, 0)
         cm = self._const_mask([get_key])
         masks = {
@@ -598,23 +685,30 @@ class PaxosEncoded(EncodedModelBase):
 
     def _on_getok(self, vec, k, e: EnvSpec, xp):
         j = self.clients.index(e.dst)
-        lane = vec[self.S]
-        phase = _field(lane, j * 6, 2, xp)
+        cl = self._clane_index()
+        lane = vec[cl]
+        phase = _field(lane, j * self.CST, 2, xp)
         handled = phase == 1
-        new_lane = _set_field(lane, j * 6, 2, xp.uint32(2), xp)
-        new_lane = _set_field(new_lane, j * 6 + 2, 2, xp.uint32(3), xp)
-        new_lane = _set_field(new_lane, j * 6 + 4, 2, xp.uint32(e.value), xp)
-        out = vec.at[self.S].set(xp.where(handled, new_lane, lane))
+        new_lane = _set_field(lane, j * self.CST, 2, xp.uint32(2), xp)
+        new_lane = _set_field(
+            new_lane, j * self.CST + 2, 2, xp.uint32(3), xp
+        )
+        new_lane = _set_field(
+            new_lane, j * self.CST + 4, self.W_RV, xp.uint32(e.value), xp
+        )
+        out = vec.at[cl].set(xp.where(handled, new_lane, lane))
         out = self._net_update(out, k, {}, xp)
         return out, handled
 
     def _on_prepare(self, vec, k, e: EnvSpec, xp):
         lane = vec[e.dst]
-        decided = _field(lane, _B_DEC, 1, xp) != 0
-        ballot = _field(lane, _B_BALLOT, _W_BALLOT, xp)
-        acc = _field(lane, _B_ACC, _W_ACC, xp)
+        decided = _field(lane, self.B_DEC, 1, xp) != 0
+        ballot = _field(lane, self.B_BALLOT, self.W_BALLOT, xp)
+        acc = _field(lane, self.B_ACC, self.W_ACC, xp)
         handled = (~decided) & (ballot < e.ballot)
-        new_lane = _set_field(lane, _B_BALLOT, _W_BALLOT, xp.uint32(e.ballot), xp)
+        new_lane = _set_field(
+            lane, self.B_BALLOT, self.W_BALLOT, xp.uint32(e.ballot), xp
+        )
         # Send Prepared(b, la=accepted) to the leader; select the
         # envelope by the acceptor's current accepted code.
         masks: dict = {}
@@ -637,16 +731,19 @@ class PaxosEncoded(EncodedModelBase):
     def _on_prepared(self, vec, k, e: EnvSpec, xp):
         l = e.dst
         lane = vec[l]
-        decided = _field(lane, _B_DEC, 1, xp) != 0
-        ballot = _field(lane, _B_BALLOT, _W_BALLOT, xp)
-        prop = _field(lane, _B_PROP, _W_PROP, xp)
+        plane = vec[self._prep_lane(l)]
+        decided = _field(lane, self.B_DEC, 1, xp) != 0
+        ballot = _field(lane, self.B_BALLOT, self.W_BALLOT, xp)
+        prop = _field(lane, self.B_PROP, self.W_PROP, xp)
         handled = (~decided) & (ballot == e.ballot)
         # prepares[src] = 1 + la.
-        new_lane = _set_field(
-            lane, _B_PREP + _W_PREP * e.src, _W_PREP, xp.uint32(1 + e.la), xp
+        new_plane = _set_field(
+            plane, self.B_PREP + self.W_PREP * e.src, self.W_PREP,
+            xp.uint32(1 + e.la), xp,
         )
         entries = [
-            _field(new_lane, _B_PREP + _W_PREP * i, _W_PREP, xp)
+            _field(new_plane, self.B_PREP + self.W_PREP * i,
+                   self.W_PREP, xp)
             for i in range(self.S)
         ]
         count = sum((en != 0).astype(xp.uint32) for en in entries)
@@ -661,13 +758,18 @@ class PaxosEncoded(EncodedModelBase):
             best > 0, ((best - 1) % xp.uint32(self.P)) + 1, prop
         )
         acc_code = 1 + (e.ballot - 1) * self.P + (chosen - 1)
-        fired_lane = new_lane
-        fired_lane = _set_field(fired_lane, _B_PROP, _W_PROP, chosen, xp)
-        fired_lane = _set_field(fired_lane, _B_ACC, _W_ACC, acc_code, xp)
+        fired_lane = lane
         fired_lane = _set_field(
-            fired_lane, _B_ACCEPTS, _W_ACCEPTS, xp.uint32(1 << l), xp
+            fired_lane, self.B_PROP, self.W_PROP, chosen, xp
         )
-        new_lane = xp.where(fire, fired_lane, new_lane)
+        fired_lane = _set_field(
+            fired_lane, self.B_ACC, self.W_ACC, acc_code, xp
+        )
+        fired_lane = _set_field(
+            fired_lane, self.B_ACCEPTS, self.W_ACCEPTS,
+            xp.uint32(1 << l), xp,
+        )
+        new_lane = xp.where(fire, fired_lane, lane)
         masks: dict = {}
         covered = fire & xp.bool_(False)
         for p in self.choosable[e.ballot]:
@@ -684,19 +786,35 @@ class PaxosEncoded(EncodedModelBase):
                     hit, xp.uint32(m), xp.uint32(0)
                 )
         poison = fire & ~covered
-        out = vec.at[l].set(xp.where(handled, new_lane, lane))
+        if self.two_lane:
+            out = vec.at[l].set(xp.where(handled, new_lane, lane))
+            out = out.at[self._prep_lane(l)].set(
+                xp.where(handled, new_plane, plane)
+            )
+        else:
+            # Main lane and prepares share one lane: merge the updated
+            # prepares field range into the (possibly fired) main bits.
+            pmask = xp.uint32(
+                ((1 << (self.S * self.W_PREP)) - 1) << self.B_PREP
+            )
+            merged = (new_lane & ~pmask) | (new_plane & pmask)
+            out = vec.at[l].set(xp.where(handled, merged, lane))
         out = self._poison(out, poison, xp)
         out = self._net_update(out, k, masks, xp)
         return out, handled
 
     def _on_accept(self, vec, k, e: EnvSpec, xp):
         lane = vec[e.dst]
-        decided = _field(lane, _B_DEC, 1, xp) != 0
-        ballot = _field(lane, _B_BALLOT, _W_BALLOT, xp)
+        decided = _field(lane, self.B_DEC, 1, xp) != 0
+        ballot = _field(lane, self.B_BALLOT, self.W_BALLOT, xp)
         handled = (~decided) & (ballot <= e.ballot)
         acc_code = 1 + (e.ballot - 1) * self.P + (e.prop - 1)
-        new_lane = _set_field(lane, _B_BALLOT, _W_BALLOT, xp.uint32(e.ballot), xp)
-        new_lane = _set_field(new_lane, _B_ACC, _W_ACC, xp.uint32(acc_code), xp)
+        new_lane = _set_field(
+            lane, self.B_BALLOT, self.W_BALLOT, xp.uint32(e.ballot), xp
+        )
+        new_lane = _set_field(
+            new_lane, self.B_ACC, self.W_ACC, xp.uint32(acc_code), xp
+        )
         out = vec.at[e.dst].set(xp.where(handled, new_lane, lane))
         cm = self._const_mask([(e.dst, e.src, "accepted", e.ballot, 0, 0, 0)])
         masks = {
@@ -709,21 +827,23 @@ class PaxosEncoded(EncodedModelBase):
     def _on_accepted(self, vec, k, e: EnvSpec, xp):
         l = e.dst
         lane = vec[l]
-        decided = _field(lane, _B_DEC, 1, xp) != 0
-        ballot = _field(lane, _B_BALLOT, _W_BALLOT, xp)
-        prop = _field(lane, _B_PROP, _W_PROP, xp)
+        decided = _field(lane, self.B_DEC, 1, xp) != 0
+        ballot = _field(lane, self.B_BALLOT, self.W_BALLOT, xp)
+        prop = _field(lane, self.B_PROP, self.W_PROP, xp)
         handled = (~decided) & (ballot == e.ballot)
-        accepts = _field(lane, _B_ACCEPTS, _W_ACCEPTS, xp) | xp.uint32(
-            1 << e.src
-        )
+        accepts = _field(
+            lane, self.B_ACCEPTS, self.W_ACCEPTS, xp
+        ) | xp.uint32(1 << e.src)
         count = sum(
             ((accepts >> xp.uint32(i)) & 1).astype(xp.uint32)
             for i in range(self.S)
         )
         fire = handled & (count == 2)
-        new_lane = _set_field(lane, _B_ACCEPTS, _W_ACCEPTS, accepts, xp)
+        new_lane = _set_field(
+            lane, self.B_ACCEPTS, self.W_ACCEPTS, accepts, xp
+        )
         new_lane = xp.where(
-            fire, new_lane | xp.uint32(1 << _B_DEC), new_lane
+            fire, new_lane | xp.uint32(1 << self.B_DEC), new_lane
         )
         masks: dict = {}
         covered = fire & xp.bool_(False)
@@ -750,19 +870,24 @@ class PaxosEncoded(EncodedModelBase):
 
     def _on_decided(self, vec, k, e: EnvSpec, xp):
         lane = vec[e.dst]
-        decided = _field(lane, _B_DEC, 1, xp) != 0
+        decided = _field(lane, self.B_DEC, 1, xp) != 0
         handled = ~decided
         acc_code = 1 + (e.ballot - 1) * self.P + (e.prop - 1)
-        new_lane = _set_field(lane, _B_BALLOT, _W_BALLOT, xp.uint32(e.ballot), xp)
-        new_lane = _set_field(new_lane, _B_ACC, _W_ACC, xp.uint32(acc_code), xp)
-        new_lane = new_lane | xp.uint32(1 << _B_DEC)
+        new_lane = _set_field(
+            lane, self.B_BALLOT, self.W_BALLOT, xp.uint32(e.ballot), xp
+        )
+        new_lane = _set_field(
+            new_lane, self.B_ACC, self.W_ACC, xp.uint32(acc_code), xp
+        )
+        new_lane = new_lane | xp.uint32(1 << self.B_DEC)
         out = vec.at[e.dst].set(xp.where(handled, new_lane, lane))
         out = self._net_update(out, k, {}, xp)
         return out, handled
 
     def _poison(self, vec, cond, xp):
-        lane = vec[self.S]
-        return vec.at[self.S].set(
+        cl = self._clane_index()
+        lane = vec[cl]
+        return vec.at[cl].set(
             xp.where(cond, lane | xp.uint32(1 << _B_POISON), lane)
         )
 
@@ -771,12 +896,12 @@ class PaxosEncoded(EncodedModelBase):
     def property_conditions_vec(self, vec):
         import jax.numpy as jnp
 
-        clane = vec[self.S]
+        clane = vec[self._clane_index()]
         idx = jnp.uint32(0)
         for j in range(self.C):
-            ph = _field(clane, j * 6 + 2, 2, jnp)
-            rv = _field(clane, j * 6 + 4, 2, jnp)
-            idx = idx * 12 + ph * 3 + rv
+            ph = _field(clane, j * self.CST + 2, 2, jnp)
+            rv = _field(clane, j * self.CST + 4, self.W_RV, jnp)
+            idx = idx * self.TB + ph * self.TBV + rv
         table = jnp.asarray(self._lin_table)
         linearizable = table[idx] & (
             _field(clane, _B_POISON, 1, jnp) == 0
@@ -791,7 +916,9 @@ class PaxosEncoded(EncodedModelBase):
         )
         chosen = jnp.bool_(False)
         for ln, m in masks.items():
-            chosen = chosen | ((vec[self.S + 1 + ln] & jnp.uint32(m)) != 0)
+            chosen = chosen | (
+                (vec[self.n_state_lanes + ln] & jnp.uint32(m)) != 0
+            )
         return jnp.stack([linearizable, chosen])
 
 

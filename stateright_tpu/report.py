@@ -64,6 +64,21 @@ class WriteReporter(Reporter):
         self.out.flush()
 
     def report_discoveries(self, checker: "Checker") -> None:
+        # Fingerprint-only engines (track_paths=False, simulation)
+        # report the discovery fingerprint instead of a replayable
+        # path; full-path checkers keep the reference format.
+        fp_only = getattr(checker, "discovery_fingerprints", None)
+        track_paths = getattr(checker, "track_paths", True)
+        if fp_only is not None and not track_paths:
+            for name, fp in sorted(fp_only().items()):
+                classification = checker.discovery_classification(name)
+                self.out.write(
+                    f"Discovered \"{name}\" {classification.value} "
+                    f"{fp:#018x} (fingerprint only; re-run with "
+                    "track_paths=True for the trace)\n"
+                )
+            self.out.flush()
+            return
         for name, path in sorted(checker.discoveries().items()):
             classification = checker.discovery_classification(name)
             self.out.write(

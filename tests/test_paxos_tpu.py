@@ -119,3 +119,89 @@ def test_paxos_2clients_16668_tpu():
     assert tpu.unique_state_count() == 16668
     tpu.assert_properties()
     assert tpu.discovered_property_names() == {"value chosen"}
+
+
+def test_paxos_3clients_depth_differential():
+    """The generalized encoding (VERDICT r2 #3): `paxos check 3` on the
+    TPU engine matches host BFS state-for-state at bounded depths (the
+    full 1,194,428-state space is exercised on real hardware by
+    bench.py; the host oracle cannot reach it in test time)."""
+    cfg = PaxosModelCfg(client_count=3, server_count=3)
+    host = (
+        paxos_model(cfg).checker().target_max_depth(7).spawn_bfs().join()
+    )
+    tpu = (
+        paxos_model(cfg)
+        .checker()
+        .target_max_depth(7)
+        .spawn_tpu_sortmerge(
+            capacity=1 << 12,
+            frontier_capacity=1 << 10,
+            cand_capacity=1 << 12,
+            track_paths=False,
+        )
+        .join()
+    )
+    assert tpu.unique_state_count() == host.unique_state_count()
+    assert tpu.discovered_property_names() == set(host.discoveries())
+
+
+def test_paxos_4clients_universe_covers_shallow_space():
+    """client_count=4 (two proposals on leader 0, two-lane prepares):
+    every reachable host state at depth <= 6 encodes inside the bounded
+    universe, and the ballot closure brute-force admits the 3-leader
+    coexistence patterns the pairwise round-2 rule got wrong."""
+    from collections import deque
+
+    from stateright_tpu.models.paxos_tpu import PaxosEncoded
+
+    cfg = PaxosModelCfg(client_count=4, server_count=3)
+    enc = PaxosEncoded(cfg)
+    assert enc.two_lane
+    model = paxos_model(cfg)
+    [init] = model.init_states()
+    seen = {init: 0}
+    q = deque([init])
+    while q:
+        st = q.popleft()
+        d = seen[st]
+        enc.encode(st)  # raises if outside the universe
+        if d >= 6:
+            continue
+        for a in model.actions(st):
+            ns = model.next_state(st, a)
+            if ns is not None and ns not in seen:
+                seen[ns] = d + 1
+                q.append(ns)
+    assert len(seen) > 500
+
+
+def test_paxos_coexistence_admits_same_round_pairs_with_3_leaders():
+    """(2,l1) and (2,l2) CAN coexist when a third leader supplies the
+    round-1 support — the 3-leader case the two-leader pair rule
+    excluded; and (3,l1)/(3,l2) cannot (only one leader remains for
+    rounds 1 and 2)."""
+    from stateright_tpu.models.paxos_tpu import PaxosEncoded
+
+    cfg = PaxosModelCfg(client_count=3, server_count=3)
+    enc = PaxosEncoded(cfg)
+    b = enc.ballot_enum
+    from stateright_tpu.actor import Id
+
+    b2l1 = b[(2, Id(1))]
+    b2l2 = b[(2, Id(2))]
+    b3l1 = b[(3, Id(1))]
+    b3l2 = b[(3, Id(2))]
+    # Reconstruct coexistence from the la_universe closure: ballot x's
+    # prepared messages may carry last-accepted entries from exactly
+    # the coexisting lower ballots.
+    las_of_b2l2 = enc.la_universe[b2l2]
+    assert any(
+        1 + (b2l1 - 1) * enc.P <= la < 1 + b2l1 * enc.P
+        for la in las_of_b2l2
+    )
+    las_of_b3l2 = enc.la_universe[b3l2]
+    assert not any(
+        1 + (b3l1 - 1) * enc.P <= la < 1 + b3l1 * enc.P
+        for la in las_of_b3l2
+    )

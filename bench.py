@@ -182,6 +182,9 @@ def tpu_workloads(quick=False):
                     capacity=11 << 20,
                     frontier_capacity=3 << 19,
                     cand_capacity=17 << 20,
+                    # Finer compaction tiles measured ~5% faster at this
+                    # scale (lax.sort is superlinear; PERF.md).
+                    tile_rows=1 << 20,
                 ),
                 10340352,
             )

@@ -51,7 +51,12 @@ class EncodedModel(Protocol):
     def property_conditions_vec(self, vec: Any) -> Any:
         """Pure jax function: ``uint32[width] -> bool[P]`` — the truth of
         each host property's condition at this state, in
-        ``host_model.properties()`` order."""
+        ``host_model.properties()`` order.
+
+        Contract note: the device engines track EventuallyBits in a
+        uint32 lane, so EVENTUALLY properties must sit at indices < 32
+        of ``properties()`` (order ALWAYS/SOMETIMES after them if
+        needed). Every engine validates this at spawn and raises."""
         ...
 
     def within_boundary_vec(self, vec: Any) -> Any:

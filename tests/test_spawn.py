@@ -24,7 +24,7 @@ from stateright_tpu.models.ping_pong import Ping, PingPongActor, Pong
 def _free_ports(n):
     socks = []
     for _ in range(n):
-        s = socket.socket(socket.SOCK_DGRAM and socket.AF_INET, socket.SOCK_DGRAM)
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         s.bind(("127.0.0.1", 0))
         socks.append(s)
     ports = [s.getsockname()[1] for s in socks]

@@ -202,3 +202,44 @@ def test_tpu_rejects_model_without_encoding():
 
     with pytest.raises(ValueError):
         BinaryClock().checker().spawn_tpu()
+
+
+def test_eventually_index_constraint_is_loud():
+    """EncodedModel contract (encoding.py): eventually properties must
+    sit at property indices < 32 — every device engine refuses early
+    and loudly rather than silently wrapping the ebits lane."""
+    import pytest
+
+    from stateright_tpu.model import Expectation, Model, Property
+    from stateright_tpu.models.increment_tpu import IncrementEncoded
+
+    class ManyProps(Model):
+        def __init__(self):
+            self._inner = IncrementEncoded(2).host_model
+
+        def init_states(self):
+            return self._inner.init_states()
+
+        def actions(self, state):
+            return self._inner.actions(state)
+
+        def next_state(self, state, action):
+            return self._inner.next_state(state, action)
+
+        def properties(self):
+            pad = [
+                Property(Expectation.ALWAYS, f"p{i}", lambda m, s: True)
+                for i in range(32)
+            ]
+            return pad + [
+                Property(
+                    Expectation.EVENTUALLY, "late", lambda m, s: True
+                )
+            ]
+
+    model = ManyProps()
+    with pytest.raises(ValueError, match="indices < 32"):
+        model.checker().spawn_tpu_sortmerge(
+            encoded=IncrementEncoded(2), capacity=64,
+            frontier_capacity=32, cand_capacity=64,
+        ).join()

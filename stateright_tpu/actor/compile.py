@@ -56,8 +56,13 @@ at compile time, over component domains — never on device.
   bounded only by *system*-level reachability) exceed ``max_domain``
   and fail loudly — those keep hand-written encodings
   (models/paxos_tpu.py).
-* Non-duplicating envelope counts ride in 8-bit fields (host ``encode``
-  raises past 255; a count that high means the closure bound is wrong).
+* Non-duplicating envelope counts ride in 8-bit fields with an
+  effective bound of 127 (host ``encode`` raises at 128). On device, a
+  successor whose count reaches 128 is pruned AND — unless the model
+  boundary would prune it anyway — reported through ``step_vec``'s
+  truncation flag, which every engine raises on: a model with
+  unbounded multiset counts fails loudly instead of reporting a
+  truncated space as verified.
 """
 
 from __future__ import annotations
@@ -402,7 +407,17 @@ class CompiledActorEncoding(EncodedModelBase):
             out = Out()
             try:
                 model.actors[i].on_msg(Id(i), cow, env.src, env.msg, out)
-            except Exception:
+            except Exception as exc:
+                if self.closure_mode == "reachable":
+                    # Every harvested pair comes from a reachable system
+                    # state: a raising handler is a genuine model bug
+                    # (the reference propagates handler panics), not an
+                    # overapproximation artifact — fail the compile.
+                    raise RuntimeError(
+                        f"actor {i} on_msg raised on a reachable "
+                        f"(state, envelope) pair: state={s!r}, "
+                        f"envelope={env!r}"
+                    ) from exc
                 # The closure overapproximates: this (state, envelope)
                 # pair can be system-unreachable, in which case the
                 # handler may legitimately reject it. Record a no-op
@@ -429,7 +444,12 @@ class CompiledActorEncoding(EncodedModelBase):
             out = Out()
             try:
                 model.actors[i].on_timeout(Id(i), cow, t, out)
-            except Exception:
+            except Exception as exc:
+                if self.closure_mode == "reachable":
+                    raise RuntimeError(
+                        f"actor {i} on_timeout raised on a reachable "
+                        f"(state, timer) pair: state={s!r}, timer={t!r}"
+                    ) from exc
                 self._tmo_tr[key] = (s, True, (), {})
                 return
             noop = is_no_op_with_timer(cow, out, t)
@@ -851,6 +871,20 @@ class CompiledActorEncoding(EncodedModelBase):
         import jax.numpy as jnp
 
         succs, valids = [], []
+        # Any otherwise-valid, in-boundary successor pruned by the
+        # implicit count bound (top bit of an 8-bit envelope field)
+        # raises this flag; the engines carry it to the host and raise,
+        # so a truncated space is never reported as a clean
+        # verification. Successors the model boundary would prune
+        # anyway are NOT truncation: the count field still holds the
+        # true value (128 = the top bit itself, no carry corruption),
+        # so the boundary predicate evaluates faithfully.
+        trunc = jnp.bool_(False)
+
+        def in_bound(s):
+            if self.boundary_spec is None:
+                return jnp.bool_(True)
+            return jnp.asarray(self.within_boundary_vec(s), dtype=bool)
         n_crashed = jnp.uint32(0)
         for i in range(self.n):
             n_crashed = n_crashed + self._get_field(
@@ -879,8 +913,9 @@ class CompiledActorEncoding(EncodedModelBase):
                 poisoned = jnp.any(
                     (s & jnp.asarray(self._net_top_mask)) != 0
                 )
-                t_noop = t_noop | poisoned
-            return s, t_noop
+            else:
+                poisoned = jnp.bool_(False)
+            return s, t_noop, poisoned
 
         # Deliver slots (model.rs:299-351).
         for (i, k, nxt, noop, ndl, tan, tor, hcl) in self.tbl_deliver:
@@ -895,11 +930,13 @@ class CompiledActorEncoding(EncodedModelBase):
                     s, f, self._get_field(s, f, jnp) - 1, jnp
                 )
 
-            s, t_noop = apply_transition(
+            s, t_noop, poisoned = apply_transition(
                 i, nxt, noop, ndl, tan, tor, hcl, extra_net=dec_net
             )
+            enabled = present & ~crashed & ~t_noop
+            trunc = trunc | (enabled & poisoned & in_bound(s))
             succs.append(s)
-            valids.append(present & ~crashed & ~t_noop)
+            valids.append(enabled & ~poisoned)
 
         # Drop slots — lossy networks only (model.rs:246-249).
         for k in self.drop_slots:
@@ -920,9 +957,13 @@ class CompiledActorEncoding(EncodedModelBase):
         ):
             f = self.f_timer[i][j]
             armed = self._get_field(vec, f, jnp) != 0
-            s, t_noop = apply_transition(i, nxt, noop, ndl, tan, tor, hcl)
+            s, t_noop, poisoned = apply_transition(
+                i, nxt, noop, ndl, tan, tor, hcl
+            )
+            enabled = armed & ~t_noop
+            trunc = trunc | (enabled & poisoned & in_bound(s))
             succs.append(s)
-            valids.append(armed & ~t_noop)
+            valids.append(enabled & ~poisoned)
 
         # Crash slots (model.rs:372-380).
         for i in self.crash_slots:
@@ -939,7 +980,7 @@ class CompiledActorEncoding(EncodedModelBase):
         if not succs:  # degenerate: no possible actions
             succs.append(vec)
             valids.append(jnp.bool_(False))
-        return jnp.stack(succs), jnp.stack(valids)
+        return jnp.stack(succs), jnp.stack(valids), trunc
 
     def property_conditions_vec(self, vec):
         import jax.numpy as jnp

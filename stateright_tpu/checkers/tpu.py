@@ -95,6 +95,19 @@ def _combine64(lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
     return (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
 
 
+def step_with_trunc(enc, rows, jnp):
+    """vmap ``enc.step_vec`` over a row block, normalizing the optional
+    truncation flag (see EncodedModel.step_vec) to a per-row bool:
+    ``(succs[N,K,W], valid[N,K], trunc[N])``."""
+    import jax
+
+    res = jax.vmap(enc.step_vec)(rows)
+    if len(res) == 3:
+        return res
+    succs, valid = res
+    return succs, valid, jnp.zeros(rows.shape[0], dtype=bool)
+
+
 def expand_frontier(enc, props, evt_idx, frontier, fval, ebits, expand,
                     with_repeats=True):
     """The shared first half of a wave (single-chip and sharded): from a
@@ -112,6 +125,9 @@ def expand_frontier(enc, props, evt_idx, frontier, fval, ebits, expand,
       ``f_lo/f_hi``  uint32[F]    frontier fingerprints
       ``flat``       uint32[F*K, W] candidate successors
       ``v``          bool[F*K]    candidate validity
+      ``trunc``      bool[F]      rows whose encoding pruned an
+                                  otherwise-valid successor at an
+                                  internal bound (see EncodedModel.step_vec)
     and, only when ``with_repeats=True``:
       ``p_lo/p_hi``  uint32[F*K]  parent (frontier) fingerprints per candidate
       ``child_ebits`` uint32[F*K] ebits each candidate inherits
@@ -138,7 +154,8 @@ def expand_frontier(enc, props, evt_idx, frontier, fval, ebits, expand,
     for i in evt_idx:
         ebits = jnp.where(cond[:, i], ebits & ~jnp.uint32(1 << i), ebits)
 
-    succs, valid = jax.vmap(enc.step_vec)(frontier)
+    succs, valid, trunc = step_with_trunc(enc, frontier, jnp)
+    trunc = trunc & fval & expand
     valid = valid & fval[:, None] & expand
     bound = jax.vmap(lambda row: jax.vmap(enc.within_boundary_vec)(row))(succs)
     valid = valid & bound
@@ -157,6 +174,7 @@ def expand_frontier(enc, props, evt_idx, frontier, fval, ebits, expand,
         f_hi=f_hi,
         flat=succs.reshape(F * K, W),
         v=valid.reshape(F * K),
+        trunc=trunc,
     )
     if with_repeats:
         out["p_lo"] = jnp.repeat(f_lo, K)
@@ -384,6 +402,7 @@ class TpuBfsChecker(Checker):
                 overflow=jnp.any(pending),
                 f_overflow=jnp.bool_(False),
                 c_overflow=jnp.bool_(False),
+                e_overflow=jnp.bool_(False),
                 done=jnp.bool_(n0 == 0) | jnp.any(pending),
             )
 
@@ -402,6 +421,7 @@ class TpuBfsChecker(Checker):
             ex = expand_frontier(
                 enc, props, evt_idx, c["frontier"], fval, ebits, expand
             )
+            e_overflow = c["e_overflow"] | jnp.any(ex["trunc"])
 
             disc_found, disc_lo, disc_hi = discovery_update(
                 props, ex, fval, c["disc_found"], c["disc_lo"], c["disc_hi"]
@@ -492,6 +512,7 @@ class TpuBfsChecker(Checker):
                 & ~overflow
                 & ~f_overflow
                 & ~c_overflow
+                & ~e_overflow
             )
             return dict(
                 t_lo=table.lo,
@@ -513,6 +534,7 @@ class TpuBfsChecker(Checker):
                 overflow=overflow,
                 f_overflow=f_overflow,
                 c_overflow=c_overflow,
+                e_overflow=e_overflow,
                 done=~cont,
             )
 
@@ -536,6 +558,7 @@ class TpuBfsChecker(Checker):
                     c["gen_hi"],
                     c["new"],
                     c["c_overflow"].astype(jnp.uint32),
+                    c["e_overflow"].astype(jnp.uint32),
                 ]
             )
             stats = jnp.concatenate(
@@ -615,6 +638,14 @@ class TpuBfsChecker(Checker):
                 )
             if bool(s[9]):
                 raise RuntimeError(self._cand_overflow_message())
+            if bool(s[10]):
+                raise RuntimeError(
+                    "encoding-bound overflow: a successor was pruned by an "
+                    "internal encoding bound (e.g. a compiled envelope "
+                    "count reached 128) — the state space would be "
+                    "silently truncated. Bound the model (boundary/"
+                    "closure bounds) or use an encoding with wider fields."
+                )
             if not done:
                 self._maybe_warn_occupancy(self.metrics["occupancy"])
             if done:
@@ -633,10 +664,10 @@ class TpuBfsChecker(Checker):
         # Keep device handles; download lazily only if a path is
         # reconstructed (_build_generated).
         self._capture_final(carry)
-        disc_found = s[10 : 10 + n_props]
-        disc_lo = s[10 + n_props : 10 + 2 * n_props]
-        disc_hi = s[10 + 2 * n_props : 10 + 3 * n_props]
-        self._consume_extra_stats(s[10 + 3 * n_props :])
+        disc_found = s[11 : 11 + n_props]
+        disc_lo = s[11 + n_props : 11 + 2 * n_props]
+        disc_hi = s[11 + 2 * n_props : 11 + 3 * n_props]
+        self._consume_extra_stats(s[11 + 3 * n_props :])
         for i, prop in enumerate(props):
             if disc_found[i]:
                 fp = _fp_int(disc_lo[i], disc_hi[i])

@@ -42,7 +42,7 @@ from ..model import Expectation
 from ..ops.fingerprint import fingerprint_u32v
 from ..path import Path
 from ..report import ReportData, Reporter
-from .tpu import TpuBfsChecker, _fp_int
+from .tpu import TpuBfsChecker, _fp_int, step_with_trunc
 
 
 class TpuSimulationChecker(TpuBfsChecker):
@@ -148,6 +148,7 @@ class TpuSimulationChecker(TpuBfsChecker):
                 disc_found=jnp.zeros(n_props, dtype=bool),
                 disc_lo=jnp.zeros(n_props, dtype=jnp.uint32),
                 disc_hi=jnp.zeros(n_props, dtype=jnp.uint32),
+                e_ovf=jnp.bool_(False),
                 init=init_rows,
             )
 
@@ -164,7 +165,8 @@ class TpuSimulationChecker(TpuBfsChecker):
                     cond[:, i], ebits & ~jnp.uint32(1 << i), ebits
                 )
 
-            succs, valid = jax.vmap(enc.step_vec)(walks)
+            succs, valid, trunc = step_with_trunc(enc, walks, jnp)
+            trunc_any = jnp.any(trunc)
             bound = jax.vmap(
                 lambda row: jax.vmap(enc.within_boundary_vec)(row)
             )(succs)
@@ -194,13 +196,13 @@ class TpuSimulationChecker(TpuBfsChecker):
                     jnp.where(fresh, f_hi[row], disc_hi[i])
                 )
             return (succs, valid, n_valid, terminal, ebits,
-                    disc_found, disc_lo, disc_hi)
+                    disc_found, disc_lo, disc_hi, trunc_any)
 
         def step_once(step, c, salt):
             walks = c["walks"]
             (
                 succs, valid, n_valid, terminal, ebits,
-                disc_found, disc_lo, disc_hi,
+                disc_found, disc_lo, disc_hi, trunc_any,
             ) = eval_block(walks, c["ebits"], c)
 
             # Uniform choice among the valid successors of each walk.
@@ -239,6 +241,7 @@ class TpuSimulationChecker(TpuBfsChecker):
                 disc_found=disc_found,
                 disc_lo=disc_lo,
                 disc_hi=disc_hi,
+                e_ovf=c["e_ovf"] | trunc_any,
                 init=c["init"],
             )
 
@@ -256,7 +259,8 @@ class TpuSimulationChecker(TpuBfsChecker):
                 # The round's FINAL states were generated and counted
                 # inside the loop but not yet property-checked —
                 # evaluate them before restarting the walks.
-                (_, _, _, _, _, disc_found, disc_lo, disc_hi) = (
+                (_, _, _, _, _, disc_found, disc_lo, disc_hi,
+                 trunc_any) = (
                     eval_block(c["walks"], c["ebits"], c)
                 )
                 idx = (
@@ -271,6 +275,7 @@ class TpuSimulationChecker(TpuBfsChecker):
                     disc_found=disc_found,
                     disc_lo=disc_lo,
                     disc_hi=disc_hi,
+                    e_ovf=c["e_ovf"] | trunc_any,
                 )
             stats = jnp.concatenate(
                 [
@@ -278,6 +283,7 @@ class TpuSimulationChecker(TpuBfsChecker):
                         [
                             c["states"],
                             c["depth"],
+                            c["e_ovf"].astype(jnp.uint32),
                         ]
                     ),
                     c["disc_found"].astype(jnp.uint32),
@@ -310,9 +316,16 @@ class TpuSimulationChecker(TpuBfsChecker):
         self._total_states = int(stats[0])
         self._unique_states = int(stats[0])  # approximate, as reference
         self._max_depth = int(stats[1])
-        disc_found = stats[2 : 2 + n_props]
-        disc_lo = stats[2 + n_props : 2 + 2 * n_props]
-        disc_hi = stats[2 + 2 * n_props : 2 + 3 * n_props]
+        if bool(stats[2]):
+            raise RuntimeError(
+                "encoding-bound overflow: a walk hit a successor pruned "
+                "by an internal encoding bound (e.g. a compiled envelope "
+                "count reached 128); walk coverage would be silently "
+                "truncated"
+            )
+        disc_found = stats[3 : 3 + n_props]
+        disc_lo = stats[3 + n_props : 3 + 2 * n_props]
+        disc_hi = stats[3 + 2 * n_props : 3 + 3 * n_props]
         for i, prop in enumerate(props):
             if disc_found[i]:
                 self._discovered_fps[prop.name] = _fp_int(

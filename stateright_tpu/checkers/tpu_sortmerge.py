@@ -250,6 +250,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 overflow=jnp.bool_(n0 > C),
                 f_overflow=jnp.bool_(False),
                 c_overflow=jnp.bool_(False),
+                e_overflow=jnp.bool_(False),
                 max_cand=jnp.uint32(0),
                 max_tile_cand=jnp.uint32(0),
                 done=jnp.bool_(n0 == 0),
@@ -284,7 +285,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
 
         def make_merge(c, vc, B_eff, ck_lo, ck_hi, fetch, n_cand,
                        disc_found, disc_lo, disc_hi, c_overflow,
-                       max_tile_cand):
+                       e_overflow, max_tile_cand):
             """The merge stage for visited-prefix class vc: one stable
             3-lane merge sort (visited-first ⇒ first-of-run wins and
             intra-wave duplicates resolve for free), a 2-lane rebuild
@@ -377,7 +378,13 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     pl_par_hi = lax.dynamic_update_slice(
                         c["pl_par_hi"], np_hi, off
                     )
-                    pl_n = c["pl_n"] + new_count.astype(jnp.uint32)
+                    # Clamp to the F rows the block write actually
+                    # wrote: on an f_overflow wave new_count can exceed
+                    # F, and _run raises before reconstruction — but
+                    # the live-count invariant should hold regardless.
+                    pl_n = c["pl_n"] + jnp.minimum(
+                        new_count.astype(jnp.uint32), jnp.uint32(F)
+                    )
                 else:
                     pl_child_lo = c["pl_child_lo"]
                     pl_child_hi = c["pl_child_hi"]
@@ -404,6 +411,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     & ~overflow
                     & ~f_overflow
                     & ~c_overflow
+                    & ~e_overflow
                 )
                 return dict(
                     v_lo=v_lo_new,
@@ -431,6 +439,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     overflow=overflow,
                     f_overflow=f_overflow,
                     c_overflow=c_overflow,
+                    e_overflow=e_overflow,
                     max_cand=jnp.maximum(c["max_cand"], n_cand),
                     max_tile_cand=max_tile_cand,
                     done=~cont,
@@ -461,6 +470,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                         enc, props, evt_idx, frontier_f, fval_f, ebits_f,
                         expand, with_repeats=False,
                     )
+                    e_overflow = c["e_overflow"] | jnp.any(ex["trunc"])
                     disc_found, disc_lo, disc_hi = discovery_update(
                         props, ex, fval_f,
                         c["disc_found"], c["disc_lo"], c["disc_hi"],
@@ -544,7 +554,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                             make_merge(
                                 c, vc, cand_B, ck_lo, ck_hi, fetch,
                                 n_cand, disc_found, disc_lo, disc_hi,
-                                c_overflow,
+                                c_overflow, e_overflow,
                                 jnp.maximum(c["max_tile_cand"], tile_max),
                             )
                             for vc in range(len(v_ladder))
@@ -558,7 +568,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 def tile_body(t, acc):
                     (
                         ck_lo, ck_hi, cst, cplo, cphi, ceb,
-                        dfound, dlo, dhi, n_cand, c_ovf, tmax,
+                        dfound, dlo, dhi, n_cand, c_ovf, e_ovf, tmax,
                     ) = acc
                     off = t * T
                     tf = lax.dynamic_slice(c["frontier"], (off, 0), (T, W))
@@ -568,6 +578,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                         enc, props, evt_idx, tf, tfv, teb, expand,
                         with_repeats=False,
                     )
+                    e_ovf = e_ovf | jnp.any(ex["trunc"])
                     dfound, dlo, dhi = discovery_update(
                         props, ex, tfv, dfound, dlo, dhi
                     )
@@ -603,13 +614,14 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     return (
                         ck_lo, ck_hi, cst, cplo, cphi, ceb,
                         dfound, dlo, dhi,
-                        n_cand + t_cand.astype(jnp.uint32), c_ovf, tmax,
+                        n_cand + t_cand.astype(jnp.uint32), c_ovf, e_ovf,
+                        tmax,
                     )
 
                 (
                     ck_lo, ck_hi, b_state, b_par_lo, b_par_hi, b_ebits,
                     disc_found, disc_lo, disc_hi, n_cand, c_overflow,
-                    tile_max,
+                    e_overflow, tile_max,
                 ) = lax.fori_loop(
                     0,
                     NT,
@@ -626,6 +638,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                         c["disc_hi"],
                         jnp.uint32(0),
                         c["c_overflow"],
+                        c["e_overflow"],
                         jnp.uint32(0),
                     ),
                 )
@@ -644,7 +657,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                         make_merge(
                             c, vc, B_eff, ck_lo, ck_hi, fetch,
                             n_cand, disc_found, disc_lo, disc_hi,
-                            c_overflow,
+                            c_overflow, e_overflow,
                             jnp.maximum(c["max_tile_cand"], tile_max),
                         )
                         for vc in range(len(v_ladder))
@@ -687,6 +700,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     c["gen_hi"],
                     c["new"],
                     c["c_overflow"].astype(jnp.uint32),
+                    c["e_overflow"].astype(jnp.uint32),
                 ]
             )
             stats = jnp.concatenate(

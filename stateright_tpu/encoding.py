@@ -42,10 +42,17 @@ class EncodedModel(Protocol):
         """uint32[N0, width] — encoded init states (host-side numpy)."""
         ...
 
-    def step_vec(self, vec: Any) -> tuple[Any, Any]:
+    def step_vec(self, vec: Any) -> tuple[Any, ...]:
         """Pure jax function on ONE encoded state:
         ``uint32[width] -> (uint32[max_actions, width], bool[max_actions])``.
-        The engine vmaps this over the frontier."""
+        The engine vmaps this over the frontier.
+
+        An encoding with internal capacity bounds (e.g. the compiled
+        actor encoding's 8-bit envelope counts) MAY return a third
+        element: a scalar ``bool`` that is True when an otherwise-valid
+        successor was pruned by such a bound. Engines carry the flag to
+        the host and raise — a truncated space is never silently
+        reported as fully verified."""
         ...
 
     def property_conditions_vec(self, vec: Any) -> Any:

@@ -219,6 +219,7 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                 overflow=overflow,
                 f_overflow=jnp.bool_(False),
                 c_overflow=jnp.bool_(False),
+                e_overflow=jnp.bool_(False),
                 done=jnp.bool_(n0 == 0) | overflow,
             )
 
@@ -236,6 +237,7 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             ex = expand_frontier(
                 enc, props, evt_idx, c["frontier"], fval, ebits, expand
             )
+            e_overflow = c["e_overflow"] | bool_any(jnp.any(ex["trunc"]))
 
             # Discoveries: local per-wave hits, globally folded. The
             # winning fingerprint comes from the lowest shard index
@@ -366,6 +368,7 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                 & ~overflow
                 & ~f_overflow
                 & ~c_overflow
+                & ~e_overflow
             )
             return dict(
                 t_lo=table.lo,
@@ -389,6 +392,7 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                 overflow=overflow,
                 f_overflow=f_overflow,
                 c_overflow=c_overflow,
+                e_overflow=e_overflow,
                 done=~cont,
             )
 
@@ -415,6 +419,7 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                     c["gen_hi"],
                     c["new"],
                     c["c_overflow"].astype(jnp.uint32),
+                    c["e_overflow"].astype(jnp.uint32),
                 ]
             )
             stats = jnp.concatenate(
@@ -451,6 +456,7 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             overflow=P(),
             f_overflow=P(),
             c_overflow=P(),
+            e_overflow=P(),
             done=P(),
         )
         seed_sm = shard_map(

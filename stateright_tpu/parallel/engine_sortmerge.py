@@ -247,6 +247,7 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                 overflow=jnp.bool_(n0 > C),
                 f_overflow=jnp.bool_(False),
                 c_overflow=jnp.bool_(False),
+                e_overflow=jnp.bool_(False),
                 done=jnp.bool_(n0 == 0),
             )
 
@@ -264,6 +265,7 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                 enc, props, evt_idx, c["frontier"], fval, ebits, expand,
                 with_repeats=False,
             )
+            e_overflow = c["e_overflow"] | bool_any(jnp.any(ex["trunc"]))
 
             # Discoveries: local per-wave hits, globally folded (the
             # lowest hitting shard index wins, mirroring whichever
@@ -430,7 +432,13 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                 pl_par_hi = lax.dynamic_update_slice(
                     c["pl_par_hi"], np_hi, off
                 )
-                pl_n = c["pl_n"] + new_count.astype(jnp.uint32)
+                # Clamp to the F rows the block write actually wrote
+                # (on an f_overflow wave new_count can exceed F; _run
+                # raises before reconstruction, but the live-count
+                # invariant should hold regardless).
+                pl_n = c["pl_n"] + jnp.minimum(
+                    new_count.astype(jnp.uint32), jnp.uint32(F)
+                )
             else:
                 pl_child_lo = c["pl_child_lo"]
                 pl_child_hi = c["pl_child_hi"]
@@ -462,6 +470,7 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                 & ~overflow
                 & ~f_overflow
                 & ~c_overflow
+                & ~e_overflow
             )
             return dict(
                 v_lo=v_lo_new,
@@ -489,6 +498,7 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                 overflow=overflow,
                 f_overflow=f_overflow,
                 c_overflow=c_overflow,
+                e_overflow=e_overflow,
                 done=~cont,
             )
 
@@ -513,6 +523,7 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                     c["gen_hi"],
                     c["new"],
                     c["c_overflow"].astype(jnp.uint32),
+                    c["e_overflow"].astype(jnp.uint32),
                 ]
             )
             stats = jnp.concatenate(
@@ -555,6 +566,7 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             overflow=P(),
             f_overflow=P(),
             c_overflow=P(),
+            e_overflow=P(),
             done=P(),
         )
         seed_sm = shard_map(

@@ -228,3 +228,58 @@ def test_closure_divergence_detected():
     specs.pop("closure_actor_bound")  # counters now unbounded
     with pytest.raises(RuntimeError, match="closure"):
         compile_actor_model(model, max_domain=64, **specs)
+
+
+def test_count_bound_overflow_raises():
+    """A model with finite component domains but unbounded envelope
+    multiplicity must fail loudly when the device prunes a successor at
+    the implicit 128-count bound (ADVICE r3, medium) — not report a
+    clean, silently truncated 'verified' space."""
+    from stateright_tpu.actor import Actor, ActorModel, Network
+
+    class Flooder(Actor):
+        def on_start(self, id, out):
+            out.send(id, "go")
+            return 0
+
+        def on_msg(self, id, state, src, msg, out):
+            # Consume one "go", emit two: multiplicity diverges while
+            # the local state and envelope universe stay singletons.
+            out.send(id, "go")
+            out.send(id, "go")
+
+    model = (
+        ActorModel(cfg=None)
+        .actor(Flooder())
+        .init_network(Network.new_unordered_nonduplicating())
+    )
+    enc = compile_actor_model(model, properties={})
+    checker = spawn_compiled(
+        model, enc,
+        capacity=1 << 9, frontier_capacity=1 << 5,
+        cand_capacity=1 << 7, waves_per_sync=32,
+    )
+    with pytest.raises(RuntimeError, match="encoding-bound overflow"):
+        checker.join()
+
+
+def test_reachable_mode_propagates_handler_errors():
+    """closure='reachable' harvests only reachable (state, envelope)
+    pairs, so a raising handler is a genuine model bug and must fail
+    the compile (ADVICE r3) — overapprox mode still records a no-op."""
+    from stateright_tpu.actor import Actor, ActorModel
+
+    class Boom(Actor):
+        def on_start(self, id, out):
+            out.send(id, "go")
+            return 0
+
+        def on_msg(self, id, state, src, msg, out):
+            raise KeyError("handler bug")
+
+    model = ActorModel(cfg=None).actor(Boom())
+    with pytest.raises(RuntimeError, match="on_msg raised on a reachable"):
+        compile_actor_model(model, properties={}, closure="reachable")
+    # Overapprox mode keeps the lenient no-op treatment.
+    enc = compile_actor_model(model, properties={})
+    assert enc.width >= 1

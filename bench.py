@@ -150,7 +150,13 @@ def tpu_workloads(quick=False):
                     3,
                     capacity=5 << 18,
                     frontier_capacity=1 << 18,
-                    cand_capacity=1 << 19,
+                    # Sparse action dispatch (round 4): the candidate
+                    # budget tracks ENABLED (row, slot) pairs — peak
+                    # 343,235 — not F*K slot cells; r3's dense path ran
+                    # this lane at 151k st/s, sparse runs ~1M.
+                    cand_capacity=3 << 17,
+                    pair_width=16,
+                    tile_rows=1 << 18,
                 ),
                 1194428,
             )

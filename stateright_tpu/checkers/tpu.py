@@ -108,6 +108,33 @@ def step_with_trunc(enc, rows, jnp):
     return succs, valid, jnp.zeros(rows.shape[0], dtype=bool)
 
 
+def frontier_props(enc, props, evt_idx, frontier, fval, ebits):
+    """The step-free half of a wave: frontier fingerprints, the
+    property bitmap, and eventually-bit clearing (shared between the
+    dense expansion below and the sparse-dispatch path, which computes
+    successors per enabled (row, slot) pair instead of per slot).
+
+    Returns ``(cond[F, P], ebits[F], f_lo[F], f_hi[F])``."""
+    import jax
+    import jax.numpy as jnp
+
+    F = frontier.shape[0]
+    n_props = len(props)
+
+    f_lo, f_hi = fingerprint_u32v(frontier, jnp)
+
+    # Property bitmap over the frontier (bfs.rs:223-268).
+    if n_props:
+        cond = jax.vmap(enc.property_conditions_vec)(frontier)
+        cond = cond & fval[:, None]
+    else:
+        cond = jnp.zeros((F, 0), dtype=bool)
+    # Clear satisfied eventually-bits (checker.rs:559-566).
+    for i in evt_idx:
+        ebits = jnp.where(cond[:, i], ebits & ~jnp.uint32(1 << i), ebits)
+    return cond, ebits, f_lo, f_hi
+
+
 def expand_frontier(enc, props, evt_idx, frontier, fval, ebits, expand,
                     with_repeats=True):
     """The shared first half of a wave (single-chip and sharded): from a
@@ -140,19 +167,10 @@ def expand_frontier(enc, props, evt_idx, frontier, fval, ebits, expand,
 
     F = frontier.shape[0]
     K, W = enc.max_actions, enc.width
-    n_props = len(props)
 
-    f_lo, f_hi = fingerprint_u32v(frontier, jnp)
-
-    # Property bitmap over the frontier (bfs.rs:223-268).
-    if n_props:
-        cond = jax.vmap(enc.property_conditions_vec)(frontier)
-        cond = cond & fval[:, None]
-    else:
-        cond = jnp.zeros((F, 0), dtype=bool)
-    # Clear satisfied eventually-bits (checker.rs:559-566).
-    for i in evt_idx:
-        ebits = jnp.where(cond[:, i], ebits & ~jnp.uint32(1 << i), ebits)
+    cond, ebits, f_lo, f_hi = frontier_props(
+        enc, props, evt_idx, frontier, fval, ebits
+    )
 
     succs, valid, trunc = step_with_trunc(enc, frontier, jnp)
     trunc = trunc & fval & expand

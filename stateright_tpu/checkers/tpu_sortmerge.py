@@ -61,6 +61,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..encoding import EncodedModelBase, SparseEncodedModel
 from ..model import Expectation
 from ..ops.fingerprint import fingerprint_u32v
 from ..ops.u64 import U64, u64_add
@@ -68,6 +69,7 @@ from .tpu import (
     TpuBfsChecker,
     discovery_update,
     expand_frontier,
+    frontier_props,
 )
 
 _SENT = 0xFFFFFFFF
@@ -125,6 +127,8 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         ladder_step: int = 2,
         v_ladder_step: int = 4,
         flat_budget_bytes: int = 1 << 30,
+        sparse: bool | None = None,
+        pair_width: int = 32,
         **kwargs,
     ):
         super().__init__(builder, **kwargs)
@@ -135,11 +139,22 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         self.ladder_step = ladder_step
         self.v_ladder_step = v_ladder_step
         self.flat_budget_bytes = flat_budget_bytes
+        #: sparse action dispatch (None = auto: on iff the encoding
+        #: implements SparseEncodedModel). pair_width bounds the
+        #: enabled slots extracted per frontier row per wave (overflow
+        #: detected, never silent).
+        self.sparse = sparse
+        self.pair_width = pair_width
         if tiles > 1 and self.frontier_capacity % tiles:
             raise ValueError(
                 f"frontier_capacity {self.frontier_capacity} not divisible "
                 f"by tiles {tiles}"
             )
+
+    def _use_sparse(self) -> bool:
+        if self.sparse is not None:
+            return self.sparse
+        return isinstance(self.encoded, SparseEncodedModel)
 
     def _cache_extras(self) -> tuple:
         return (
@@ -151,6 +166,8 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             self.ladder_step,
             self.v_ladder_step,
             self.flat_budget_bytes,
+            self._use_sparse(),
+            self.pair_width,
         )
 
     def _maybe_warn_occupancy(self, occupancy: float) -> None:
@@ -158,6 +175,14 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         and overflow is detected exactly — nothing to warn about."""
 
     def _cand_overflow_message(self) -> str:
+        if self._use_sparse():
+            return (
+                "pair-buffer overflow: a wave enabled more (row, slot) "
+                f"pairs than cand_capacity={self.cand_capacity}, or one "
+                f"row enabled more than pair_width={self.pair_width} "
+                "slots; raise the exceeded knob — the "
+                "max_wave_candidates metric reports the observed peak"
+            )
         return (
             "candidate-buffer overflow: a wave generated more valid "
             f"successors than cand_capacity={self.cand_capacity} (or, on "
@@ -667,6 +692,219 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
 
             return wave
 
+        # -- sparse action dispatch (PERF.md §paxos) ---------------------
+        #
+        # The dense wave pays O(F·K) successor construction,
+        # fingerprints and compaction sorts even when only a sliver of
+        # the K slots is enabled (paxos check 3: ~200x padding). The
+        # sparse wave instead:
+        #   1. evaluates the encoding's CHEAP per-slot enabled
+        #      predicate over [F, K] (field extracts, no successors),
+        #   2. packs it to per-row bitmaps and peels up to pair_width
+        #      enabled slots per row with a lowest-set-bit loop —
+        #      elementwise passes over [F, K/32] lanes, no sort,
+        #   3. compacts the (row, slot) pairs with tiled 1-lane
+        #      packed-append sorts over the F×pair_width grid (a
+        #      K/pair_width-times smaller sort than the dense path's),
+        #   4. runs the table-driven per-pair transition, fingerprints,
+        #      and the shared merge on ≤B real candidates only.
+        # Every O(F·K) stage that remains is a pure elementwise pass.
+        wb = getattr(type(enc), "within_boundary_vec", None)
+        sparse_boundary = (
+            wb is not EncodedModelBase.within_boundary_vec
+            and not getattr(enc, "trivial_boundary", False)
+        )
+
+        def make_sparse_wave(fc: int, v_class):
+            F_f = f_ladder[fc]
+            EV = min(self.pair_width, K)
+            NPg = F_f * EV
+            B_p = min(B_user, NPg)
+            compaction = NPg > B_p
+            want_tiles = -(-NPg // self.tile_rows)
+            if F_f == F:
+                want_tiles = max(want_tiles, self.tiles)
+            NT = _divisor_at_least(F_f, want_tiles) if compaction else 1
+            T = F_f // NT
+            Ba = (B_p + T * EV) if compaction else NPg
+            L = (K + 31) // 32
+
+            def wave(c):
+                if target_depth is None:
+                    expand = jnp.bool_(True)
+                else:
+                    expand = c["depth"] < target_depth
+                frontier_f = c["frontier"][:F_f]
+                fval_f = c["fval"][:F_f]
+                ebits_f = c["ebits"][:F_f]
+                cond, eb, f_lo, f_hi = frontier_props(
+                    enc, props, evt_idx, frontier_f, fval_f, ebits_f
+                )
+
+                mask = jax.vmap(enc.enabled_mask_vec)(frontier_f)
+                mask = mask & fval_f[:, None] & expand
+                cnt = jnp.sum(mask, axis=1, dtype=jnp.uint32)
+                n_pairs = jnp.sum(cnt, dtype=jnp.uint32)
+                c_overflow = (
+                    c["c_overflow"]
+                    | jnp.any(cnt > jnp.uint32(EV))
+                    | (n_pairs > jnp.uint32(B_p))
+                )
+
+                # Per-row bitmap; peel pair_width lowest set bits.
+                maskp = jnp.pad(mask, ((0, 0), (0, L * 32 - K)))
+                bits = jnp.sum(
+                    maskp.reshape(F_f, L, 32).astype(jnp.uint32)
+                    * (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)),
+                    axis=2,
+                    dtype=jnp.uint32,
+                )
+                # Peel the lowest set bit per row, EV times — pure
+                # elementwise [F, L] passes plus a min-reduce along L
+                # (argmax/take_along_axis formulations lower to slow
+                # gathers on TPU: measured ~6ms/iteration vs <0.5ms
+                # for this form at F=2^18, L=9).
+                lane_base = (
+                    jnp.arange(L, dtype=jnp.uint32) * jnp.uint32(32)
+                )[None, :]
+                lanes = bits
+                slot_cols, val_cols = [], []
+                for _ in range(EV):
+                    low = lanes & (jnp.uint32(0) - lanes)
+                    pos = lax.population_count(low - jnp.uint32(1))
+                    cand = jnp.where(
+                        lanes != 0, lane_base + pos, jnp.uint32(_SENT)
+                    )
+                    slot = jnp.min(cand, axis=1)
+                    any_ = slot != jnp.uint32(_SENT)
+                    slot_cols.append(
+                        jnp.where(any_, slot, jnp.uint32(0))
+                    )
+                    val_cols.append(any_)
+                    lanes = jnp.where(
+                        cand == slot[:, None],
+                        lanes & (lanes - jnp.uint32(1)),
+                        lanes,
+                    )
+                slots_flat = jnp.stack(slot_cols, axis=1).reshape(NPg)
+                valid_g = jnp.stack(val_cols, axis=1)
+
+                pair_idx = (
+                    jnp.arange(F_f, dtype=jnp.uint32)[:, None]
+                    * jnp.uint32(EV)
+                    + jnp.arange(EV, dtype=jnp.uint32)[None, :]
+                )
+                keys = jnp.where(
+                    valid_g, pair_idx, jnp.uint32(_SENT)
+                ).reshape(NPg)
+
+                if compaction:
+                    # Tiled 1-lane packed-append compaction (the sparse
+                    # analog of the dense tiled key compaction; sort is
+                    # superlinear so NT small sorts beat one big one).
+                    def tile_body(ti, acc):
+                        pk, app_off, tmax = acc
+                        off = ti * (T * EV)
+                        tk = lax.dynamic_slice(keys, (off,), (T * EV,))
+                        tc = jnp.sum(
+                            tk != jnp.uint32(_SENT), dtype=jnp.uint32
+                        )
+                        tmax = jnp.maximum(tmax, tc)
+                        (sk,) = lax.sort((tk,), num_keys=1)
+                        pk = lax.dynamic_update_slice(pk, sk, (app_off,))
+                        return pk, app_off + tc, tmax
+
+                    pk, _, tile_max = lax.fori_loop(
+                        0,
+                        NT,
+                        tile_body,
+                        (
+                            jnp.full(Ba, _SENT, jnp.uint32),
+                            jnp.uint32(0),
+                            jnp.uint32(0),
+                        ),
+                    )
+                else:
+                    pk = keys
+                    tile_max = n_pairs
+
+                live = pk != jnp.uint32(_SENT)
+                pidx = jnp.where(live, pk, jnp.uint32(0))
+                prow = pidx // jnp.uint32(EV)
+                pslot = slots_flat[pidx]
+                pstate = frontier_f[prow]
+                res = jax.vmap(enc.step_slot_vec)(pstate, pslot)
+                # step_slot_vec MAY return (succ, trunc): trunc marks
+                # pairs pruned by an internal encoding bound (compiled
+                # envelope counts) — excluded from candidates and, when
+                # in-boundary, raised as e_overflow (matching the dense
+                # path's truncation contract).
+                succ, ptr = res if isinstance(res, tuple) else (res, None)
+
+                e_overflow = c["e_overflow"]
+                if sparse_boundary or ptr is not None:
+                    if sparse_boundary:
+                        inb = jax.vmap(enc.within_boundary_vec)(succ)
+                    else:
+                        inb = jnp.bool_(True)
+                    pair_ok = live & inb
+                    if ptr is not None:
+                        e_overflow = e_overflow | jnp.any(pair_ok & ptr)
+                        pair_ok = pair_ok & ~ptr
+                    # Terminal = no surviving successor at all:
+                    # scatter-max each surviving pair onto its row.
+                    row_ok = jnp.zeros(F_f, jnp.uint32).at[
+                        jnp.where(pair_ok, prow, jnp.uint32(F_f))
+                    ].max(jnp.uint32(1), mode="drop")
+                    has_succ = row_ok != 0
+                    n_cand = jnp.sum(pair_ok, dtype=jnp.uint32)
+                else:
+                    pair_ok = live
+                    has_succ = cnt > 0
+                    n_cand = n_pairs
+                terminal = fval_f & ~has_succ & expand
+                evt_cex = terminal & (eb != 0)
+                exd = dict(
+                    cond=cond, ebits=eb, evt_cex=evt_cex,
+                    f_lo=f_lo, f_hi=f_hi,
+                )
+                disc_found, disc_lo, disc_hi = discovery_update(
+                    props, exd, fval_f,
+                    c["disc_found"], c["disc_lo"], c["disc_hi"],
+                )
+
+                k_lo, k_hi = fingerprint_u32v(succ, jnp)
+                k_lo, k_hi = clamp_keys(k_lo, k_hi)
+                ck_lo = jnp.where(pair_ok, k_lo, jnp.uint32(_SENT))
+                ck_hi = jnp.where(pair_ok, k_hi, jnp.uint32(_SENT))
+
+                def fetch(nf_row):
+                    par_row = prow[nf_row]
+                    return (
+                        succ[nf_row],
+                        f_lo[par_row] if track_paths else None,
+                        f_hi[par_row] if track_paths else None,
+                        eb[par_row],
+                    )
+
+                return lax.switch(
+                    v_class,
+                    [
+                        make_merge(
+                            c, vc, Ba, ck_lo, ck_hi, fetch,
+                            n_cand, disc_found, disc_lo, disc_hi,
+                            c_overflow, e_overflow,
+                            jnp.maximum(c["max_tile_cand"], tile_max),
+                        )
+                        for vc in range(len(v_ladder))
+                    ],
+                    0,
+                )
+
+            return wave
+
+        use_sparse = self._use_sparse()
+
         def body(c):
             n_f = c["n_frontier"]
             u = c["new"]
@@ -676,9 +914,10 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             v_class = jnp.int32(0)
             for V_i in v_ladder[:-1]:
                 v_class = v_class + (u > jnp.uint32(V_i)).astype(jnp.int32)
+            mk = make_sparse_wave if use_sparse else make_wave
             return lax.switch(
                 f_class,
-                [make_wave(fc, v_class) for fc in range(len(f_ladder))],
+                [mk(fc, v_class) for fc in range(len(f_ladder))],
                 c,
             )
 

@@ -112,12 +112,15 @@ def _paxos(sub: str, args: list[str]) -> None:
         )
         # Measured spaces: 1c=265, 2c=16,668, 3c=1,194,428 (~71x per
         # client); 4c is estimated ~85M — runnable on a 16GB chip in
-        # fingerprint-only mode, sized accordingly.
+        # fingerprint-only mode, sized accordingly. The encoding
+        # provides sparse action dispatch (SparseEncodedModel), so the
+        # candidate budget tracks ENABLED pairs (3c peak: 343,235),
+        # not F*K slot cells; pair/tile knobs per PERF.md §sparse.
         caps = {
             1: (1 << 10, 1 << 8, 1 << 10),
             2: (1 << 15, 1 << 12, 1 << 14),
-            3: (5 << 18, 1 << 18, 1 << 19),
-            4: (7 << 24, 1 << 22, 1 << 24),
+            3: (5 << 18, 1 << 18, 3 << 17),
+            4: (7 << 24, 1 << 22, 3 << 20),
         }
         cap, fcap, ccap = caps.get(client_count, caps[4])
         _report(
@@ -127,6 +130,8 @@ def _paxos(sub: str, args: list[str]) -> None:
                 capacity=cap,
                 frontier_capacity=fcap,
                 cand_capacity=ccap,
+                pair_width=16,
+                tile_rows=1 << 18,
                 track_paths=client_count <= 2,
             )
         )

@@ -78,6 +78,44 @@ class EncodedModel(Protocol):
         ...
 
 
+@runtime_checkable
+class SparseEncodedModel(Protocol):
+    """Optional extension of :class:`EncodedModel`: sparse action
+    dispatch (PERF.md §paxos).
+
+    The dense ``step_vec`` contract pays for all ``max_actions`` slots
+    on every frontier row; for envelope-encoded actor models most slots
+    are invalid (paxos check 3: ~200x padding). An encoding providing
+    this interface lets the sort-merge engine pre-compact the enabled
+    (row, slot) pairs — a cheap elementwise predicate, a per-row bitmap
+    extraction, and one small sort — and run the (table-driven)
+    transition only on real candidates, mirroring the reference's
+    enabled-actions-only enumeration (src/actor/model.rs:243-286).
+
+    Contract (engine-checked by differential tests, not at runtime):
+
+    * ``enabled_mask_vec(vec)[k]`` must equal ``step_vec(vec)[1][k]``
+      for every slot ``k`` (the engine applies ``within_boundary_vec``
+      to successors itself).
+    * ``step_slot_vec(vec, k)`` must equal ``step_vec(vec)[0][k]``
+      whenever slot ``k`` is enabled.
+    """
+
+    def enabled_mask_vec(self, vec: Any) -> Any:
+        """Pure jax function: ``uint32[width] -> bool[max_actions]`` —
+        which action slots are enabled at this state. Must be CHEAP
+        (field extracts and compares; no successor construction): it
+        runs on every (row, slot) cell each wave."""
+        ...
+
+    def step_slot_vec(self, vec: Any, slot: Any) -> Any:
+        """Pure jax function: ``(uint32[width], uint32 slot) ->
+        uint32[width]`` — the successor for one enabled (state, slot)
+        pair, with ``slot`` a traced index. Runs only on compacted
+        pairs; table gathers by ``slot`` are the intended idiom."""
+        ...
+
+
 class EncodedModelBase:
     """Convenience defaults."""
 

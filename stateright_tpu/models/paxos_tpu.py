@@ -891,6 +891,404 @@ class PaxosEncoded(EncodedModelBase):
             xp.where(cond, lane | xp.uint32(1 << _B_POISON), lane)
         )
 
+    # -- sparse action dispatch (SparseEncodedModel) -----------------------
+    #
+    # The dense step_vec pays for all K slots per frontier row; with
+    # K=284 at check 3 that is ~200x padding (PERF.md §paxos). The
+    # sparse interface gives the engine (a) a cheap per-slot enabled
+    # predicate — the envelope's presence bit AND the handler's guard,
+    # which for every paxos handler is a small function of the DST
+    # actor's fields — and (b) a table-driven per-pair transition where
+    # every per-slot constant of the dense handlers (_on_*) becomes a
+    # gather by slot index. Send masks unify into one [K*SEL]-row table
+    # indexed by (slot, selector): the selector is the single
+    # state-dependent value each handler's send depends on (put: dst's
+    # ballot enum; get: read value; prepare: acceptor's accepted code;
+    # prepared: chosen proposal; accepted: leader's proposal).
+
+    _KINDS = (
+        "put", "get", "putok", "getok", "prepare", "prepared", "accept",
+        "accepted", "decided",
+    )
+
+    def _sparse_tables(self) -> dict:
+        if hasattr(self, "_sp"):
+            return self._sp
+        K, S, P, NB = self.K, self.S, self.P, self.NB
+        la_max = NB * P
+        SEL = max(NB, P, la_max) + 1
+        kindno = {k: n for n, k in enumerate(self._KINDS)}
+        kind = np.zeros(K, np.uint32)
+        dst = np.zeros(K, np.uint32)
+        src = np.zeros(K, np.uint32)
+        ballot = np.zeros(K, np.uint32)
+        prop = np.zeros(K, np.uint32)
+        la = np.zeros(K, np.uint32)
+        value = np.zeros(K, np.uint32)
+        dst_srv = np.zeros(K, np.uint32)
+        dst_cli = np.zeros(K, np.uint32)
+        prep_lane = np.zeros(K, np.uint32)
+        send = np.zeros((K * SEL, self.net_lanes), np.uint32)
+        poison = np.zeros(K * SEL, np.uint32)
+        aux = np.zeros(K * SEL, np.uint32)
+
+        def orkey(row: int, key: tuple) -> None:
+            kk = self.index[key]
+            send[row, kk // 32] |= np.uint32(1 << (kk % 32))
+
+        for k, e in enumerate(self.universe):
+            kind[k] = kindno[e.kind]
+            dst[k], src[k] = e.dst, e.src
+            ballot[k], prop[k], la[k], value[k] = (
+                e.ballot, e.prop, e.la, e.value,
+            )
+            dst_srv[k] = min(e.dst, S - 1)
+            dst_cli[k] = (
+                self.clients.index(e.dst) if e.dst in self.clients else 0
+            )
+            prep_lane[k] = self._prep_lane(int(dst_srv[k]))
+            row0 = k * SEL
+            if e.kind == "put":
+                rounds = sorted(r for (r, l) in self.ballots if l == e.dst)
+                round_of = [0] + [r for (r, _) in self.ballots]
+                for sel in range(NB + 1):
+                    nr = round_of[sel] + 1
+                    if nr in rounds:
+                        b = self.ballot_enum[(nr, Id(e.dst))]
+                        aux[row0 + sel] = b
+                        for d in range(S):
+                            if d != e.dst:
+                                orkey(
+                                    row0 + sel,
+                                    (e.dst, d, "prepare", b, 0, 0, 0),
+                                )
+                    else:
+                        poison[row0 + sel] = 1
+            elif e.kind == "get":
+                for v in range(1, P + 1):
+                    key = (e.dst, e.src, "getok", 0, 0, 0, v)
+                    if key in self.index:
+                        orkey(row0 + v, key)
+            elif e.kind == "putok":
+                orkey(
+                    row0, (e.dst, (e.dst + 1) % S, "get", 0, 0, 0, 0)
+                )
+            elif e.kind == "prepare":
+                las = set(self.la_universe[e.ballot])
+                for sel in range(la_max + 1):
+                    if sel in las:
+                        orkey(
+                            row0 + sel,
+                            (e.dst, e.src, "prepared", e.ballot, 0, sel, 0),
+                        )
+                    else:
+                        poison[row0 + sel] = 1
+            elif e.kind == "prepared":
+                ch = set(self.choosable[e.ballot])
+                for sel in range(P + 1):
+                    if sel in ch:
+                        for d in range(S):
+                            if d != e.dst:
+                                orkey(
+                                    row0 + sel,
+                                    (e.dst, d, "accept", e.ballot, sel,
+                                     0, 0),
+                                )
+                    else:
+                        poison[row0 + sel] = 1
+            elif e.kind == "accept":
+                orkey(
+                    row0, (e.dst, e.src, "accepted", e.ballot, 0, 0, 0)
+                )
+            elif e.kind == "accepted":
+                ch = set(self.choosable[e.ballot])
+                for sel in range(P + 1):
+                    if sel in ch:
+                        for d in range(S):
+                            if d != e.dst:
+                                orkey(
+                                    row0 + sel,
+                                    (e.dst, d, "decided", e.ballot, sel,
+                                     0, 0),
+                                )
+                        orkey(
+                            row0 + sel,
+                            (e.dst, self.clients[sel - 1], "putok", 0,
+                             sel, 0, 0),
+                        )
+                    else:
+                        poison[row0 + sel] = 1
+        # Pack per-slot params into ONE table row and the (slot, sel)
+        # tables into another: per-pair fetches then cost two row
+        # gathers instead of twelve scalar gathers (~10ns/row each on
+        # TPU regardless of table size — measured 95ms/wave at 1M
+        # pairs before packing).
+        params = np.stack(
+            [kind, dst_srv, dst_cli, src, ballot, prop, la, value,
+             prep_lane],
+            axis=1,
+        )
+        sendtab = np.concatenate(
+            [send, poison[:, None], aux[:, None]], axis=1
+        )
+        self._sp = dict(
+            SEL=SEL, kind=kind, ballot=ballot,
+            dst_srv=dst_srv, dst_cli=dst_cli,
+            k_lane=(np.arange(K) // 32).astype(np.uint32),
+            k_shift=(np.arange(K) % 32).astype(np.uint32),
+            params=params, sendtab=sendtab,
+        )
+        return self._sp
+
+    def enabled_mask_vec(self, vec):
+        """bool[K]: presence bit AND the dense handler's guard — must
+        match ``step_vec``'s validity exactly (pinned by an exhaustive
+        differential test over the 2-client space)."""
+        import jax.numpy as jnp
+
+        t = self._sparse_tables()
+        net = vec[self.n_state_lanes:]
+        present = (
+            (net[jnp.asarray(t["k_lane"])] >> jnp.asarray(t["k_shift"]))
+            & jnp.uint32(1)
+        ) != 0
+        srv = vec[: self.S]
+        dec = ((srv >> jnp.uint32(self.B_DEC)) & jnp.uint32(1)) != 0
+        bal = (srv >> jnp.uint32(self.B_BALLOT)) & jnp.uint32(
+            (1 << self.W_BALLOT) - 1
+        )
+        prp = (srv >> jnp.uint32(self.B_PROP)) & jnp.uint32(
+            (1 << self.W_PROP) - 1
+        )
+        clane = vec[self._clane_index()]
+        ph = jnp.stack(
+            [
+                (clane >> jnp.uint32(j * self.CST)) & jnp.uint32(3)
+                for j in range(self.C)
+            ]
+        )
+        ds = jnp.asarray(t["dst_srv"])
+        d = dec[ds]
+        b = bal[ds]
+        p = prp[ds]
+        cph = ph[jnp.asarray(t["dst_cli"])]
+        k = jnp.asarray(t["kind"])
+        bt = jnp.asarray(t["ballot"])
+        handled = (
+            ((k == 0) & ~d & (p == 0))
+            | ((k == 1) & d)
+            | ((k == 2) & (cph == 0))
+            | ((k == 3) & (cph == 1))
+            | ((k == 4) & ~d & (b < bt))
+            | ((k == 5) & ~d & (b == bt))
+            | ((k == 6) & ~d & (b <= bt))
+            | ((k == 7) & ~d & (b == bt))
+            | ((k == 8) & ~d)
+        )
+        return present & handled
+
+    def step_slot_vec(self, vec, slot):
+        """Successor for one enabled (state, slot) pair; every dense
+        handler's per-slot constant is a table gather, every branch a
+        select — one straight-line program, no lax.switch (all branches
+        would execute under vmap anyway; sharing the gathered params
+        across kinds is cheaper)."""
+        import jax.numpy as jnp
+
+        t = self._sparse_tables()
+        xp = jnp
+        SEL = t["SEL"]
+        P, S = self.P, self.S
+        slot = slot.astype(xp.uint32)
+        prow = xp.asarray(t["params"])[slot]
+        kind, dsrv, dcli, src, bt, pt, lat, vt, pl_idx = (
+            prow[i] for i in range(9)
+        )
+
+        is_put = kind == 0
+        is_get = kind == 1
+        is_putok = kind == 2
+        is_getok = kind == 3
+        is_prepare = kind == 4
+        is_prepared = kind == 5
+        is_accept = kind == 6
+        is_accepted = kind == 7
+        is_decided = kind == 8
+
+        def fget(lane, shift, width):
+            return (lane >> shift) & xp.uint32((1 << width) - 1)
+
+        def fset(lane, shift, width, val):
+            mask = xp.uint32((1 << width) - 1) << shift
+            return (lane & ~mask) | (
+                (val.astype(xp.uint32) & xp.uint32((1 << width) - 1))
+                << shift
+            )
+
+        def u(x):
+            return xp.uint32(x)
+
+        # Dynamic-index reads also become static selects (same TPU
+        # lowering hazard class as the writes below).
+        lane = vec[0]
+        for j in range(1, self.S):
+            lane = xp.where(dsrv == j, vec[j], lane)
+        if self.two_lane:
+            plane = vec[self.S]
+            for j in range(self.S + 1, 2 * self.S):
+                plane = xp.where(pl_idx == j, vec[j], plane)
+        else:
+            plane = lane  # prepares share the main lane
+        clidx = self._clane_index()
+        clane = vec[clidx]
+        dec = fget(lane, u(self.B_DEC), 1) != 0
+        bal = fget(lane, u(self.B_BALLOT), self.W_BALLOT)
+        prp = fget(lane, u(self.B_PROP), self.W_PROP)
+        acc = fget(lane, u(self.B_ACC), self.W_ACC)
+        accepts = fget(lane, u(self.B_ACCEPTS), self.W_ACCEPTS)
+
+        # prepared: record prepares[src] = 1 + la, majority fire.
+        pshift = u(self.B_PREP) + u(self.W_PREP) * src
+        new_plane = fset(plane, pshift, self.W_PREP, u(1) + lat)
+        entries = [
+            fget(new_plane, u(self.B_PREP + self.W_PREP * i), self.W_PREP)
+            for i in range(S)
+        ]
+        pcount = sum((en != 0).astype(xp.uint32) for en in entries)
+        fire = ~dec & (bal == bt) & (pcount == 2)
+        best = u(0)
+        for en in entries:
+            best = xp.maximum(best, xp.where(en != 0, en - 1, u(0)))
+        chosen = xp.where(best > 0, ((best - 1) % u(P)) + 1, prp)
+
+        # accepted: accepts |= 1 << src, majority fire.
+        acc2 = accepts | (u(1) << src)
+        acount = sum(
+            ((acc2 >> u(i)) & u(1)) for i in range(S)
+        ).astype(xp.uint32)
+        fire_acc = ~dec & (bal == bt) & (acount == 2)
+
+        # get: value of the accepted proposal.
+        val = xp.where(acc > 0, ((acc - 1) % u(P)) + 1, u(0))
+
+        # Unified (slot, selector) tables: sends, poison, put's new
+        # ballot. Gate: prepared/accepted send+poison only on fire.
+        sel = xp.where(
+            is_put, bal,
+            xp.where(
+                is_get, val,
+                xp.where(
+                    is_prepare, acc,
+                    xp.where(
+                        is_prepared, chosen,
+                        xp.where(is_accepted, prp, u(0)),
+                    ),
+                ),
+            ),
+        )
+        gate = xp.where(
+            is_prepared, fire, xp.where(is_accepted, fire_acc, True)
+        )
+        trow = slot * u(SEL) + sel
+        srow = xp.asarray(t["sendtab"])[trow]
+        send_row = xp.where(gate, srow[: self.net_lanes], u(0))
+        poison = gate & (srow[self.net_lanes] != 0)
+        nb = srow[self.net_lanes + 1]
+
+        # Per-kind server-lane updates (branchless, selected by kind).
+        put_prep = (acc + 1) << (u(self.W_PREP) * dsrv)
+        put_lane = (
+            (nb << u(self.B_BALLOT))
+            | (pt << u(self.B_PROP))
+            | (acc << u(self.B_ACC))
+        )
+        prepare_lane = fset(lane, u(self.B_BALLOT), self.W_BALLOT, bt)
+        acc_code_cb = u(1) + (bt - 1) * u(P) + (chosen - 1)
+        fired_lane = fset(lane, u(self.B_PROP), self.W_PROP, chosen)
+        fired_lane = fset(fired_lane, u(self.B_ACC), self.W_ACC,
+                          acc_code_cb)
+        fired_lane = fset(
+            fired_lane, u(self.B_ACCEPTS), self.W_ACCEPTS, u(1) << dsrv
+        )
+        prepared_lane = xp.where(fire, fired_lane, lane)
+        acc_code_bp = u(1) + (bt - 1) * u(P) + (pt - 1)
+        accept_lane = fset(lane, u(self.B_BALLOT), self.W_BALLOT, bt)
+        accept_lane = fset(accept_lane, u(self.B_ACC), self.W_ACC,
+                           acc_code_bp)
+        accepted_lane = fset(
+            lane, u(self.B_ACCEPTS), self.W_ACCEPTS, acc2
+        )
+        accepted_lane = xp.where(
+            fire_acc, accepted_lane | u(1 << self.B_DEC), accepted_lane
+        )
+        decided_lane = fset(lane, u(self.B_BALLOT), self.W_BALLOT, bt)
+        decided_lane = fset(decided_lane, u(self.B_ACC), self.W_ACC,
+                            acc_code_bp)
+        decided_lane = decided_lane | u(1 << self.B_DEC)
+
+        srv_lane = lane
+        if not self.two_lane:
+            # Prepares share the main lane: merge field updates.
+            pmask = u(((1 << (S * self.W_PREP)) - 1) << self.B_PREP)
+            put_lane = put_lane | (put_prep << u(self.B_PREP))
+            prepared_lane = (prepared_lane & ~pmask) | (new_plane & pmask)
+        srv_lane = xp.where(is_put, put_lane, srv_lane)
+        srv_lane = xp.where(is_prepare, prepare_lane, srv_lane)
+        srv_lane = xp.where(is_prepared, prepared_lane, srv_lane)
+        srv_lane = xp.where(is_accept, accept_lane, srv_lane)
+        srv_lane = xp.where(is_accepted, accepted_lane, srv_lane)
+        srv_lane = xp.where(is_decided, decided_lane, srv_lane)
+
+        # Compose the output with STATIC per-lane selects, never a
+        # dynamic-index write: ``vec.at[dsrv].set(...)`` vmapped over
+        # multi-million-row pair batches was observed to DROP the
+        # scatter on TPU (XLA lowering hazard; correct on CPU) — the
+        # repro is a padded 2M-pair wave where the server lane kept its
+        # old value while the net lanes updated. W is tiny (~13), so
+        # W selects are cheap and fusion-friendly anyway.
+        lanes_out = []
+        for j in range(self.n_state_lanes):
+            lane_j = vec[j]
+            if j < self.S:
+                lane_j = xp.where(dsrv == j, srv_lane, lane_j)
+            if self.two_lane and self.S <= j < 2 * self.S:
+                plane_new = xp.where(
+                    is_put, put_prep,
+                    xp.where(is_prepared, new_plane, plane),
+                )
+                lane_j = xp.where(pl_idx == j, plane_new, lane_j)
+            lanes_out.append(lane_j)
+        out = vec
+        for j, lane_j in enumerate(lanes_out):
+            out = out.at[j].set(lane_j)
+
+        # Client-lane updates (putok/getok) + the poison bit.
+        cst = u(self.CST) * dcli
+        putok_clane = fset(clane, cst, 2, u(1))
+        putok_clane = fset(putok_clane, cst + u(2), 2, u(2))
+        getok_clane = fset(clane, cst, 2, u(2))
+        getok_clane = fset(getok_clane, cst + u(2), 2, u(3))
+        getok_clane = fset(getok_clane, cst + u(4), self.W_RV, vt)
+        clane_new = xp.where(
+            is_putok, putok_clane, xp.where(is_getok, getok_clane, clane)
+        )
+        clane_new = xp.where(
+            poison, clane_new | u(1 << _B_POISON), clane_new
+        )
+        out = out.at[clidx].set(clane_new)
+
+        # Network: clear the delivered bit, OR the (gated) sends in.
+        for ln in range(self.net_lanes):
+            idx = self.n_state_lanes + ln
+            lane_v = out[idx]
+            clear = xp.where(
+                (slot >> u(5)) == u(ln),
+                u(1) << (slot & u(31)),
+                u(0),
+            )
+            out = out.at[idx].set((lane_v & ~clear) | send_row[ln])
+        return out
+
     # -- properties --------------------------------------------------------
 
     def property_conditions_vec(self, vec):

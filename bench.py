@@ -195,6 +195,27 @@ def tpu_workloads(quick=False):
                 10340352,
             )
         )
+        loads.append(
+            (
+                # THE north-star workload (BASELINE.md:27 defines the
+                # target on `paxos check 4`, examples/paxos.rs:352-465).
+                # The true space is 2,372,188 states at depth 28 — far
+                # below the pre-measurement ~85M estimate, because the
+                # 4th client shares leader 0, whose single-Put guard
+                # (proposal-None) caps the ballot blowup. First
+                # executed round 4, via sparse dispatch.
+                "paxos 4c/3s",
+                paxos(
+                    4,
+                    capacity=5 << 19,
+                    frontier_capacity=1 << 19,
+                    cand_capacity=1 << 21,
+                    pair_width=16,
+                    tile_rows=1 << 19,
+                ),
+                2372188,
+            )
+        )
     return loads
 
 

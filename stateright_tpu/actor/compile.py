@@ -760,6 +760,276 @@ class CompiledActorEncoding(EncodedModelBase):
                 h2 = self._hist_tr.get((h, cls[0], cls[1]))
                 if h2 is not None:
                     self.tbl_history[hi, ci] = self.hidx[h2]
+        self.n_cls = n_cls
+        self._build_sparse_tables()
+
+    # -- sparse dispatch tables (SparseEncodedModel) ----------------------
+    #
+    # The same per-slot tables the dense step unrolls statically,
+    # re-laid-out for TRACED slot indices so the sort-merge engine's
+    # sparse path (checkers/tpu_sortmerge.py) can run the transition on
+    # compacted (row, slot) pairs only. Layout is gather-lean (the TPU
+    # pair-kernel lessons from PERF.md §sparse): all per-slot constants
+    # pack into ONE [A, 12] params row, all per-(slot, actor-state)
+    # transition effects into ONE [R, 3W+3] flat row, and every lane
+    # read/write is a static per-lane select — never a dynamic-index
+    # scatter.
+    #
+    # Params row layout (uint32):
+    #   0 kind (0=deliver, 1=drop, 2=timeout, 3=crash, 4=pad)
+    #   1 actor index (deliver dst / timeout owner / crash target)
+    #   2 flat-table row offset (deliver/timeout)
+    #   3 actor-state field lane   4 shift   5 mask
+    #   6 net-count field lane     7 shift   8 mask   (deliver/drop)
+    #   9 timer/crashed field lane 10 shift
+    #   11 unused (pad)
+    # Flat transition row layout: [nxt, noop, hcl] + ndl[W] + tan[W]
+    # + tor[W].
+
+    _SK_DELIVER, _SK_DROP, _SK_TIMEOUT, _SK_CRASH, _SK_PAD = range(5)
+
+    def _build_sparse_tables(self) -> None:
+        W = self.width
+        A = self.max_actions
+        params = np.zeros((A, 12), np.uint32)
+        params[:, 0] = self._SK_PAD
+        flat_rows: list = []
+
+        def flat_of(tbl) -> int:
+            """Append one per-state transition block; return its base
+            row. tbl = (nxt, noop, ndl, tan, tor, hcl) arrays over the
+            dst actor's state domain."""
+            nxt, noop, ndl, tan, tor, hcl = tbl
+            base = len(flat_rows)
+            for si in range(len(nxt)):
+                flat_rows.append(
+                    np.concatenate(
+                        [
+                            np.array(
+                                [nxt[si], np.uint32(bool(noop[si])),
+                                 hcl[si]],
+                                np.uint32,
+                            ),
+                            ndl[si], tan[si], tor[si],
+                        ]
+                    )
+                )
+            return base
+
+        a = 0
+        for (i, k, nxt, noop, ndl, tan, tor, hcl) in self.tbl_deliver:
+            f, fn = self.f_actor[i], self.f_net[k]
+            params[a] = [
+                self._SK_DELIVER, i,
+                flat_of((nxt, noop, ndl, tan, tor, hcl)),
+                f.lane, f.shift, (1 << f.bits) - 1,
+                fn.lane, fn.shift, (1 << fn.bits) - 1,
+                0, 0, 0,
+            ]
+            a += 1
+        for k in self.drop_slots:
+            fn = self.f_net[k]
+            params[a] = [
+                self._SK_DROP, 0, 0, 0, 0, 0,
+                fn.lane, fn.shift, (1 << fn.bits) - 1, 0, 0, 0,
+            ]
+            a += 1
+        for (i, j, nxt, noop, ndl, tan, tor, hcl) in self.tbl_timeout:
+            f, ft = self.f_actor[i], self.f_timer[i][j]
+            params[a] = [
+                self._SK_TIMEOUT, i,
+                flat_of((nxt, noop, ndl, tan, tor, hcl)),
+                f.lane, f.shift, (1 << f.bits) - 1,
+                0, 0, 0, ft.lane, ft.shift, 0,
+            ]
+            a += 1
+        for i in self.crash_slots:
+            fc = self.f_crashed[i]
+            params[a] = [
+                self._SK_CRASH, i, 0, 0, 0, 0, 0, 0, 0,
+                fc.lane, fc.shift, 0,
+            ]
+            a += 1
+
+        self._sp_params = params
+        self._sp_flat = (
+            np.stack(flat_rows)
+            if flat_rows
+            else np.zeros((1, 3 + 3 * W), np.uint32)
+        )
+        self._sp_hist_flat = self.tbl_history.reshape(-1)
+        # Crash: per-actor [W] AND-mask clearing every timer bit.
+        cr = np.full((max(1, self.n), W), 0xFFFFFFFF, np.uint32)
+        for i in range(self.n):
+            for ftm in self.f_timer[i]:
+                cr[i, ftm.lane] &= ~np.uint32(1 << ftm.shift)
+        self._sp_crash_and = cr
+
+    @property
+    def trivial_boundary(self) -> bool:
+        """Lets the sparse engine skip the per-pair boundary pass and
+        the terminal scatter-back when no boundary spec exists."""
+        return self.boundary_spec is None
+
+    def enabled_mask_vec(self, vec):
+        """bool[A]: present/armed AND the precomputed no-op tables —
+        the dense ``step_vec`` validity EXCEPT the count-bound poison,
+        which ``step_slot_vec`` reports as its truncation flag (the
+        engine excludes those pairs and raises when in-boundary)."""
+        import jax.numpy as jnp
+
+        p = self._sp_params
+        kind = jnp.asarray(p[:, 0])
+        # Per-actor values, tabulated statically then gathered by the
+        # (host-constant) per-slot actor index.
+        s_idx = jnp.stack(
+            [self._get_actor_idx(vec, i, jnp) for i in range(self.n)]
+        )
+        crashed = jnp.stack(
+            [
+                self._get_field(vec, self.f_crashed[i], jnp) != 0
+                for i in range(self.n)
+            ]
+        )
+        n_crashed = jnp.sum(crashed.astype(jnp.uint32))
+        ai = jnp.asarray(p[:, 1])
+        a_sidx = s_idx[ai]
+        a_crashed = crashed[ai]
+        # Net count / timer bit per slot: static per-lane select.
+        net_val = jnp.uint32(0)
+        tmr_val = jnp.uint32(0)
+        for j in range(self.width):
+            net_val = jnp.where(
+                jnp.asarray(p[:, 6]) == j, vec[j], net_val
+            )
+            tmr_val = jnp.where(
+                jnp.asarray(p[:, 9]) == j, vec[j], tmr_val
+            )
+        present = (
+            (net_val >> jnp.asarray(p[:, 7])) & jnp.asarray(p[:, 8])
+        ) > 0
+        armed = (
+            (tmr_val >> jnp.asarray(p[:, 10])) & jnp.uint32(1)
+        ) != 0
+        noop = jnp.asarray(self._sp_flat[:, 1])[
+            jnp.minimum(
+                jnp.asarray(p[:, 2]) + a_sidx,
+                jnp.uint32(self._sp_flat.shape[0] - 1),
+            )
+        ] != 0
+        en_deliver = present & ~a_crashed & ~noop
+        en_drop = present
+        en_timeout = armed & ~noop
+        en_crash = ~a_crashed & (
+            n_crashed < jnp.uint32(self.max_crashes)
+        )
+        return (
+            ((kind == self._SK_DELIVER) & en_deliver)
+            | ((kind == self._SK_DROP) & en_drop)
+            | ((kind == self._SK_TIMEOUT) & en_timeout)
+            | ((kind == self._SK_CRASH) & en_crash)
+        )
+
+    def step_slot_vec(self, vec, slot):
+        """(successor, trunc) for one enabled (state, slot) pair."""
+        import jax.numpy as jnp
+
+        xp = jnp
+        W = self.width
+        slot = slot.astype(xp.uint32)
+        prow = xp.asarray(self._sp_params)[slot]
+        kind = prow[0]
+        is_deliver = kind == self._SK_DELIVER
+        is_drop = kind == self._SK_DROP
+        is_timeout = kind == self._SK_TIMEOUT
+        is_crash = kind == self._SK_CRASH
+
+        def lane_sel(arr, lane_idx):
+            v = arr[0]
+            for j in range(1, W):
+                v = xp.where(lane_idx == j, arr[j], v)
+            return v
+
+        # Actor-state index -> flat transition row.
+        s_idx = (lane_sel(vec, prow[3]) >> prow[4]) & prow[5]
+        frow_i = xp.minimum(
+            prow[2] + s_idx, xp.uint32(self._sp_flat.shape[0] - 1)
+        )
+        frow = xp.asarray(self._sp_flat)[frow_i]
+        nxt, hcl = frow[0], frow[2]
+        ndl = frow[3 : 3 + W]
+        tan = frow[3 + W : 3 + 2 * W]
+        tor = frow[3 + 2 * W : 3 + 3 * W]
+
+        h_idx = self._get_field(vec, self.f_history, xp)
+        h2 = xp.asarray(self._sp_hist_flat)[
+            h_idx * xp.uint32(self.n_cls) + hcl
+        ]
+
+        # deliver/timeout: the table-driven transition, composed as
+        # pure [W]-vector ops (delta add/or, timer and/or, field sets
+        # via static-lane selects).
+        apply = vec
+        amask = xp.uint32(prow[5]) << prow[4]
+        aval = (nxt & prow[5]) << prow[4]
+        asel = xp.arange(W, dtype=xp.uint32) == prow[3]
+        apply = xp.where(asel, (apply & ~amask) | aval, apply)
+        if self.dup:
+            apply = apply | ndl
+        else:
+            apply = apply + ndl
+        apply = (apply & tan) | tor
+        hf = self.f_history
+        hmask = xp.uint32(hf.mask)
+        hval = (h2 & xp.uint32((1 << hf.bits) - 1)) << xp.uint32(hf.shift)
+        hsel = xp.arange(W, dtype=xp.uint32) == xp.uint32(hf.lane)
+        apply = xp.where(hsel, (apply & ~hmask) | hval, apply)
+
+        # deliver additionally consumes the envelope (nondup). The
+        # count must be read POST-delta (a handler may re-send the
+        # envelope it consumed, exactly as the dense dec_net reads the
+        # updated state).
+        nsel = xp.arange(W, dtype=xp.uint32) == prow[6]
+        if self.dup:
+            s_deliver = apply  # redeliverable (network.rs:204-206)
+            s_drop = xp.where(
+                nsel, vec & ~(prow[8] << prow[7]), vec
+            )
+        else:
+            nmask = prow[8] << prow[7]
+            ac = (lane_sel(apply, prow[6]) >> prow[7]) & prow[8]
+            s_deliver = xp.where(
+                nsel, (apply & ~nmask) | (((ac - 1) & prow[8]) << prow[7]),
+                apply,
+            )
+            vc = (lane_sel(vec, prow[6]) >> prow[7]) & prow[8]
+            s_drop = xp.where(
+                nsel, (vec & ~nmask) | (((vc - 1) & prow[8]) << prow[7]),
+                vec,
+            )
+
+        s_timeout = apply  # fired-timer clear already folded into tan
+
+        csel = xp.arange(W, dtype=xp.uint32) == prow[9]
+        s_crash = xp.where(csel, vec | (xp.uint32(1) << prow[10]), vec)
+        ai = xp.minimum(prow[1], xp.uint32(max(0, self.n - 1)))
+        s_crash = s_crash & xp.asarray(self._sp_crash_and)[ai]
+
+        succ = xp.where(
+            is_deliver, s_deliver,
+            xp.where(
+                is_drop, s_drop,
+                xp.where(is_timeout, s_timeout,
+                         xp.where(is_crash, s_crash, vec)),
+            ),
+        )
+        if self.dup:
+            trunc = xp.bool_(False)
+        else:
+            trunc = (is_deliver | is_timeout) & xp.any(
+                (succ & xp.asarray(self._net_top_mask)) != 0
+            )
+        return succ, trunc
 
     # -- field access (host + device) ------------------------------------
 

@@ -47,12 +47,13 @@ from .tpu import TpuBfsChecker, _fp_int, step_with_trunc
 
 class TpuSimulationChecker(TpuBfsChecker):
     """``CheckerBuilder.spawn_tpu_simulation()`` — N vmapped random
-    walks. Reuses the wave engine's result surface (discovery
-    fingerprints, host-replay path reconstruction via parent-free
-    re-walk is NOT available: simulation reports discovery
-    fingerprints and example/counterexample existence, as the
-    reference's simulation checker reports discovered paths only for
-    the traces it kept)."""
+    walks. With ``track_paths=True`` (default) the device keeps a
+    per-walk fingerprint trace ring; on each property's FIRST discovery
+    the hitting walk's trace is frozen into a per-property buffer, and
+    ``discoveries()`` replays it through the host model into a real
+    :class:`Path` — the device counterpart of the trace the reference's
+    simulation checker keeps per iteration
+    (src/checker/simulation.rs:180-364)."""
 
     def __init__(
         self,
@@ -62,33 +63,78 @@ class TpuSimulationChecker(TpuBfsChecker):
         max_steps: int = 64,
         rounds: int = 4,
         seed: int = 0,
+        track_paths: bool = True,
     ):
         super().__init__(
             builder,
             encoded=encoded,
             capacity=1,
             frontier_capacity=1,
-            track_paths=False,
+            track_paths=track_paths,
         )
         self.n_walks = n_walks
         self.max_steps = max_steps
         self.rounds = rounds
         self.seed = seed
+        #: per-property frozen traces: name -> [fp, ...] (uint64)
+        self._disc_traces: dict[str, list[int]] = {}
 
     def _cache_extras(self) -> tuple:
         return ("tpu-sim", self.n_walks, self.max_steps, self.rounds,
-                self.seed)
+                self.seed, self.track_paths)
 
     def discoveries(self):
         self._ensure_run()
-        if not self._discovered_fps:
-            return {}
-        raise RuntimeError(
-            "the device simulation checker reports discovery existence "
-            "and fingerprints only (discovered_property_names / "
-            "discovery_fingerprints); use spawn_simulation or an "
-            "exhaustive checker for counterexample paths"
-        )
+        if not self.track_paths and self._discovered_fps:
+            raise RuntimeError(
+                "paths unavailable with track_paths=False; use "
+                "discovered_property_names()/discovery_fingerprints(), "
+                "or re-run with track_paths=True for replayable traces"
+            )
+        out = {}
+        for name, fps in self._disc_traces.items():
+            out[name] = self._replay_trace(fps)
+        return out
+
+    def _replay_trace(self, fps: list[int]) -> Path:
+        """Replay a fingerprint trace through the HOST model (the same
+        differential the wave engine's path reconstruction performs —
+        every step must re-encode to the recorded fingerprint)."""
+        import numpy as np
+
+        model = self.model
+        enc = self.encoded
+        state = None
+        for init_state in model.init_states():
+            vec = np.asarray(enc.encode(init_state), np.uint32)
+            if self._vec_fp(vec) == fps[0]:
+                state = init_state
+                break
+        if state is None:
+            raise RuntimeError(
+                f"no init state encodes to fingerprint {fps[0]:#x}; "
+                "encode()/init_vecs() disagree"
+            )
+        steps = []
+        for next_fp in fps[1:]:
+            found = False
+            for action in model.actions(state):
+                next_state = model.next_state(state, action)
+                if next_state is None:
+                    continue
+                vec = np.asarray(enc.encode(next_state), np.uint32)
+                if self._vec_fp(vec) == next_fp:
+                    steps.append((state, action))
+                    state = next_state
+                    found = True
+                    break
+            if not found:
+                raise RuntimeError(
+                    f"no host successor encodes to {next_fp:#x}: the "
+                    "device walk disagrees with the host model"
+                )
+        steps.append((state, None))
+        return Path(steps)
 
     # -- device program ----------------------------------------------------
 
@@ -116,6 +162,8 @@ class TpuSimulationChecker(TpuBfsChecker):
         rounds = self.rounds
         seed = self.seed
         ebits_init = self._eventually_bits_init()
+        track_paths = self.track_paths
+        LT = max_steps + 1  # trace ring length (depth starts at 1)
 
         def rand_bits(step, salt):
             """Counter-based per-walk uniform bits: splitmix over
@@ -138,6 +186,7 @@ class TpuSimulationChecker(TpuBfsChecker):
             idx = jnp.arange(N, dtype=jnp.uint32) % jnp.uint32(n0)
             walks = init_rows[idx]
             ebits = jnp.full(N, jnp.uint32(ebits_init))
+            LTt = LT if track_paths else 1
             return dict(
                 walks=walks,
                 ebits=ebits,
@@ -149,13 +198,30 @@ class TpuSimulationChecker(TpuBfsChecker):
                 disc_lo=jnp.zeros(n_props, dtype=jnp.uint32),
                 disc_hi=jnp.zeros(n_props, dtype=jnp.uint32),
                 e_ovf=jnp.bool_(False),
+                # Per-walk fingerprint trace ring + per-property frozen
+                # traces (the hitting walk's prefix at first discovery).
+                trace_lo=jnp.zeros((N, LTt), jnp.uint32),
+                trace_hi=jnp.zeros((N, LTt), jnp.uint32),
+                dt_lo=jnp.zeros((n_props, LTt), jnp.uint32),
+                dt_hi=jnp.zeros((n_props, LTt), jnp.uint32),
+                dt_len=jnp.zeros(n_props, jnp.uint32),
                 init=init_rows,
             )
 
         def eval_block(walks, ebits, c):
             """Property bitmap + discovery folding over a walk block;
-            returns (succs, valid, terminal, ebits', disc triple)."""
+            returns (succs, valid, terminal, ebits', disc/trace
+            updates)."""
             f_lo, f_hi = fingerprint_u32v(walks, jnp)
+            if track_paths:
+                # Record each walk's CURRENT state at its depth slot —
+                # idempotent, so the end-of-round re-evaluation is safe.
+                pos = jnp.minimum(c["walk_depth"] - 1, jnp.uint32(LT - 1))
+                rows_i = jnp.arange(N)
+                trace_lo = c["trace_lo"].at[rows_i, pos].set(f_lo)
+                trace_hi = c["trace_hi"].at[rows_i, pos].set(f_hi)
+            else:
+                trace_lo, trace_hi = c["trace_lo"], c["trace_hi"]
             if n_props:
                 cond = jax.vmap(enc.property_conditions_vec)(walks)
             else:
@@ -176,6 +242,7 @@ class TpuSimulationChecker(TpuBfsChecker):
 
             disc_found = c["disc_found"]
             disc_lo, disc_hi = c["disc_lo"], c["disc_hi"]
+            dt_lo, dt_hi, dt_len = c["dt_lo"], c["dt_hi"], c["dt_len"]
             for i, p in enumerate(props):
                 if p.expectation == Expectation.ALWAYS:
                     mask = ~cond[:, i]
@@ -195,14 +262,30 @@ class TpuSimulationChecker(TpuBfsChecker):
                 disc_hi = disc_hi.at[i].set(
                     jnp.where(fresh, f_hi[row], disc_hi[i])
                 )
+                if track_paths:
+                    # Freeze the hitting walk's trace prefix before its
+                    # ring slots are recycled by a restart.
+                    dt_lo = dt_lo.at[i].set(
+                        jnp.where(fresh, trace_lo[row], dt_lo[i])
+                    )
+                    dt_hi = dt_hi.at[i].set(
+                        jnp.where(fresh, trace_hi[row], dt_hi[i])
+                    )
+                    dt_len = dt_len.at[i].set(
+                        jnp.where(
+                            fresh, c["walk_depth"][row], dt_len[i]
+                        )
+                    )
             return (succs, valid, n_valid, terminal, ebits,
-                    disc_found, disc_lo, disc_hi, trunc_any)
+                    disc_found, disc_lo, disc_hi, trunc_any,
+                    trace_lo, trace_hi, dt_lo, dt_hi, dt_len)
 
         def step_once(step, c, salt):
             walks = c["walks"]
             (
                 succs, valid, n_valid, terminal, ebits,
                 disc_found, disc_lo, disc_hi, trunc_any,
+                trace_lo, trace_hi, dt_lo, dt_hi, dt_len,
             ) = eval_block(walks, c["ebits"], c)
 
             # Uniform choice among the valid successors of each walk.
@@ -242,6 +325,11 @@ class TpuSimulationChecker(TpuBfsChecker):
                 disc_lo=disc_lo,
                 disc_hi=disc_hi,
                 e_ovf=c["e_ovf"] | trunc_any,
+                trace_lo=trace_lo,
+                trace_hi=trace_hi,
+                dt_lo=dt_lo,
+                dt_hi=dt_hi,
+                dt_len=dt_len,
                 init=c["init"],
             )
 
@@ -260,7 +348,8 @@ class TpuSimulationChecker(TpuBfsChecker):
                 # inside the loop but not yet property-checked —
                 # evaluate them before restarting the walks.
                 (_, _, _, _, _, disc_found, disc_lo, disc_hi,
-                 trunc_any) = (
+                 trunc_any, trace_lo, trace_hi, dt_lo, dt_hi,
+                 dt_len) = (
                     eval_block(c["walks"], c["ebits"], c)
                 )
                 idx = (
@@ -276,6 +365,11 @@ class TpuSimulationChecker(TpuBfsChecker):
                     disc_lo=disc_lo,
                     disc_hi=disc_hi,
                     e_ovf=c["e_ovf"] | trunc_any,
+                    trace_lo=trace_lo,
+                    trace_hi=trace_hi,
+                    dt_lo=dt_lo,
+                    dt_hi=dt_hi,
+                    dt_len=dt_len,
                 )
             stats = jnp.concatenate(
                 [
@@ -289,6 +383,9 @@ class TpuSimulationChecker(TpuBfsChecker):
                     c["disc_found"].astype(jnp.uint32),
                     c["disc_lo"],
                     c["disc_hi"],
+                    c["dt_len"],
+                    c["dt_lo"].reshape(-1),
+                    c["dt_hi"].reshape(-1),
                 ]
             )
             return stats
@@ -326,11 +423,25 @@ class TpuSimulationChecker(TpuBfsChecker):
         disc_found = stats[3 : 3 + n_props]
         disc_lo = stats[3 + n_props : 3 + 2 * n_props]
         disc_hi = stats[3 + 2 * n_props : 3 + 3 * n_props]
+        off = 3 + 3 * n_props
+        dt_len = stats[off : off + n_props]
+        LT = self.max_steps + 1 if self.track_paths else 1
+        dt_lo = stats[off + n_props : off + n_props + n_props * LT]
+        dt_hi = stats[off + n_props + n_props * LT :]
         for i, prop in enumerate(props):
             if disc_found[i]:
                 self._discovered_fps[prop.name] = _fp_int(
                     disc_lo[i], disc_hi[i]
                 )
+                if self.track_paths:
+                    ln = int(dt_len[i])
+                    fps = [
+                        _fp_int(
+                            dt_lo[i * LT + j], dt_hi[i * LT + j]
+                        )
+                        for j in range(ln)
+                    ]
+                    self._disc_traces[prop.name] = fps
         if reporter is not None:
             reporter.report_checking(
                 ReportData(

@@ -94,6 +94,149 @@ def _divisor_at_least(n: int, want: int) -> int:
     return d
 
 
+def sparse_pair_candidates(enc, frontier_f, fval_f, expand, *, EV, B_p,
+                           NT, T, mask_budget_cells, Ba,
+                           axis_name=None):
+    """The sparse-dispatch pair pipeline, shared by the single-chip and
+    sharded sort-merge engines (PERF.md §sparse): per-slot enabled
+    mask → per-row bitmaps (tiled so the [F, K] bool mask never
+    materializes at large F) → lowest-set-bit peel into ≤EV slots per
+    row → tiled 1-lane packed-append compaction into a [Ba] buffer of
+    pair indices.
+
+    Returns ``(pidx[Ba], live[Ba], pslot[Ba], cnt[F_f], n_pairs,
+    pair_ovf, tile_max)`` — ``pair_ovf`` is True when a row enabled
+    more than EV slots or the wave enabled more than B_p pairs.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    F_f = frontier_f.shape[0]
+    W = frontier_f.shape[1]
+    K = enc.max_actions
+    L = (K + 31) // 32
+    NPg = F_f * EV
+    compaction = NPg > B_p
+
+    def pv(x):
+        """Inside shard_map, fori_loop carries seeded from constants
+        are 'unvarying' while the body outputs vary per shard — mark
+        the seeds as shard-varying to keep carry types equal."""
+        if axis_name is None:
+            return x
+        return lax.pvary(x, axis_name)
+
+    def mask_bits(tf, tfv):
+        m = jax.vmap(enc.enabled_mask_vec)(tf)
+        m = m & tfv[:, None] & expand
+        tc = jnp.sum(m, axis=1, dtype=jnp.uint32)
+        mp = jnp.pad(m, ((0, 0), (0, L * 32 - K)))
+        tb = jnp.sum(
+            mp.reshape(-1, L, 32).astype(jnp.uint32)
+            * (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)),
+            axis=2,
+            dtype=jnp.uint32,
+        )
+        return tb, tc
+
+    if F_f * K > mask_budget_cells:
+        NTm = _divisor_at_least(F_f, -(-F_f * K // mask_budget_cells))
+        Tm = F_f // NTm
+
+        def mtile(ti, acc):
+            bits_a, cnt_a = acc
+            off = ti * Tm
+            tf = lax.dynamic_slice(frontier_f, (off, 0), (Tm, W))
+            tfv = lax.dynamic_slice(fval_f, (off,), (Tm,))
+            tb, tc = mask_bits(tf, tfv)
+            bits_a = lax.dynamic_update_slice(bits_a, tb, (off, 0))
+            cnt_a = lax.dynamic_update_slice(cnt_a, tc, (off,))
+            return bits_a, cnt_a
+
+        bits, cnt = lax.fori_loop(
+            0,
+            NTm,
+            mtile,
+            (
+                pv(jnp.zeros((F_f, L), jnp.uint32)),
+                pv(jnp.zeros(F_f, jnp.uint32)),
+            ),
+        )
+    else:
+        bits, cnt = mask_bits(frontier_f, fval_f)
+    n_pairs = jnp.sum(cnt, dtype=jnp.uint32)
+    pair_ovf = jnp.any(cnt > jnp.uint32(EV)) | (
+        n_pairs > jnp.uint32(B_p)
+    )
+
+    # Peel the lowest set bit per row, EV times — pure elementwise
+    # [F, L] passes plus a min-reduce along L (argmax/take_along_axis
+    # formulations lower to slow gathers on TPU: measured
+    # ~6ms/iteration vs <0.5ms for this form at F=2^18, L=9).
+    lane_base = (
+        jnp.arange(L, dtype=jnp.uint32) * jnp.uint32(32)
+    )[None, :]
+    lanes = bits
+    slot_cols, val_cols = [], []
+    for _ in range(EV):
+        low = lanes & (jnp.uint32(0) - lanes)
+        pos = lax.population_count(low - jnp.uint32(1))
+        cand = jnp.where(
+            lanes != 0, lane_base + pos, jnp.uint32(_SENT)
+        )
+        slot = jnp.min(cand, axis=1)
+        any_ = slot != jnp.uint32(_SENT)
+        slot_cols.append(jnp.where(any_, slot, jnp.uint32(0)))
+        val_cols.append(any_)
+        lanes = jnp.where(
+            cand == slot[:, None],
+            lanes & (lanes - jnp.uint32(1)),
+            lanes,
+        )
+    slots_flat = jnp.stack(slot_cols, axis=1).reshape(NPg)
+    valid_g = jnp.stack(val_cols, axis=1)
+
+    pair_idx = (
+        jnp.arange(F_f, dtype=jnp.uint32)[:, None] * jnp.uint32(EV)
+        + jnp.arange(EV, dtype=jnp.uint32)[None, :]
+    )
+    keys = jnp.where(valid_g, pair_idx, jnp.uint32(_SENT)).reshape(NPg)
+
+    if compaction:
+        # Tiled 1-lane packed-append compaction (the sparse analog of
+        # the dense tiled key compaction; sort is superlinear so NT
+        # small sorts beat one big one).
+        def tile_body(ti, acc):
+            pk, app_off, tmax = acc
+            off = ti * (T * EV)
+            tk = lax.dynamic_slice(keys, (off,), (T * EV,))
+            tc = jnp.sum(tk != jnp.uint32(_SENT), dtype=jnp.uint32)
+            tmax = jnp.maximum(tmax, tc)
+            (sk,) = lax.sort((tk,), num_keys=1)
+            pk = lax.dynamic_update_slice(pk, sk, (app_off,))
+            return pk, app_off + tc, tmax
+
+        pk, _, tile_max = lax.fori_loop(
+            0,
+            NT,
+            tile_body,
+            (
+                pv(jnp.full(Ba, _SENT, jnp.uint32)),
+                pv(jnp.uint32(0)),
+                pv(jnp.uint32(0)),
+            ),
+        )
+    else:
+        pk = keys
+        tile_max = n_pairs
+
+    live = pk != jnp.uint32(_SENT)
+    pidx = jnp.where(live, pk, jnp.uint32(0))
+    pslot = slots_flat[pidx]
+    return pidx, live, pslot, cnt, n_pairs, pair_ovf, tile_max
+
+
 class SortMergeTpuBfsChecker(TpuBfsChecker):
     """``CheckerBuilder.spawn_tpu_sortmerge()``.
 
@@ -128,7 +271,8 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         v_ladder_step: int = 4,
         flat_budget_bytes: int = 1 << 30,
         sparse: bool | None = None,
-        pair_width: int = 32,
+        pair_width: int | None = None,
+        mask_budget_cells: int = 1 << 23,
         **kwargs,
     ):
         super().__init__(builder, **kwargs)
@@ -142,9 +286,12 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         #: sparse action dispatch (None = auto: on iff the encoding
         #: implements SparseEncodedModel). pair_width bounds the
         #: enabled slots extracted per frontier row per wave (overflow
-        #: detected, never silent).
+        #: detected, never silent); None defers to the encoding's
+        #: ``pair_width_hint`` and finally to max_actions, which can
+        #: never overflow per-row.
         self.sparse = sparse
         self.pair_width = pair_width
+        self.mask_budget_cells = mask_budget_cells
         if tiles > 1 and self.frontier_capacity % tiles:
             raise ValueError(
                 f"frontier_capacity {self.frontier_capacity} not divisible "
@@ -155,6 +302,13 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         if self.sparse is not None:
             return self.sparse
         return isinstance(self.encoded, SparseEncodedModel)
+
+    def _pair_width(self) -> int:
+        K = self.encoded.max_actions
+        if self.pair_width is not None:
+            return min(self.pair_width, K)
+        hint = getattr(self.encoded, "pair_width_hint", None)
+        return min(hint, K) if hint else K
 
     def _cache_extras(self) -> tuple:
         return (
@@ -167,7 +321,8 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             self.v_ladder_step,
             self.flat_budget_bytes,
             self._use_sparse(),
-            self.pair_width,
+            self._pair_width(),
+            self.mask_budget_cells,
         )
 
     def _maybe_warn_occupancy(self, occupancy: float) -> None:
@@ -179,7 +334,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             return (
                 "pair-buffer overflow: a wave enabled more (row, slot) "
                 f"pairs than cand_capacity={self.cand_capacity}, or one "
-                f"row enabled more than pair_width={self.pair_width} "
+                f"row enabled more than pair_width={self._pair_width()} "
                 "slots; raise the exceeded knob — the "
                 "max_wave_candidates metric reports the observed peak"
             )
@@ -715,9 +870,22 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             and not getattr(enc, "trivial_boundary", False)
         )
 
+        import jax as _jax
+
+        use_sparse = self._use_sparse()
+        if use_sparse:
+            _res_shape = _jax.eval_shape(
+                enc.step_slot_vec,
+                _jax.ShapeDtypeStruct((W,), jnp.uint32),
+                _jax.ShapeDtypeStruct((), jnp.uint32),
+            )
+            sparse_has_trunc = isinstance(_res_shape, tuple)
+        else:
+            sparse_has_trunc = False
+
         def make_sparse_wave(fc: int, v_class):
             F_f = f_ladder[fc]
-            EV = min(self.pair_width, K)
+            EV = self._pair_width()
             NPg = F_f * EV
             B_p = min(B_user, NPg)
             compaction = NPg > B_p
@@ -728,6 +896,18 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             T = F_f // NT
             Ba = (B_p + T * EV) if compaction else NPg
             L = (K + 31) // 32
+            # Memory-lean mode: when the [Ba, W] successor tensor would
+            # blow the flat budget (paxos check 4: 28M pairs × 19 lanes
+            # ≈ 2GB at merge-time peak), fingerprint pairs in chunks
+            # without materializing successors, and RECOMPUTE the ≤F
+            # winning rows' successors at fetch time. Extra cost: one
+            # step_slot pass over the winners; saving: the whole [Ba,W]
+            # tensor is never alive.
+            chunked = compaction and (Ba * W * 4 > self.flat_budget_bytes)
+            if chunked:
+                NC = -(-(Ba * W * 4) // self.flat_budget_bytes)
+                Bc = -(-Ba // NC)
+                Ba = NC * Bc  # pad so chunks tile it exactly
 
             def wave(c):
                 if target_depth is None:
@@ -741,127 +921,108 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     enc, props, evt_idx, frontier_f, fval_f, ebits_f
                 )
 
-                mask = jax.vmap(enc.enabled_mask_vec)(frontier_f)
-                mask = mask & fval_f[:, None] & expand
-                cnt = jnp.sum(mask, axis=1, dtype=jnp.uint32)
-                n_pairs = jnp.sum(cnt, dtype=jnp.uint32)
-                c_overflow = (
-                    c["c_overflow"]
-                    | jnp.any(cnt > jnp.uint32(EV))
-                    | (n_pairs > jnp.uint32(B_p))
-                )
-
-                # Per-row bitmap; peel pair_width lowest set bits.
-                maskp = jnp.pad(mask, ((0, 0), (0, L * 32 - K)))
-                bits = jnp.sum(
-                    maskp.reshape(F_f, L, 32).astype(jnp.uint32)
-                    * (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)),
-                    axis=2,
-                    dtype=jnp.uint32,
-                )
-                # Peel the lowest set bit per row, EV times — pure
-                # elementwise [F, L] passes plus a min-reduce along L
-                # (argmax/take_along_axis formulations lower to slow
-                # gathers on TPU: measured ~6ms/iteration vs <0.5ms
-                # for this form at F=2^18, L=9).
-                lane_base = (
-                    jnp.arange(L, dtype=jnp.uint32) * jnp.uint32(32)
-                )[None, :]
-                lanes = bits
-                slot_cols, val_cols = [], []
-                for _ in range(EV):
-                    low = lanes & (jnp.uint32(0) - lanes)
-                    pos = lax.population_count(low - jnp.uint32(1))
-                    cand = jnp.where(
-                        lanes != 0, lane_base + pos, jnp.uint32(_SENT)
+                pidx, live, pslot, cnt, n_pairs, pair_ovf, tile_max = (
+                    sparse_pair_candidates(
+                        enc, frontier_f, fval_f, expand,
+                        EV=EV, B_p=B_p, NT=NT, T=T,
+                        mask_budget_cells=self.mask_budget_cells,
+                        Ba=Ba,
                     )
-                    slot = jnp.min(cand, axis=1)
-                    any_ = slot != jnp.uint32(_SENT)
-                    slot_cols.append(
-                        jnp.where(any_, slot, jnp.uint32(0))
-                    )
-                    val_cols.append(any_)
-                    lanes = jnp.where(
-                        cand == slot[:, None],
-                        lanes & (lanes - jnp.uint32(1)),
-                        lanes,
-                    )
-                slots_flat = jnp.stack(slot_cols, axis=1).reshape(NPg)
-                valid_g = jnp.stack(val_cols, axis=1)
-
-                pair_idx = (
-                    jnp.arange(F_f, dtype=jnp.uint32)[:, None]
-                    * jnp.uint32(EV)
-                    + jnp.arange(EV, dtype=jnp.uint32)[None, :]
                 )
-                keys = jnp.where(
-                    valid_g, pair_idx, jnp.uint32(_SENT)
-                ).reshape(NPg)
+                c_overflow = c["c_overflow"] | pair_ovf
+                e_overflow = c["e_overflow"]
+                needs_scan = sparse_boundary or sparse_has_trunc
 
-                if compaction:
-                    # Tiled 1-lane packed-append compaction (the sparse
-                    # analog of the dense tiled key compaction; sort is
-                    # superlinear so NT small sorts beat one big one).
-                    def tile_body(ti, acc):
-                        pk, app_off, tmax = acc
-                        off = ti * (T * EV)
-                        tk = lax.dynamic_slice(keys, (off,), (T * EV,))
-                        tc = jnp.sum(
-                            tk != jnp.uint32(_SENT), dtype=jnp.uint32
+                def step_pairs(st, sl):
+                    """(succ, trunc|None) for a pair block;
+                    step_slot_vec MAY return (succ, trunc): trunc marks
+                    pairs pruned by an internal encoding bound
+                    (compiled envelope counts) — excluded from
+                    candidates and, when in-boundary, raised as
+                    e_overflow (the dense truncation contract)."""
+                    res = jax.vmap(enc.step_slot_vec)(st, sl)
+                    return res if isinstance(res, tuple) else (res, None)
+
+                def eval_pairs(pidx_b, live_b, slot_b):
+                    """fingerprint keys + validity (+ scan stats) for a
+                    block of compacted pairs."""
+                    prow_b = pidx_b // jnp.uint32(EV)
+                    succ_b, ptr_b = step_pairs(
+                        frontier_f[prow_b], slot_b
+                    )
+                    if sparse_boundary:
+                        inb = jax.vmap(enc.within_boundary_vec)(succ_b)
+                        ok = live_b & inb
+                    else:
+                        ok = live_b
+                    if ptr_b is not None:
+                        eov = jnp.any(ok & ptr_b)
+                        ok = ok & ~ptr_b
+                    else:
+                        eov = jnp.bool_(False)
+                    lo, hi = fingerprint_u32v(succ_b, jnp)
+                    lo, hi = clamp_keys(lo, hi)
+                    lo = jnp.where(ok, lo, jnp.uint32(_SENT))
+                    hi = jnp.where(ok, hi, jnp.uint32(_SENT))
+                    return lo, hi, ok, prow_b, eov
+
+                if chunked:
+                    # Chunked fingerprint pass: the [Ba, W] successor
+                    # tensor is never materialized.
+                    def fchunk(ti, acc):
+                        cl, ch, nc, eov, rok = acc
+                        off = ti * Bc
+                        pidx_b = lax.dynamic_slice(pidx, (off,), (Bc,))
+                        live_b = lax.dynamic_slice(live, (off,), (Bc,))
+                        slot_b = lax.dynamic_slice(pslot, (off,), (Bc,))
+                        lo, hi, ok, prow_b, ev = eval_pairs(
+                            pidx_b, live_b, slot_b
                         )
-                        tmax = jnp.maximum(tmax, tc)
-                        (sk,) = lax.sort((tk,), num_keys=1)
-                        pk = lax.dynamic_update_slice(pk, sk, (app_off,))
-                        return pk, app_off + tc, tmax
+                        cl = lax.dynamic_update_slice(cl, lo, (off,))
+                        ch = lax.dynamic_update_slice(ch, hi, (off,))
+                        if needs_scan:
+                            nc = nc + jnp.sum(ok, dtype=jnp.uint32)
+                            rok = rok.at[
+                                jnp.where(ok, prow_b, jnp.uint32(F_f))
+                            ].max(jnp.uint32(1), mode="drop")
+                        return cl, ch, nc, eov | ev, rok
 
-                    pk, _, tile_max = lax.fori_loop(
+                    ck_lo, ck_hi, nc_acc, eov_acc, row_ok = lax.fori_loop(
                         0,
-                        NT,
-                        tile_body,
+                        NC,
+                        fchunk,
                         (
                             jnp.full(Ba, _SENT, jnp.uint32),
+                            jnp.full(Ba, _SENT, jnp.uint32),
                             jnp.uint32(0),
-                            jnp.uint32(0),
+                            jnp.bool_(False),
+                            jnp.zeros(F_f if needs_scan else 1,
+                                      jnp.uint32),
                         ),
                     )
-                else:
-                    pk = keys
-                    tile_max = n_pairs
-
-                live = pk != jnp.uint32(_SENT)
-                pidx = jnp.where(live, pk, jnp.uint32(0))
-                prow = pidx // jnp.uint32(EV)
-                pslot = slots_flat[pidx]
-                pstate = frontier_f[prow]
-                res = jax.vmap(enc.step_slot_vec)(pstate, pslot)
-                # step_slot_vec MAY return (succ, trunc): trunc marks
-                # pairs pruned by an internal encoding bound (compiled
-                # envelope counts) — excluded from candidates and, when
-                # in-boundary, raised as e_overflow (matching the dense
-                # path's truncation contract).
-                succ, ptr = res if isinstance(res, tuple) else (res, None)
-
-                e_overflow = c["e_overflow"]
-                if sparse_boundary or ptr is not None:
-                    if sparse_boundary:
-                        inb = jax.vmap(enc.within_boundary_vec)(succ)
+                    e_overflow = e_overflow | eov_acc
+                    if needs_scan:
+                        has_succ = row_ok != 0
+                        n_cand = nc_acc
                     else:
-                        inb = jnp.bool_(True)
-                    pair_ok = live & inb
-                    if ptr is not None:
-                        e_overflow = e_overflow | jnp.any(pair_ok & ptr)
-                        pair_ok = pair_ok & ~ptr
-                    # Terminal = no surviving successor at all:
-                    # scatter-max each surviving pair onto its row.
-                    row_ok = jnp.zeros(F_f, jnp.uint32).at[
-                        jnp.where(pair_ok, prow, jnp.uint32(F_f))
-                    ].max(jnp.uint32(1), mode="drop")
-                    has_succ = row_ok != 0
-                    n_cand = jnp.sum(pair_ok, dtype=jnp.uint32)
+                        has_succ = cnt > 0
+                        n_cand = n_pairs
                 else:
-                    pair_ok = live
-                    has_succ = cnt > 0
-                    n_cand = n_pairs
+                    ck_lo, ck_hi, pair_ok, prow, eov = eval_pairs(
+                        pidx, live, pslot
+                    )
+                    e_overflow = e_overflow | eov
+                    if needs_scan:
+                        # Terminal = no surviving successor at all:
+                        # scatter-max surviving pairs onto their rows.
+                        row_ok = jnp.zeros(F_f, jnp.uint32).at[
+                            jnp.where(pair_ok, prow, jnp.uint32(F_f))
+                        ].max(jnp.uint32(1), mode="drop")
+                        has_succ = row_ok != 0
+                        n_cand = jnp.sum(pair_ok, dtype=jnp.uint32)
+                    else:
+                        has_succ = cnt > 0
+                        n_cand = n_pairs
                 terminal = fval_f & ~has_succ & expand
                 evt_cex = terminal & (eb != 0)
                 exd = dict(
@@ -873,15 +1034,18 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     c["disc_found"], c["disc_lo"], c["disc_hi"],
                 )
 
-                k_lo, k_hi = fingerprint_u32v(succ, jnp)
-                k_lo, k_hi = clamp_keys(k_lo, k_hi)
-                ck_lo = jnp.where(pair_ok, k_lo, jnp.uint32(_SENT))
-                ck_hi = jnp.where(pair_ok, k_hi, jnp.uint32(_SENT))
-
                 def fetch(nf_row):
-                    par_row = prow[nf_row]
+                    # Winners' successors are recomputed from their
+                    # (row, slot) pairs — cheaper than keeping [Ba, W]
+                    # alive through the merge, and exact by the
+                    # SparseEncodedModel purity contract.
+                    pidx_w = pidx[nf_row]
+                    par_row = pidx_w // jnp.uint32(EV)
+                    succ_w, _ = step_pairs(
+                        frontier_f[par_row], pslot[nf_row]
+                    )
                     return (
-                        succ[nf_row],
+                        succ_w,
                         f_lo[par_row] if track_paths else None,
                         f_hi[par_row] if track_paths else None,
                         eb[par_row],
@@ -902,8 +1066,6 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 )
 
             return wave
-
-        use_sparse = self._use_sparse()
 
         def body(c):
             n_f = c["n_frontier"]

@@ -110,19 +110,25 @@ def _paxos(sub: str, args: list[str]) -> None:
             f"Model checking Single Decree Paxos with {client_count} "
             "clients on the TPU wave engine."
         )
-        # Measured spaces: 1c=265, 2c=16,668, 3c=1,194,428 (~71x per
-        # client); 4c is estimated ~85M — runnable on a 16GB chip in
-        # fingerprint-only mode, sized accordingly. The encoding
-        # provides sparse action dispatch (SparseEncodedModel), so the
-        # candidate budget tracks ENABLED pairs (3c peak: 343,235),
-        # not F*K slot cells; pair/tile knobs per PERF.md §sparse.
+        # Measured spaces: 1c=265, 2c=16,668, 3c=1,194,428,
+        # 4c=2,372,188 (the 4th client shares leader 0, whose
+        # single-Put guard caps the growth). The encoding provides
+        # sparse action dispatch (SparseEncodedModel), so the
+        # candidate budget tracks ENABLED pairs (3c peak 343,235; 4c
+        # peak 686,045), not F*K slot cells; knobs per PERF.md §sparse.
         caps = {
             1: (1 << 10, 1 << 8, 1 << 10),
             2: (1 << 15, 1 << 12, 1 << 14),
             3: (5 << 18, 1 << 18, 3 << 17),
-            4: (7 << 24, 1 << 22, 3 << 20),
+            4: (5 << 19, 1 << 19, 1 << 21),
         }
-        cap, fcap, ccap = caps.get(client_count, caps[4])
+        if client_count not in caps:
+            raise SystemExit(
+                f"paxos check-tpu supports 1-4 clients (got "
+                f"{client_count}): the TPU encoding's client-lane "
+                "packing caps at 4 (models/paxos_tpu.py)"
+            )
+        cap, fcap, ccap = caps[client_count]
         _report(
             paxos_model(cfg)
             .checker()

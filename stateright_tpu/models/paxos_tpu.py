@@ -911,6 +911,11 @@ class PaxosEncoded(EncodedModelBase):
         "accepted", "decided",
     )
 
+    #: Measured max enabled slots per reachable state: 5 (1c), 8 (2c),
+    #: 8 (3c d<=9, 4c d<=7) — 16 gives 2x headroom; the engine detects
+    #: overflow loudly.
+    pair_width_hint = 16
+
     def _sparse_tables(self) -> dict:
         if hasattr(self, "_sp"):
             return self._sp

@@ -35,14 +35,24 @@ Per wave, inside one ``shard_map``-wrapped ``lax.while_loop``:
    ``psum``/``pmin`` reductions: every device agrees on ``done``
    without touching the host.
 
-Shapes are per-shard and fixed (the adaptive class ladders of the
-single-chip engine don't pay for themselves inside shard_map yet —
-multi-chip waves are sized by the workload's peak via the same
-``max_wave_candidates`` metric). On one device the shuffle degenerates
-to the identity and results are state-identical to the single-chip
-engines; tests pin identical results for shard counts 1/2/8 on the
-CPU mesh, with ``track_paths=True`` paths replaying through the host
-model.
+**Adaptive classes (round 4).** Round 3's sharded waves compiled ONE
+worst-case shape, re-importing the flat-wave cost profile whose
+single-chip version caused the round-2 rm=8 cliff. Waves now dispatch
+through the same frontier/visited class ladders as the single-chip
+engine — every shard agrees on the class via ``lax.pmax`` over local
+frontier/unique counts (collectives are collective: the ``lax.switch``
+must take the same branch on every shard) — and the routing sort,
+per-destination tiles, the ``all_to_all`` itself, and the merge all
+scale with the running wave. Encodings implementing
+``SparseEncodedModel`` get sparse action dispatch here too: pairs are
+extracted and stepped shard-locally (the shared pipeline in
+checkers/tpu_sortmerge.py), and only real candidates enter the
+routing sort and the shuffle.
+
+On one device the shuffle degenerates to the identity and results are
+state-identical to the single-chip engines; tests pin identical
+results for shard counts 1/2/8 on the CPU mesh, with
+``track_paths=True`` paths replaying through the host model.
 """
 
 from __future__ import annotations
@@ -81,6 +91,7 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
         waves_per_sync: int = 16,
         cand_capacity: Optional[int] = None,
         bucket_capacity: Optional[int] = None,
+        **kwargs,
     ):
         import jax
         from jax.sharding import Mesh
@@ -108,17 +119,20 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             track_paths=track_paths,
             waves_per_sync=waves_per_sync,
             cand_capacity=cand_capacity,
+            **kwargs,
         )
         self.total_capacity = capacity * self.n_shards
         self.bucket_capacity = bucket_capacity
 
     def _cache_extras(self) -> tuple:
+        # Includes the single-chip extras: the ladder/sparse/tile knobs
+        # shape the compiled sharded program too.
         return (
             "sharded-sortmerge",
             self.n_shards,
             self.bucket_capacity,
             self.mesh,
-        )
+        ) + super()._cache_extras()
 
     def _cand_overflow_message(self) -> str:
         return (
@@ -148,6 +162,14 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
         except ImportError:  # pragma: no cover - older jax
             from jax.experimental.shard_map import shard_map
 
+        from ..checkers.tpu import frontier_props
+        from ..checkers.tpu_sortmerge import (
+            _divisor_at_least,
+            _ladder,
+            sparse_pair_candidates,
+        )
+        from ..encoding import EncodedModelBase
+
         enc = self.encoded
         props = list(self.model.properties())
         n_props = len(props)
@@ -164,15 +186,24 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
         K, W, F = enc.max_actions, enc.width, self.frontier_capacity
         S = self.n_shards
         C = self.capacity
-        B = min(self.cand_capacity or F * K, F * K)
-        if self.bucket_capacity is not None:
-            Bd = min(self.bucket_capacity, B)
-        elif S == 1:
-            Bd = B
-        else:
-            # Near-uniform fingerprint split: 4x the expected share.
-            Bd = min(B, max(128, (4 * B + S - 1) // S))
-        R = S * Bd  # rows received per shard per wave
+        B_user = min(self.cand_capacity or F * K, F * K)
+        use_sparse = self._use_sparse()
+        EV = self._pair_width() if use_sparse else 0
+        sparse_has_trunc = sparse_boundary = False
+        if use_sparse:
+            sparse_has_trunc = isinstance(
+                jax.eval_shape(
+                    enc.step_slot_vec,
+                    jax.ShapeDtypeStruct((W,), jnp.uint32),
+                    jax.ShapeDtypeStruct((), jnp.uint32),
+                ),
+                tuple,
+            )
+            wb = getattr(type(enc), "within_boundary_vec", None)
+            sparse_boundary = (
+                wb is not EncodedModelBase.within_boundary_vec
+                and not getattr(enc, "trivial_boundary", False)
+            )
         if n0 > C:
             raise ValueError(
                 f"per-shard capacity {C} < {n0} init states"
@@ -182,12 +213,41 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
         waves_per_sync = self.waves_per_sync
         ebits_init = self._eventually_bits_init()
         track_paths = self.track_paths
+
+        # Class ladders, agreed across shards per wave via lax.pmax
+        # (collectives are collective: every shard must take the same
+        # lax.switch branch or the all_to_all deadlocks).
+        f_ladder = _ladder(self.f_min, F, self.ladder_step)
+        v_ladder = _ladder(self.v_min, C, self.v_ladder_step)
+
+        def class_params(fc: int):
+            """Static per-frontier-class shapes (per shard)."""
+            F_c = f_ladder[fc]
+            if use_sparse:
+                NPg = F_c * EV
+                B_c = min(B_user, NPg)
+                compaction = NPg > B_c
+                want = -(-NPg // self.tile_rows)
+                NT = _divisor_at_least(F_c, want) if compaction else 1
+                T = F_c // NT
+                R_src = (B_c + T * EV) if compaction else NPg
+            else:
+                NT = T = 1
+                R_src = F_c * K
+                B_c = min(B_user, R_src)
+            if self.bucket_capacity is not None:
+                Bd_c = min(self.bucket_capacity, B_c)
+            elif S == 1:
+                Bd_c = B_c
+            else:
+                # Near-uniform fingerprint split: 4x the expected share.
+                Bd_c = min(B_c, max(128, (4 * B_c + S - 1) // S))
+            return F_c, NT, T, R_src, B_c, Bd_c
+
         # Per-shard parent-log rows: every unique state a shard owns
-        # (≤ C) gets one entry; the append block is F rows (the
-        # next-frontier width), so headroom must cover max(F, R) or a
-        # clamped dynamic_update_slice would silently overwrite earlier
-        # log entries.
-        L = C + max(F, R) if track_paths else 0
+        # (<= C) gets one entry; the append block is F rows (the
+        # next-frontier width).
+        L = C + F if track_paths else 0
         # Payload lanes: state + (parent fp) + ebits + own fp (owners
         # don't re-hash after the shuffle). All-zero fp lanes mark
         # unused bucket slots (fingerprints are never 0).
@@ -212,7 +272,7 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             frontier = jnp.zeros((F, W), dtype=jnp.uint32).at[sp].set(
                 init_rows, mode="drop"
             )
-            n_mine = jnp.sum(mine)
+            n_mine = jnp.sum(mine).astype(jnp.uint32)
             fval = jnp.arange(F) < n_mine
             ebits = jnp.where(fval, jnp.uint32(ebits_init), jnp.uint32(0))
             v_hi = jnp.where(mine, hi0, jnp.uint32(_SENT))
@@ -232,6 +292,8 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                 frontier=frontier,
                 fval=fval,
                 ebits=ebits,
+                n_loc=n_mine.reshape(1),
+                u_loc=n_mine.reshape(1),
                 depth=jnp.int32(1),
                 wchunk=jnp.int32(0),
                 waves=jnp.uint32(0),
@@ -251,255 +313,493 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                 done=jnp.bool_(n0 == 0),
             )
 
-        def body(c):
-            ebits = c["ebits"]
-            fval = c["fval"]
-            me = lax.axis_index("shard").astype(jnp.uint32)
+        def make_merge(c, vc, R_c, recv, n_cand, sent, disc, ovf):
+            """Owner-local sort-merge dedup against visited-prefix
+            class vc (the DashMap-shard role, bfs.rs:28-29, on the
+            TPU-fast path): stable merge with the visited prefix
+            first, so first-of-run wins and intra-wave duplicates
+            resolve for free."""
+            V_v = v_ladder[vc]
+            M = V_v + R_c
+            disc_found, disc_lo, disc_hi = disc
+            overflow0, f_overflow0, c_overflow, e_overflow = ovf
 
-            if target_depth is None:
-                expand = jnp.bool_(True)
-            else:
-                expand = c["depth"] < target_depth
+            def merge(_):
+                r_lo = recv[:, E]
+                r_hi = recv[:, E + 1]
+                r_val = (r_lo != 0) | (r_hi != 0)
+                ck_lo = jnp.where(r_val, r_lo, jnp.uint32(_SENT))
+                ck_hi = jnp.where(r_val, r_hi, jnp.uint32(_SENT))
 
-            ex = expand_frontier(
-                enc, props, evt_idx, c["frontier"], fval, ebits, expand,
-                with_repeats=False,
-            )
-            e_overflow = c["e_overflow"] | bool_any(jnp.any(ex["trunc"]))
-
-            # Discoveries: local per-wave hits, globally folded (the
-            # lowest hitting shard index wins, mirroring whichever
-            # racing thread lands first in the reference).
-            if n_props:
-                hits, los, his = wave_hits(props, ex, fval)
-                ghits = bool_any(hits)
-                pri = jnp.where(hits, me, jnp.uint32(S))
-                winner = lax.pmin(pri, "shard")
-                sel = hits & (pri == winner)
-                g_lo = lax.psum(jnp.where(sel, los, jnp.uint32(0)), "shard")
-                g_hi = lax.psum(jnp.where(sel, his, jnp.uint32(0)), "shard")
-                fresh = ghits & ~c["disc_found"]
-                disc_found = c["disc_found"] | ghits
-                disc_lo = jnp.where(fresh, g_lo, c["disc_lo"])
-                disc_hi = jnp.where(fresh, g_hi, c["disc_hi"])
-            else:
-                disc_found = c["disc_found"]
-                disc_lo = c["disc_lo"]
-                disc_hi = c["disc_hi"]
-
-            flat, valid = ex["flat"], ex["v"]
-            n_cand = jnp.sum(valid).astype(jnp.uint32)
-            k_lo, k_hi = fingerprint_u32v(flat, jnp)
-            k_lo, k_hi = clamp_keys(k_lo, k_hi)
-            owner = jnp.where(
-                valid, k_lo % jnp.uint32(S), jnp.uint32(S)
-            )
-
-            # Route+compact in ONE sort: order by (owner, key); valid
-            # candidates form S contiguous destination runs (invalid
-            # rows carry owner=S and sort last).
-            rows = jnp.arange(F * K, dtype=jnp.uint32)
-            s_owner, s_hi, s_lo, s_row = lax.sort(
-                (owner, k_hi, k_lo, rows), num_keys=3
-            )
-            # s_owner is sorted: all destination-run boundaries in one
-            # searchsorted pass (S scans of the F*K array otherwise).
-            edges = jnp.searchsorted(
-                s_owner, jnp.arange(S + 1, dtype=jnp.uint32)
-            ).astype(jnp.uint32)
-            starts = edges[:-1]
-            counts = edges[1:] - starts
-            # Only the per-destination tile size is a physical limit
-            # here (the routing sort spans the full F*K tensor);
-            # cand_capacity shapes the Bd default above.
-            route_ovf = jnp.any(counts > jnp.uint32(Bd))
-            c_overflow = c["c_overflow"] | bool_any(route_ovf)
-
-            # Payload rows for the send buffer, fetched per destination
-            # run: state lanes, parent fp, ebits, own fp.
-            prow_all = s_row // jnp.uint32(K)
-
-            def dest_tile(d):
-                start = starts[d]
-                cnt = counts[d]
-                live = jnp.arange(Bd, dtype=jnp.uint32) < cnt
-                idx = jnp.clip(
-                    start + jnp.arange(Bd, dtype=jnp.uint32),
-                    0,
-                    jnp.uint32(F * K - 1),
-                )
-                srow = s_row[idx]
-                prow = prow_all[idx]
-                parts = [flat[srow]]
-                if track_paths:
-                    parts += [
-                        ex["f_lo"][prow][:, None],
-                        ex["f_hi"][prow][:, None],
+                m_hi = jnp.concatenate([c["v_hi"][:V_v], ck_hi])
+                m_lo = jnp.concatenate([c["v_lo"][:V_v], ck_lo])
+                m_pos = jnp.concatenate(
+                    [
+                        jnp.zeros(V_v, jnp.uint32),
+                        jnp.arange(1, R_c + 1, dtype=jnp.uint32),
                     ]
-                parts.append(ex["ebits"][prow][:, None])
-                parts += [
-                    jnp.where(live, s_lo[idx], 0)[:, None],
-                    jnp.where(live, s_hi[idx], 0)[:, None],
-                ]
-                tile = jnp.concatenate(parts, axis=1)
-                return jnp.where(live[:, None], tile, jnp.uint32(0))
-
-            send = jnp.concatenate([dest_tile(d) for d in range(S)], axis=0)
-            cross = n_cand - counts[me]
-            g_cross = lax.psum(cross.astype(jnp.uint32), "shard")
-            sent = u64_add(
-                U64(c["sent_lo"], c["sent_hi"]), U64(g_cross, jnp.uint32(0))
-            )
-
-            recv = lax.all_to_all(
-                send, "shard", split_axis=0, concat_axis=0, tiled=True
-            )
-
-            # Owner-local sort-merge dedup (the DashMap-shard role,
-            # bfs.rs:28-29, on the TPU-fast path): stable merge with
-            # the visited prefix first, so first-of-run wins and
-            # intra-wave duplicates resolve for free.
-            r_lo = recv[:, E]
-            r_hi = recv[:, E + 1]
-            r_val = (r_lo != 0) | (r_hi != 0)
-            ck_lo = jnp.where(r_val, r_lo, jnp.uint32(_SENT))
-            ck_hi = jnp.where(r_val, r_hi, jnp.uint32(_SENT))
-
-            m_hi = jnp.concatenate([c["v_hi"], ck_hi])
-            m_lo = jnp.concatenate([c["v_lo"], ck_lo])
-            m_pos = jnp.concatenate(
-                [
-                    jnp.zeros(C, jnp.uint32),
-                    jnp.arange(1, R + 1, dtype=jnp.uint32),
-                ]
-            )
-            m_hi, m_lo, m_pos = lax.sort((m_hi, m_lo, m_pos), num_keys=2)
-            real = ~(
-                (m_hi == jnp.uint32(_SENT)) & (m_lo == jnp.uint32(_SENT))
-            )
-            prev_same = jnp.concatenate(
-                [
-                    jnp.zeros(1, bool),
-                    (m_hi[1:] == m_hi[:-1]) & (m_lo[1:] == m_lo[:-1]),
-                ]
-            )
-            is_new = real & ~prev_same & (m_pos > 0)
-            new_count = jnp.sum(is_new)
-
-            u_hi = jnp.where(prev_same, jnp.uint32(_SENT), m_hi)
-            u_lo = jnp.where(prev_same, jnp.uint32(_SENT), m_lo)
-            u_hi, u_lo = lax.sort((u_hi, u_lo), num_keys=2)
-            overflow = c["overflow"] | bool_any(
-                ~(
-                    (u_hi[C] == jnp.uint32(_SENT))
-                    & (u_lo[C] == jnp.uint32(_SENT))
                 )
-            )
-            v_hi_new, v_lo_new = u_hi[:C], u_lo[:C]
+                m_hi, m_lo, m_pos = lax.sort(
+                    (m_hi, m_lo, m_pos), num_keys=2
+                )
+                real = ~(
+                    (m_hi == jnp.uint32(_SENT))
+                    & (m_lo == jnp.uint32(_SENT))
+                )
+                prev_same = jnp.concatenate(
+                    [
+                        jnp.zeros(1, bool),
+                        (m_hi[1:] == m_hi[:-1])
+                        & (m_lo[1:] == m_lo[:-1]),
+                    ]
+                )
+                is_new = real & ~prev_same & (m_pos > 0)
+                new_count = jnp.sum(is_new)
 
-            nf_pos = jnp.where(is_new, m_pos, jnp.uint32(_SENT))
-            (nf_pos,) = lax.sort((nf_pos,), num_keys=1)
-            if C + R >= F:
-                nf_pos = nf_pos[:F]
-            else:
-                nf_pos = jnp.concatenate(
-                    [nf_pos, jnp.full(F - (C + R), _SENT, jnp.uint32)]
-                )
-            nf_valid = jnp.arange(F) < new_count
-            f_overflow = c["f_overflow"] | bool_any(new_count > F)
-            nf_row = jnp.where(nf_valid, nf_pos - 1, jnp.uint32(0))
-            next_fe = recv[nf_row]
-            next_frontier = jnp.where(
-                nf_valid[:, None], next_fe[:, :W], jnp.uint32(0)
-            )
-            next_ebits = jnp.where(nf_valid, next_fe[:, EB], 0)
+                u_hi = jnp.where(prev_same, jnp.uint32(_SENT), m_hi)
+                u_lo = jnp.where(prev_same, jnp.uint32(_SENT), m_lo)
+                u_hi, u_lo = lax.sort((u_hi, u_lo), num_keys=2)
+                if M <= C:
+                    v_hi_new = lax.dynamic_update_slice(
+                        c["v_hi"], u_hi, (0,)
+                    )
+                    v_lo_new = lax.dynamic_update_slice(
+                        c["v_lo"], u_lo, (0,)
+                    )
+                    overflow = overflow0
+                else:
+                    overflow = overflow0 | bool_any(
+                        ~(
+                            (u_hi[C] == jnp.uint32(_SENT))
+                            & (u_lo[C] == jnp.uint32(_SENT))
+                        )
+                    )
+                    v_hi_new, v_lo_new = u_hi[:C], u_lo[:C]
 
-            if track_paths:
-                nc_lo = jnp.where(nf_valid, next_fe[:, E], 0)
-                nc_hi = jnp.where(nf_valid, next_fe[:, E + 1], 0)
-                np_lo = jnp.where(nf_valid, next_fe[:, W], 0)
-                np_hi = jnp.where(nf_valid, next_fe[:, W + 1], 0)
-                off = (c["pl_n"][0],)
-                pl_child_lo = lax.dynamic_update_slice(
-                    c["pl_child_lo"], nc_lo, off
+                nf_pos = jnp.where(is_new, m_pos, jnp.uint32(_SENT))
+                (nf_pos,) = lax.sort((nf_pos,), num_keys=1)
+                if M >= F:
+                    nf_pos = nf_pos[:F]
+                else:
+                    nf_pos = jnp.concatenate(
+                        [nf_pos, jnp.full(F - M, _SENT, jnp.uint32)]
+                    )
+                nf_valid = jnp.arange(F) < new_count
+                f_overflow = f_overflow0 | bool_any(new_count > F)
+                nf_row = jnp.where(nf_valid, nf_pos - 1, jnp.uint32(0))
+                next_fe = recv[nf_row]
+                next_frontier = jnp.where(
+                    nf_valid[:, None], next_fe[:, :W], jnp.uint32(0)
                 )
-                pl_child_hi = lax.dynamic_update_slice(
-                    c["pl_child_hi"], nc_hi, off
-                )
-                pl_par_lo = lax.dynamic_update_slice(
-                    c["pl_par_lo"], np_lo, off
-                )
-                pl_par_hi = lax.dynamic_update_slice(
-                    c["pl_par_hi"], np_hi, off
-                )
-                # Clamp to the F rows the block write actually wrote
-                # (on an f_overflow wave new_count can exceed F; _run
-                # raises before reconstruction, but the live-count
-                # invariant should hold regardless).
-                pl_n = c["pl_n"] + jnp.minimum(
-                    new_count.astype(jnp.uint32), jnp.uint32(F)
-                )
-            else:
-                pl_child_lo = c["pl_child_lo"]
-                pl_child_hi = c["pl_child_hi"]
-                pl_par_lo = c["pl_par_lo"]
-                pl_par_hi = c["pl_par_hi"]
-                pl_n = c["pl_n"]
+                next_ebits = jnp.where(nf_valid, next_fe[:, EB], 0)
 
-            g_new = lax.psum(new_count.astype(jnp.uint32), "shard")
-            g_cand = lax.psum(n_cand, "shard")
-            g = u64_add(
-                U64(c["gen_lo"], c["gen_hi"]), U64(g_cand, jnp.uint32(0))
-            )
-            new = c["new"] + g_new
-            max_cand = jnp.maximum(
-                c["max_cand"], lax.pmax(n_cand, "shard")
-            )
+                if track_paths:
+                    nc_lo = jnp.where(nf_valid, next_fe[:, E], 0)
+                    nc_hi = jnp.where(nf_valid, next_fe[:, E + 1], 0)
+                    np_lo = jnp.where(nf_valid, next_fe[:, W], 0)
+                    np_hi = jnp.where(nf_valid, next_fe[:, W + 1], 0)
+                    off = (c["pl_n"][0],)
+                    pl_child_lo = lax.dynamic_update_slice(
+                        c["pl_child_lo"], nc_lo, off
+                    )
+                    pl_child_hi = lax.dynamic_update_slice(
+                        c["pl_child_hi"], nc_hi, off
+                    )
+                    pl_par_lo = lax.dynamic_update_slice(
+                        c["pl_par_lo"], np_lo, off
+                    )
+                    pl_par_hi = lax.dynamic_update_slice(
+                        c["pl_par_hi"], np_hi, off
+                    )
+                    # Clamp to the F rows the block write actually
+                    # wrote (on an f_overflow wave new_count can
+                    # exceed F; _run raises before reconstruction, but
+                    # the live-count invariant should hold regardless).
+                    pl_n = c["pl_n"] + jnp.minimum(
+                        new_count.astype(jnp.uint32), jnp.uint32(F)
+                    )
+                else:
+                    pl_child_lo = c["pl_child_lo"]
+                    pl_child_hi = c["pl_child_hi"]
+                    pl_par_lo = c["pl_par_lo"]
+                    pl_par_hi = c["pl_par_hi"]
+                    pl_n = c["pl_n"]
 
-            all_disc = (
-                jnp.all(disc_found) if n_props else jnp.bool_(False)
-            )
-            if target_states is None:
-                target_hit = jnp.bool_(False)
-            else:
-                target_hit = new >= jnp.uint32(target_states)
-            cont = (
-                (g_new > 0)
-                & ~all_disc
-                & ~target_hit
-                & ~overflow
-                & ~f_overflow
-                & ~c_overflow
-                & ~e_overflow
-            )
-            return dict(
-                v_lo=v_lo_new,
-                v_hi=v_hi_new,
-                pl_child_lo=pl_child_lo,
-                pl_child_hi=pl_child_hi,
-                pl_par_lo=pl_par_lo,
-                pl_par_hi=pl_par_hi,
-                pl_n=pl_n,
-                frontier=next_frontier,
-                fval=nf_valid & cont,
-                ebits=next_ebits,
-                depth=jnp.where(cont, c["depth"] + 1, c["depth"]),
-                wchunk=c["wchunk"] + 1,
-                waves=c["waves"] + 1,
-                gen_lo=g.lo,
-                gen_hi=g.hi,
-                new=new,
-                sent_lo=sent.lo,
-                sent_hi=sent.hi,
-                max_cand=max_cand,
-                disc_found=disc_found,
-                disc_lo=disc_lo,
-                disc_hi=disc_hi,
-                overflow=overflow,
-                f_overflow=f_overflow,
-                c_overflow=c_overflow,
-                e_overflow=e_overflow,
-                done=~cont,
+                g_new = lax.psum(new_count.astype(jnp.uint32), "shard")
+                g_cand = lax.psum(n_cand, "shard")
+                g = u64_add(
+                    U64(c["gen_lo"], c["gen_hi"]),
+                    U64(g_cand, jnp.uint32(0)),
+                )
+                new = c["new"] + g_new
+                max_cand = jnp.maximum(
+                    c["max_cand"], lax.pmax(n_cand, "shard")
+                )
+
+                all_disc = (
+                    jnp.all(disc_found) if n_props else jnp.bool_(False)
+                )
+                if target_states is None:
+                    target_hit = jnp.bool_(False)
+                else:
+                    target_hit = new >= jnp.uint32(target_states)
+                cont = (
+                    (g_new > 0)
+                    & ~all_disc
+                    & ~target_hit
+                    & ~overflow
+                    & ~f_overflow
+                    & ~c_overflow
+                    & ~e_overflow
+                )
+                nc_u32 = new_count.astype(jnp.uint32)
+                return dict(
+                    v_lo=v_lo_new,
+                    v_hi=v_hi_new,
+                    pl_child_lo=pl_child_lo,
+                    pl_child_hi=pl_child_hi,
+                    pl_par_lo=pl_par_lo,
+                    pl_par_hi=pl_par_hi,
+                    pl_n=pl_n,
+                    frontier=next_frontier,
+                    fval=nf_valid & cont,
+                    ebits=next_ebits,
+                    n_loc=jnp.where(
+                        cont, nc_u32, jnp.uint32(0)
+                    ).reshape(1),
+                    u_loc=c["u_loc"] + nc_u32,
+                    depth=jnp.where(cont, c["depth"] + 1, c["depth"]),
+                    wchunk=c["wchunk"] + 1,
+                    waves=c["waves"] + 1,
+                    gen_lo=g.lo,
+                    gen_hi=g.hi,
+                    new=new,
+                    sent_lo=sent.lo,
+                    sent_hi=sent.hi,
+                    max_cand=max_cand,
+                    disc_found=disc_found,
+                    disc_lo=disc_lo,
+                    disc_hi=disc_hi,
+                    overflow=overflow,
+                    f_overflow=f_overflow,
+                    c_overflow=c_overflow,
+                    e_overflow=e_overflow,
+                    done=~cont,
+                )
+
+            return merge
+
+        def make_wave(fc: int, v_class):
+            F_c, NT, T, R_src, B_c, Bd_c = class_params(fc)
+            R_c = S * Bd_c
+
+            def wave(c):
+                frontier_c = c["frontier"][:F_c]
+                fval_c = c["fval"][:F_c]
+                ebits_c = c["ebits"][:F_c]
+                me = lax.axis_index("shard").astype(jnp.uint32)
+
+                if target_depth is None:
+                    expand = jnp.bool_(True)
+                else:
+                    expand = c["depth"] < target_depth
+
+                e_overflow = c["e_overflow"]
+                c_overflow = c["c_overflow"]
+
+                if use_sparse:
+                    # Sparse action dispatch, shard-local: the shared
+                    # pair pipeline (checkers/tpu_sortmerge.py), then
+                    # per-pair transitions — only real candidates
+                    # enter the routing sort and the shuffle.
+                    cond, eb, fp_lo, fp_hi = frontier_props(
+                        enc, props, evt_idx, frontier_c, fval_c,
+                        ebits_c,
+                    )
+                    (
+                        pidx, live, pslot, cnt, n_pairs, pair_ovf, _tm,
+                    ) = sparse_pair_candidates(
+                        enc, frontier_c, fval_c, expand,
+                        EV=EV, B_p=B_c, NT=NT, T=T,
+                        mask_budget_cells=self.mask_budget_cells,
+                        Ba=R_src, axis_name="shard",
+                    )
+                    c_overflow = c_overflow | bool_any(pair_ovf)
+                    prow = pidx // jnp.uint32(EV)
+                    needs_scan = sparse_boundary or sparse_has_trunc
+
+                    def step_pairs(st, sl):
+                        res = jax.vmap(enc.step_slot_vec)(st, sl)
+                        return (
+                            res if sparse_has_trunc else (res, None)
+                        )
+
+                    def eval_pairs(pidx_b, live_b, slot_b):
+                        prow_b = pidx_b // jnp.uint32(EV)
+                        succ_b, ptr_b = step_pairs(
+                            frontier_c[prow_b], slot_b
+                        )
+                        if sparse_boundary:
+                            inb = jax.vmap(enc.within_boundary_vec)(
+                                succ_b
+                            )
+                            ok = live_b & inb
+                        else:
+                            ok = live_b
+                        if ptr_b is not None:
+                            eov = jnp.any(ok & ptr_b)
+                            ok = ok & ~ptr_b
+                        else:
+                            eov = jnp.bool_(False)
+                        lo, hi = fingerprint_u32v(succ_b, jnp)
+                        lo, hi = clamp_keys(lo, hi)
+                        return succ_b, lo, hi, ok, prow_b, eov
+
+                    # Memory-lean mode (mirrors the single-chip chunked
+                    # path): when the [R_src, W] successor tensor would
+                    # blow the flat budget, fingerprint pairs in chunks
+                    # and RECOMPUTE the routed tiles' successors inside
+                    # dest_tile (step_slot purity makes this exact).
+                    chunked = R_src * W * 4 > self.flat_budget_bytes
+                    if chunked:
+                        NC = -(-(R_src * W * 4) // self.flat_budget_bytes)
+                        Bc = -(-R_src // NC)
+                        pad = NC * Bc - R_src
+                        pidx_p = jnp.pad(pidx, (0, pad))
+                        live_p = jnp.pad(live, (0, pad))
+                        pslot_p = jnp.pad(pslot, (0, pad))
+
+                        def fchunk(ti, acc):
+                            kl, kh, pok, nc, eov, rok = acc
+                            off = ti * Bc
+                            pb = lax.dynamic_slice(pidx_p, (off,), (Bc,))
+                            lb = lax.dynamic_slice(live_p, (off,), (Bc,))
+                            sb = lax.dynamic_slice(
+                                pslot_p, (off,), (Bc,)
+                            )
+                            _, lo, hi, ok, prow_b, ev = eval_pairs(
+                                pb, lb, sb
+                            )
+                            kl = lax.dynamic_update_slice(kl, lo, (off,))
+                            kh = lax.dynamic_update_slice(kh, hi, (off,))
+                            pok = lax.dynamic_update_slice(
+                                pok, ok, (off,)
+                            )
+                            if needs_scan:
+                                nc = nc + jnp.sum(ok, dtype=jnp.uint32)
+                                rok = rok.at[
+                                    jnp.where(
+                                        ok, prow_b, jnp.uint32(F_c)
+                                    )
+                                ].max(jnp.uint32(1), mode="drop")
+                            return kl, kh, pok, nc, eov | ev, rok
+
+                        def pv(x):
+                            return lax.pvary(x, "shard")
+
+                        kl, kh, pok, nc_acc, eov_acc, row_ok = (
+                            lax.fori_loop(
+                                0,
+                                NC,
+                                fchunk,
+                                (
+                                    pv(jnp.zeros(NC * Bc, jnp.uint32)),
+                                    pv(jnp.zeros(NC * Bc, jnp.uint32)),
+                                    pv(jnp.zeros(NC * Bc, bool)),
+                                    pv(jnp.uint32(0)),
+                                    pv(jnp.bool_(False)),
+                                    pv(jnp.zeros(
+                                        F_c if needs_scan else 1,
+                                        jnp.uint32,
+                                    )),
+                                ),
+                            )
+                        )
+                        k_lo = kl[:R_src]
+                        k_hi = kh[:R_src]
+                        pair_ok = pok[:R_src]
+                        e_overflow = e_overflow | bool_any(eov_acc)
+                        if needs_scan:
+                            has_succ = row_ok != 0
+                            n_cand = nc_acc
+                        else:
+                            has_succ = cnt > 0
+                            n_cand = n_pairs
+                        cand_state = None  # recomputed per dest_tile
+                    else:
+                        succ, k_lo, k_hi, pair_ok, _, eov = eval_pairs(
+                            pidx, live, pslot
+                        )
+                        e_overflow = e_overflow | bool_any(eov)
+                        if needs_scan:
+                            row_ok = jnp.zeros(F_c, jnp.uint32).at[
+                                jnp.where(
+                                    pair_ok, prow, jnp.uint32(F_c)
+                                )
+                            ].max(jnp.uint32(1), mode="drop")
+                            has_succ = row_ok != 0
+                            n_cand = jnp.sum(pair_ok, dtype=jnp.uint32)
+                        else:
+                            has_succ = cnt > 0
+                            n_cand = n_pairs
+                        cand_state = succ
+                    terminal = fval_c & ~has_succ & expand
+                    evt_cex = terminal & (eb != 0)
+                    ex = dict(
+                        cond=cond, ebits=eb, evt_cex=evt_cex,
+                        f_lo=fp_lo, f_hi=fp_hi,
+                    )
+                    cand_valid = pair_ok
+                    cand_par = prow
+
+                    def cand_rows(srow):
+                        if cand_state is not None:
+                            return cand_state[srow]
+                        succ_t, _ = step_pairs(
+                            frontier_c[cand_par[srow]], pslot[srow]
+                        )
+                        return succ_t
+                else:
+                    ex = expand_frontier(
+                        enc, props, evt_idx, frontier_c, fval_c,
+                        ebits_c, expand, with_repeats=False,
+                    )
+                    e_overflow = e_overflow | bool_any(
+                        jnp.any(ex["trunc"])
+                    )
+                    cand_state, cand_valid = ex["flat"], ex["v"]
+                    n_cand = jnp.sum(cand_valid).astype(jnp.uint32)
+                    k_lo, k_hi = fingerprint_u32v(cand_state, jnp)
+                    k_lo, k_hi = clamp_keys(k_lo, k_hi)
+                    cand_par = None  # parent row = candidate // K
+
+                    def cand_rows(srow):
+                        return cand_state[srow]
+
+                # Discoveries: local per-wave hits, globally folded
+                # (the lowest hitting shard index wins, mirroring
+                # whichever racing thread lands first in the
+                # reference).
+                if n_props:
+                    hits, los, his = wave_hits(props, ex, fval_c)
+                    ghits = bool_any(hits)
+                    pri = jnp.where(hits, me, jnp.uint32(S))
+                    winner = lax.pmin(pri, "shard")
+                    sel = hits & (pri == winner)
+                    g_lo = lax.psum(
+                        jnp.where(sel, los, jnp.uint32(0)), "shard"
+                    )
+                    g_hi = lax.psum(
+                        jnp.where(sel, his, jnp.uint32(0)), "shard"
+                    )
+                    fresh = ghits & ~c["disc_found"]
+                    disc_found = c["disc_found"] | ghits
+                    disc_lo = jnp.where(fresh, g_lo, c["disc_lo"])
+                    disc_hi = jnp.where(fresh, g_hi, c["disc_hi"])
+                else:
+                    disc_found = c["disc_found"]
+                    disc_lo = c["disc_lo"]
+                    disc_hi = c["disc_hi"]
+
+                owner = jnp.where(
+                    cand_valid, k_lo % jnp.uint32(S), jnp.uint32(S)
+                )
+
+                # Route+compact in ONE sort: order by (owner, key);
+                # valid candidates form S contiguous destination runs
+                # (invalid rows carry owner=S and sort last).
+                rows = jnp.arange(R_src, dtype=jnp.uint32)
+                s_owner, s_hi, s_lo, s_row = lax.sort(
+                    (owner, k_hi, k_lo, rows), num_keys=3
+                )
+                # s_owner is sorted: all destination-run boundaries in
+                # one searchsorted pass.
+                edges = jnp.searchsorted(
+                    s_owner, jnp.arange(S + 1, dtype=jnp.uint32)
+                ).astype(jnp.uint32)
+                starts = edges[:-1]
+                counts = edges[1:] - starts
+                route_ovf = jnp.any(counts > jnp.uint32(Bd_c))
+                c_overflow = c_overflow | bool_any(route_ovf)
+
+                def dest_tile(d):
+                    start = starts[d]
+                    cnt_d = counts[d]
+                    live_d = jnp.arange(Bd_c, dtype=jnp.uint32) < cnt_d
+                    idx = jnp.clip(
+                        start + jnp.arange(Bd_c, dtype=jnp.uint32),
+                        0,
+                        jnp.uint32(R_src - 1),
+                    )
+                    srow = s_row[idx]
+                    if cand_par is None:
+                        par = srow // jnp.uint32(K)
+                    else:
+                        par = cand_par[srow]
+                    parts = [cand_rows(srow)]
+                    if track_paths:
+                        parts += [
+                            ex["f_lo"][par][:, None],
+                            ex["f_hi"][par][:, None],
+                        ]
+                    parts.append(ex["ebits"][par][:, None])
+                    parts += [
+                        jnp.where(live_d, s_lo[idx], 0)[:, None],
+                        jnp.where(live_d, s_hi[idx], 0)[:, None],
+                    ]
+                    tile = jnp.concatenate(parts, axis=1)
+                    return jnp.where(
+                        live_d[:, None], tile, jnp.uint32(0)
+                    )
+
+                send = jnp.concatenate(
+                    [dest_tile(d) for d in range(S)], axis=0
+                )
+                cross = n_cand - counts[me]
+                g_cross = lax.psum(cross.astype(jnp.uint32), "shard")
+                sent = u64_add(
+                    U64(c["sent_lo"], c["sent_hi"]),
+                    U64(g_cross, jnp.uint32(0)),
+                )
+
+                recv = lax.all_to_all(
+                    send, "shard", split_axis=0, concat_axis=0,
+                    tiled=True,
+                )
+
+                return lax.switch(
+                    v_class,
+                    [
+                        make_merge(
+                            c, vc, R_c, recv, n_cand, sent,
+                            (disc_found, disc_lo, disc_hi),
+                            (c["overflow"], c["f_overflow"],
+                             c_overflow, e_overflow),
+                        )
+                        for vc in range(len(v_ladder))
+                    ],
+                    0,
+                )
+
+            return wave
+
+        def body(c):
+            n_max = lax.pmax(c["n_loc"][0], "shard")
+            u_max = lax.pmax(c["u_loc"][0], "shard")
+            f_class = jnp.int32(0)
+            for F_i in f_ladder[:-1]:
+                f_class = f_class + (
+                    n_max > jnp.uint32(F_i)
+                ).astype(jnp.int32)
+            v_class = jnp.int32(0)
+            for V_i in v_ladder[:-1]:
+                v_class = v_class + (
+                    u_max > jnp.uint32(V_i)
+                ).astype(jnp.int32)
+            return lax.switch(
+                f_class,
+                [make_wave(fc, v_class) for fc in range(len(f_ladder))],
+                c,
             )
 
         def cond(c):
@@ -551,6 +851,8 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             frontier=P("shard", None),
             fval=P_shard,
             ebits=P_shard,
+            n_loc=P_shard,
+            u_loc=P_shard,
             depth=P(),
             wchunk=P(),
             waves=P(),

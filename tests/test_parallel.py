@@ -238,3 +238,64 @@ def test_sharded_sortmerge_matches_host(shards):
     for name, path in c.discoveries().items():
         prop = c.model.property_by_name(name)
         assert prop.condition(c.model, path.last_state())
+
+
+def test_sharded_sparse_paxos_with_paths():
+    """Sparse action dispatch through the SHARDED engine (round 4):
+    the pair pipeline runs shard-locally and only real candidates
+    enter the routing sort and the all_to_all. Counts, property set,
+    and replayed paths match the host across shard counts, and the
+    class ladders engage (f_min below the frontier capacity)."""
+    from stateright_tpu.models.paxos import PaxosModelCfg, paxos_model
+
+    model = paxos_model(PaxosModelCfg(client_count=1, server_count=3))
+    host = model.checker().spawn_bfs().join()
+    for shards in (1, 2):
+        ck = (
+            paxos_model(PaxosModelCfg(client_count=1, server_count=3))
+            .checker()
+            .spawn_tpu_sharded_sortmerge(
+                n_shards=shards,
+                capacity=1 << 10,
+                frontier_capacity=1 << 7,
+                cand_capacity=1 << 9,
+                pair_width=16,
+                f_min=32,       # exercise the frontier ladder
+                v_min=128,      # exercise the visited ladder
+                ladder_step=2,
+                v_ladder_step=4,
+            )
+            .join()
+        )
+        assert ck.unique_state_count() == 265
+        assert sorted(ck.discoveries()) == sorted(host.discoveries())
+        p = ck.discovery("value chosen")
+        assert p is not None and len(p.actions()) >= 1
+
+
+def test_sharded_sparse_chunked_mode_matches():
+    """The sharded memory-lean chunked sparse path (successors
+    fingerprinted in chunks, routed tiles recomputed in dest_tile) —
+    forced via a tiny flat budget — matches the host with replayable
+    paths across 2 shards."""
+    from stateright_tpu.models.paxos import PaxosModelCfg, paxos_model
+
+    model = paxos_model(PaxosModelCfg(client_count=1, server_count=3))
+    host = model.checker().spawn_bfs().join()
+    ck = (
+        paxos_model(PaxosModelCfg(client_count=1, server_count=3))
+        .checker()
+        .spawn_tpu_sharded_sortmerge(
+            n_shards=2,
+            capacity=1 << 10,
+            frontier_capacity=1 << 7,
+            cand_capacity=1 << 9,
+            pair_width=16,
+            flat_budget_bytes=1 << 10,
+        )
+        .join()
+    )
+    assert ck.unique_state_count() == 265
+    assert sorted(ck.discoveries()) == sorted(host.discoveries())
+    p = ck.discovery("value chosen")
+    assert p is not None and len(p.actions()) >= 1

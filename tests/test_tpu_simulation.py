@@ -63,3 +63,40 @@ def test_tpu_simulation_reproducible():
         .join()
     )
     assert a.discovery_fingerprints() == b.discovery_fingerprints()
+
+
+def test_tpu_simulation_discovery_paths_replay():
+    """discoveries() returns REAL paths (VERDICT r3 #9): the frozen
+    per-walk fingerprint trace replays through the host model, and the
+    path's last state witnesses the discovery."""
+    from stateright_tpu.model import Expectation
+
+    model = Increment(thread_count=3)
+    sim = (
+        Increment(thread_count=3)
+        .checker()
+        .spawn_tpu_simulation(n_walks=256, max_steps=16, rounds=2)
+        .join()
+    )
+    paths = sim.discoveries()
+    assert "fin" in paths
+    p = paths["fin"]
+    assert len(p.actions()) >= 1
+    prop = model.property_by_name("fin")
+    assert prop.expectation == Expectation.ALWAYS
+    assert not prop.condition(model, p.last_state())
+
+
+def test_tpu_simulation_fast_mode_refuses_paths():
+    sim = (
+        Increment(thread_count=3)
+        .checker()
+        .spawn_tpu_simulation(
+            n_walks=256, max_steps=16, rounds=2, track_paths=False
+        )
+        .join()
+    )
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="track_paths"):
+        sim.discoveries()

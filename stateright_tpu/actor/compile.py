@@ -49,8 +49,14 @@ at compile time, over component domains — never on device.
 
 **Limits** (explicit, checked):
 
-* Ordered (FIFO) networks are not yet compiled — use the hand-encoding
-  path or host checkers.
+* Ordered (FIFO) networks compile in ``closure="reachable"`` mode only
+  (queue-length bounds are harvested from the host exploration), and
+  lossy ordered networks are rejected (the reference drops arbitrary
+  flow positions, which the head-only queue encoding cannot express).
+  Channels encode as INTEGER QUEUES — base-(alphabet+1) numbers, head
+  at the least-significant digit; pop is a divide, push adds
+  ``code*base^len`` (network.rs:67, 221-244 semantics, including the
+  no-op-delivery exception of model.rs:317-319).
 * Component domains must close finitely; systems whose local closure
   diverges under overapproximation (e.g. paxos ballots, which are
   bounded only by *system*-level reachability) exceed ``max_domain``
@@ -256,11 +262,23 @@ class CompiledActorEncoding(EncodedModelBase):
     ):
         if closure_mode not in ("overapprox", "reachable"):
             raise ValueError(f"unknown closure mode {closure_mode!r}")
-        if isinstance(model._init_network, Ordered):
-            raise ValueError(
-                "compile_actor_model does not yet support ordered (FIFO) "
-                "networks; use the host checkers or a hand encoding"
-            )
+        self.ordered = isinstance(model._init_network, Ordered)
+        if self.ordered:
+            # FIFO queue lengths are bounded only by system-level
+            # reachability (like ABD timestamps): harvest the bound.
+            if closure_mode != "reachable":
+                raise ValueError(
+                    "ordered (FIFO) networks compile in "
+                    'closure="reachable" mode only (queue-length bounds '
+                    "are harvested from the host exploration)"
+                )
+            if model.lossy_network:
+                raise ValueError(
+                    "lossy ordered networks are not compiled yet (the "
+                    "reference drops arbitrary flow positions, which "
+                    "breaks the head-only queue encoding); use the host "
+                    "checkers"
+                )
         self.model = model
         self.host_model = model
         self.n = len(model.actors)
@@ -559,23 +577,40 @@ class CompiledActorEncoding(EncodedModelBase):
         the same space: only harvested pairs are ever gathered."""
         seen = {init}
         queue = deque([init])
+        #: ordered only: per-channel max observed queue length
+        self._q_bound: dict = {}
         while queue:
             st = queue.popleft()
             for i, s in enumerate(st.actor_states):
                 add_actor_state(i, s)
             for env in set(st.network.iter_all()):
                 add_envelope(env)
+            if self.ordered:
+                for ch, flow in st.network.flows.items():
+                    self._q_bound[ch] = max(
+                        self._q_bound.get(ch, 0), len(flow)
+                    )
             for i, timers in enumerate(st.timers_set):
                 for t in timers:
                     add_timer(i, t)
             add_history(st.history)
+            prev_channel = None
             for env in st.network.iter_deliverable():
                 i = int(env.dst)
-                if i < self.n and not st.crashed[i]:
-                    run_msg(i, st.actor_states[i], env)
-                    tr = self._msg_tr[(i, st.actor_states[i], env)]
-                    if not tr[1]:
-                        run_history(st.history, env, tr[2])
+                if i >= self.n or st.crashed[i]:
+                    continue
+                if self.ordered:
+                    # FIFO: only channel heads are deliverable, and a
+                    # no-op handler still pops the queue and records
+                    # history (model.rs:252-266, 317-319 exception).
+                    channel = (env.src, env.dst)
+                    if prev_channel == channel:
+                        continue
+                    prev_channel = channel
+                run_msg(i, st.actor_states[i], env)
+                tr = self._msg_tr[(i, st.actor_states[i], env)]
+                if self.ordered or not tr[1]:
+                    run_history(st.history, env, tr[2])
             for i, timers in enumerate(st.timers_set):
                 for t in timers:
                     run_timeout(i, st.actor_states[i], t)
@@ -614,10 +649,12 @@ class CompiledActorEncoding(EncodedModelBase):
         return tuple(sends), tmap
 
     def _effect_classes(self) -> list:
-        """Distinct (env_in | None, sends) history-event signatures."""
+        """Distinct (env_in | None, sends) history-event signatures.
+        Ordered networks record history on NO-OP deliveries too (the
+        pop itself is the transition; model.rs:317-319 exception)."""
         seen = {}
         for (i, s, env), (s2, noop, sends, tmap) in self._msg_tr.items():
-            if not noop:
+            if self.ordered or not noop:
                 seen.setdefault((env, sends), None)
         for (i, s, t), (s2, noop, sends, tmap) in self._tmo_tr.items():
             if not noop:
@@ -635,23 +672,71 @@ class CompiledActorEncoding(EncodedModelBase):
         self.f_timer = [
             [lb.add(1) for _ in self.T[i]] for i in range(self.n)
         ]
-        # Network: 1 bit per envelope (duplicating set) or an 8-bit
-        # count per envelope (non-duplicating multiset).
-        bits = 1 if self.dup else 8
-        self.f_net = [lb.add(bits) for _ in self.E]
-        self.width = lb.width
-        # Per-lane mask of every count field's TOP bit: a successor
-        # with any count ≥ 128 is treated as beyond an implicit bound
-        # and pruned (valid=False) rather than risking a carry into
-        # the neighboring field — the device-side counterpart of
-        # encode()'s loud 8-bit check. Closure-bounded systems stay
-        # far below this.
-        self._net_top_mask = np.zeros(self.width, np.uint32)
-        if not self.dup:
-            for f in self.f_net:
-                self._net_top_mask[f.lane] |= np.uint32(
-                    1 << (f.shift + bits - 1)
+        if self.ordered:
+            # FIFO channels as INTEGER QUEUES: channel (src, dst) with
+            # message alphabet A holds its queue as a base-(|A|+1)
+            # number, head = least-significant digit (digit 0 = empty
+            # slot, codes 1..|A|). Canonical by construction (one
+            # integer per queue content), pop = divide by base, push =
+            # add code*base^len — no ring pointers, no shifting
+            # (encoding.py's "FIFO channels become fixed rings" design,
+            # realized arithmetically).
+            chans: dict = {}
+            for env in self.E:
+                chans.setdefault((env.src, env.dst), []).append(env)
+            self.channels = sorted(chans, key=lambda c: (int(c[0]),
+                                                         int(c[1])))
+            self.chidx = {c: k for k, c in enumerate(self.channels)}
+            #: per channel: sorted message list and msg -> 1-based code
+            self.ch_msgs = {}
+            self.ch_code = {}
+            for ch, envs in chans.items():
+                msgs = sorted(
+                    {e.msg for e in envs}, key=_domain_sort_key
                 )
+                self.ch_msgs[ch] = msgs
+                self.ch_code[ch] = {m: j + 1 for j, m in enumerate(msgs)}
+            #: per channel: harvested queue-length bound and base
+            self.ch_q = {
+                ch: max(1, self._q_bound.get(ch, 0))
+                for ch in self.channels
+            }
+            self.ch_base = {
+                ch: len(self.ch_msgs[ch]) + 1 for ch in self.channels
+            }
+            self.f_ch = []
+            for ch in self.channels:
+                span = self.ch_base[ch] ** self.ch_q[ch]
+                bits = max(1, (span - 1).bit_length())
+                if bits > 32:
+                    raise ValueError(
+                        f"ordered channel {ch} needs {bits} queue bits "
+                        f"(alphabet {len(self.ch_msgs[ch])}, max depth "
+                        f"{self.ch_q[ch]}) — exceeds one uint32 lane; "
+                        "bound the model or use the host checkers"
+                    )
+                self.f_ch.append(lb.add(bits))
+            self.f_net = []
+            self.width = lb.width
+            self._net_top_mask = np.zeros(self.width, np.uint32)
+        else:
+            # Network: 1 bit per envelope (duplicating set) or an 8-bit
+            # count per envelope (non-duplicating multiset).
+            bits = 1 if self.dup else 8
+            self.f_net = [lb.add(bits) for _ in self.E]
+            self.width = lb.width
+            # Per-lane mask of every count field's TOP bit: a successor
+            # with any count ≥ 128 is treated as beyond an implicit
+            # bound and pruned (valid=False) rather than risking a
+            # carry into the neighboring field — the device-side
+            # counterpart of encode()'s loud 8-bit check.
+            # Closure-bounded systems stay far below this.
+            self._net_top_mask = np.zeros(self.width, np.uint32)
+            if not self.dup:
+                for f in self.f_net:
+                    self._net_top_mask[f.lane] |= np.uint32(
+                        1 << (f.shift + bits - 1)
+                    )
 
         # Action slots: delivers, drops, timeouts, crashes.
         self.deliver_slots = [
@@ -675,25 +760,35 @@ class CompiledActorEncoding(EncodedModelBase):
 
     # -- tables ----------------------------------------------------------
 
-    def _tr_effects(self, i: int, tr, fired_timer=None):
+    def _tr_effects(self, i: int, tr, fired_timer=None, force=False):
         """(next_state_idx, noop, net_delta[W], timer_and[W], timer_or[W],
-        hclass) for one transition record."""
+        snd_ch[SMAX], snd_code[SMAX]) for one transition record.
+        ``force`` applies the effects even for a no-op (ordered
+        deliveries: the queue pop is a transition regardless)."""
         s2, noop, sends, tmap = tr
-        next_idx = self.sidx[i][s2] if not noop else 0
+        apply = force or not noop
+        next_idx = self.sidx[i][s2] if apply else 0
         net_delta = np.zeros(self.width, np.uint32)
-        if not noop:
-            for env in sends:
-                f = self.f_net[self.eidx[env]]
-                if self.dup:
-                    net_delta[f.lane] |= np.uint32(1 << f.shift)
+        snd_ch = np.zeros(self._smax, np.uint32)
+        snd_code = np.zeros(self._smax, np.uint32)
+        if apply:
+            for j, env in enumerate(sends):
+                if self.ordered:
+                    ch = (env.src, env.dst)
+                    snd_ch[j] = self.chidx[ch]
+                    snd_code[j] = self.ch_code[ch][env.msg]
                 else:
-                    net_delta[f.lane] += np.uint32(1 << f.shift)
+                    f = self.f_net[self.eidx[env]]
+                    if self.dup:
+                        net_delta[f.lane] |= np.uint32(1 << f.shift)
+                    else:
+                        net_delta[f.lane] += np.uint32(1 << f.shift)
         t_and = np.full(self.width, 0xFFFFFFFF, np.uint32)
         t_or = np.zeros(self.width, np.uint32)
         if fired_timer is not None:
             f = self.f_timer[i][self.tidx[i][fired_timer]]
             t_and[f.lane] &= ~np.uint32(1 << f.shift)
-        if not noop:
+        if apply:
             for t, armed in tmap.items():
                 f = self.f_timer[i][self.tidx[i][t]]
                 if armed:
@@ -702,12 +797,22 @@ class CompiledActorEncoding(EncodedModelBase):
                 else:
                     t_and[f.lane] &= ~np.uint32(1 << f.shift)
                     t_or[f.lane] &= ~np.uint32(1 << f.shift)
-        return next_idx, noop, net_delta, t_and, t_or
+        return next_idx, noop, net_delta, t_and, t_or, snd_ch, snd_code
 
     def _build_tables(self) -> None:
         classes = self._effect_classes()
         cls_idx = {c: k for k, c in enumerate(classes)}
         n_cls = max(1, len(classes))
+        #: max sends per applied transition (send-sequence columns)
+        self._smax = max(
+            [1]
+            + [
+                len(tr[2])
+                for tr in self._msg_tr.values()
+                if self.ordered or not tr[1]
+            ]
+            + [len(tr[2]) for tr in self._tmo_tr.values() if not tr[1]]
+        )
 
         # Per deliver slot: tables indexed by the dst actor's state idx.
         self.tbl_deliver = []
@@ -721,16 +826,25 @@ class CompiledActorEncoding(EncodedModelBase):
             tan = np.full((ns, self.width), 0xFFFFFFFF, np.uint32)
             tor = np.zeros((ns, self.width), np.uint32)
             hcl = np.zeros(ns, np.uint32)
+            sch = np.zeros((ns, self._smax), np.uint32)
+            scd = np.zeros((ns, self._smax), np.uint32)
             for si, s in enumerate(self.S[i]):
                 tr = self._msg_tr.get((i, s, env))
                 if tr is None:
                     continue  # unexpandable state: row never used
-                nxt[si], noop[si], ndl[si], tan[si], tor[si] = (
-                    self._tr_effects(i, tr)
+                (nxt[si], noop[si], ndl[si], tan[si], tor[si],
+                 sch[si], scd[si]) = self._tr_effects(
+                    i, tr, force=self.ordered
                 )
-                if not noop[si]:
+                if self.ordered:
+                    # Ordered records history on no-op pops too.
+                    noop[si] = False
                     hcl[si] = cls_idx[(env, tr[2])]
-            self.tbl_deliver.append((i, k, nxt, noop, ndl, tan, tor, hcl))
+                elif not noop[si]:
+                    hcl[si] = cls_idx[(env, tr[2])]
+            self.tbl_deliver.append(
+                (i, k, nxt, noop, ndl, tan, tor, hcl, sch, scd)
+            )
 
         self.tbl_timeout = []
         for (i, j) in self.timeout_slots:
@@ -742,16 +856,21 @@ class CompiledActorEncoding(EncodedModelBase):
             tan = np.full((ns, self.width), 0xFFFFFFFF, np.uint32)
             tor = np.zeros((ns, self.width), np.uint32)
             hcl = np.zeros(ns, np.uint32)
+            sch = np.zeros((ns, self._smax), np.uint32)
+            scd = np.zeros((ns, self._smax), np.uint32)
             for si, s in enumerate(self.S[i]):
                 tr = self._tmo_tr.get((i, s, t))
                 if tr is None:
                     continue
-                nxt[si], noop[si], ndl[si], tan[si], tor[si] = (
-                    self._tr_effects(i, tr, fired_timer=t)
+                (nxt[si], noop[si], ndl[si], tan[si], tor[si],
+                 sch[si], scd[si]) = self._tr_effects(
+                    i, tr, fired_timer=t
                 )
                 if not noop[si]:
                     hcl[si] = cls_idx[(None, tr[2])]
-            self.tbl_timeout.append((i, j, nxt, noop, ndl, tan, tor, hcl))
+            self.tbl_timeout.append(
+                (i, j, nxt, noop, ndl, tan, tor, hcl, sch, scd)
+            )
 
         # History table: H × effect classes.
         self.tbl_history = np.zeros((len(self.H), n_cls), np.uint32)
@@ -780,26 +899,28 @@ class CompiledActorEncoding(EncodedModelBase):
     #   1 actor index (deliver dst / timeout owner / crash target)
     #   2 flat-table row offset (deliver/timeout)
     #   3 actor-state field lane   4 shift   5 mask
-    #   6 net-count field lane     7 shift   8 mask   (deliver/drop)
+    #   6 net/queue field lane     7 shift   8 mask   (deliver/drop)
     #   9 timer/crashed field lane 10 shift
-    #   11 unused (pad)
+    #   11 channel base (ordered deliver; 0 otherwise)
+    #   12 head code (ordered deliver)
+    #   13 channel index (ordered deliver)
     # Flat transition row layout: [nxt, noop, hcl] + ndl[W] + tan[W]
-    # + tor[W].
+    # + tor[W] + snd_ch[SMAX] + snd_code[SMAX].
 
     _SK_DELIVER, _SK_DROP, _SK_TIMEOUT, _SK_CRASH, _SK_PAD = range(5)
 
     def _build_sparse_tables(self) -> None:
         W = self.width
         A = self.max_actions
-        params = np.zeros((A, 12), np.uint32)
+        params = np.zeros((A, 14), np.uint32)
         params[:, 0] = self._SK_PAD
         flat_rows: list = []
 
         def flat_of(tbl) -> int:
             """Append one per-state transition block; return its base
-            row. tbl = (nxt, noop, ndl, tan, tor, hcl) arrays over the
-            dst actor's state domain."""
-            nxt, noop, ndl, tan, tor, hcl = tbl
+            row. tbl = (nxt, noop, ndl, tan, tor, hcl, sch, scd) arrays
+            over the dst actor's state domain."""
+            nxt, noop, ndl, tan, tor, hcl, sch, scd = tbl
             base = len(flat_rows)
             for si in range(len(nxt)):
                 flat_rows.append(
@@ -811,43 +932,60 @@ class CompiledActorEncoding(EncodedModelBase):
                                 np.uint32,
                             ),
                             ndl[si], tan[si], tor[si],
+                            sch[si], scd[si],
                         ]
                     )
                 )
             return base
 
         a = 0
-        for (i, k, nxt, noop, ndl, tan, tor, hcl) in self.tbl_deliver:
-            f, fn = self.f_actor[i], self.f_net[k]
-            params[a] = [
+        for (i, k, nxt, noop, ndl, tan, tor, hcl, sch, scd) in (
+            self.tbl_deliver
+        ):
+            f = self.f_actor[i]
+            row = [
                 self._SK_DELIVER, i,
-                flat_of((nxt, noop, ndl, tan, tor, hcl)),
+                flat_of((nxt, noop, ndl, tan, tor, hcl, sch, scd)),
                 f.lane, f.shift, (1 << f.bits) - 1,
-                fn.lane, fn.shift, (1 << fn.bits) - 1,
-                0, 0, 0,
+                0, 0, 0, 0, 0, 0, 0, 0,
             ]
+            if self.ordered:
+                env = self.E[k]
+                ch = (env.src, env.dst)
+                ci = self.chidx[ch]
+                fq = self.f_ch[ci]
+                row[6:9] = [fq.lane, fq.shift, (1 << fq.bits) - 1]
+                row[11] = self.ch_base[ch]
+                row[12] = self.ch_code[ch][env.msg]
+                row[13] = ci
+            else:
+                fn = self.f_net[k]
+                row[6:9] = [fn.lane, fn.shift, (1 << fn.bits) - 1]
+            params[a] = row
             a += 1
         for k in self.drop_slots:
             fn = self.f_net[k]
             params[a] = [
                 self._SK_DROP, 0, 0, 0, 0, 0,
-                fn.lane, fn.shift, (1 << fn.bits) - 1, 0, 0, 0,
+                fn.lane, fn.shift, (1 << fn.bits) - 1, 0, 0, 0, 0, 0,
             ]
             a += 1
-        for (i, j, nxt, noop, ndl, tan, tor, hcl) in self.tbl_timeout:
+        for (i, j, nxt, noop, ndl, tan, tor, hcl, sch, scd) in (
+            self.tbl_timeout
+        ):
             f, ft = self.f_actor[i], self.f_timer[i][j]
             params[a] = [
                 self._SK_TIMEOUT, i,
-                flat_of((nxt, noop, ndl, tan, tor, hcl)),
+                flat_of((nxt, noop, ndl, tan, tor, hcl, sch, scd)),
                 f.lane, f.shift, (1 << f.bits) - 1,
-                0, 0, 0, ft.lane, ft.shift, 0,
+                0, 0, 0, ft.lane, ft.shift, 0, 0, 0,
             ]
             a += 1
         for i in self.crash_slots:
             fc = self.f_crashed[i]
             params[a] = [
                 self._SK_CRASH, i, 0, 0, 0, 0, 0, 0, 0,
-                fc.lane, fc.shift, 0,
+                fc.lane, fc.shift, 0, 0, 0,
             ]
             a += 1
 
@@ -855,7 +993,7 @@ class CompiledActorEncoding(EncodedModelBase):
         self._sp_flat = (
             np.stack(flat_rows)
             if flat_rows
-            else np.zeros((1, 3 + 3 * W), np.uint32)
+            else np.zeros((1, 3 + 3 * W + 2 * self._smax), np.uint32)
         )
         self._sp_hist_flat = self.tbl_history.reshape(-1)
         # Crash: per-actor [W] AND-mask clearing every timer bit.
@@ -905,9 +1043,19 @@ class CompiledActorEncoding(EncodedModelBase):
             tmr_val = jnp.where(
                 jnp.asarray(p[:, 9]) == j, vec[j], tmr_val
             )
-        present = (
-            (net_val >> jnp.asarray(p[:, 7])) & jnp.asarray(p[:, 8])
-        ) > 0
+        if self.ordered:
+            # Deliverable iff the slot's message is the channel HEAD
+            # (queue's least-significant digit).
+            qv = (net_val >> jnp.asarray(p[:, 7])) & jnp.asarray(
+                p[:, 8]
+            )
+            base = jnp.maximum(jnp.asarray(p[:, 11]), jnp.uint32(1))
+            present = (qv % base) == jnp.asarray(p[:, 12])
+        else:
+            present = (
+                (net_val >> jnp.asarray(p[:, 7]))
+                & jnp.asarray(p[:, 8])
+            ) > 0
         armed = (
             (tmr_val >> jnp.asarray(p[:, 10])) & jnp.uint32(1)
         ) != 0
@@ -960,6 +1108,8 @@ class CompiledActorEncoding(EncodedModelBase):
         ndl = frow[3 : 3 + W]
         tan = frow[3 + W : 3 + 2 * W]
         tor = frow[3 + 2 * W : 3 + 3 * W]
+        snd_ch = frow[3 + 3 * W : 3 + 3 * W + self._smax]
+        snd_cd = frow[3 + 3 * W + self._smax : 3 + 3 * W + 2 * self._smax]
 
         h_idx = self._get_field(vec, self.f_history, xp)
         h2 = xp.asarray(self._sp_hist_flat)[
@@ -985,30 +1135,87 @@ class CompiledActorEncoding(EncodedModelBase):
         hsel = xp.arange(W, dtype=xp.uint32) == xp.uint32(hf.lane)
         apply = xp.where(hsel, (apply & ~hmask) | hval, apply)
 
-        # deliver additionally consumes the envelope (nondup). The
-        # count must be read POST-delta (a handler may re-send the
-        # envelope it consumed, exactly as the dense dec_net reads the
-        # updated state).
-        nsel = xp.arange(W, dtype=xp.uint32) == prow[6]
-        if self.dup:
-            s_deliver = apply  # redeliverable (network.rs:204-206)
-            s_drop = xp.where(
-                nsel, vec & ~(prow[8] << prow[7]), vec
+        ord_over = xp.bool_(False)
+        if self.ordered:
+            # Pop the delivered channel's head (divide by base), then
+            # append the transition's send sequence to its queues in
+            # emission order. Composed as PURE PER-LANE ARITHMETIC —
+            # static-index lane reads, per-lane delta stacks, no masked
+            # vector writes: the masked read-modify-write form
+            # miscompiled under vmap on TPU (sibling queue lanes were
+            # zeroed; same hazard family as the dynamic-index scatter
+            # drop documented in PERF.md).
+            base = xp.maximum(prow[11], xp.uint32(1))
+            qv = (lane_sel(apply, prow[6]) >> prow[7]) & prow[8]
+            pop_amt = (qv - qv // base) << prow[7]
+            pop_vec = xp.stack(
+                [
+                    xp.where(
+                        is_deliver & (prow[6] == L), pop_amt, xp.uint32(0)
+                    )
+                    for L in range(W)
+                ]
             )
+            s_net = apply - pop_vec
+            for j in range(self._smax):
+                chj = snd_ch[j]
+                cdj = snd_cd[j]
+                do = cdj > 0
+                adds = [xp.uint32(0)] * W
+                for cc in range(len(self.channels)):
+                    cch = self.channels[cc]
+                    cbase = self.ch_base[cch]
+                    Q = self.ch_q[cch]
+                    f = self.f_ch[cc]
+                    fmask = xp.uint32((1 << f.bits) - 1)
+                    q = (s_net[f.lane] >> xp.uint32(f.shift)) & fmask
+                    ln = sum(
+                        (q >= xp.uint32(cbase**p)).astype(xp.uint32)
+                        for p in range(Q)
+                    )
+                    powv = xp.uint32(0)
+                    for pp in range(Q):
+                        powv = xp.where(
+                            ln == pp, xp.uint32(cbase**pp), powv
+                        )
+                    sel = do & (chj == cc)
+                    full = ln >= Q
+                    adds[f.lane] = adds[f.lane] + (
+                        xp.where(sel & ~full, cdj * powv, xp.uint32(0))
+                        << xp.uint32(f.shift)
+                    )
+                    ord_over = ord_over | (sel & full)
+                s_net = s_net + xp.stack(adds)
+            s_deliver = s_net
+            s_drop = vec  # lossy ordered rejected at compile
+            s_timeout = s_net
         else:
-            nmask = prow[8] << prow[7]
-            ac = (lane_sel(apply, prow[6]) >> prow[7]) & prow[8]
-            s_deliver = xp.where(
-                nsel, (apply & ~nmask) | (((ac - 1) & prow[8]) << prow[7]),
-                apply,
-            )
-            vc = (lane_sel(vec, prow[6]) >> prow[7]) & prow[8]
-            s_drop = xp.where(
-                nsel, (vec & ~nmask) | (((vc - 1) & prow[8]) << prow[7]),
-                vec,
-            )
+            # deliver additionally consumes the envelope (nondup). The
+            # count must be read POST-delta (a handler may re-send the
+            # envelope it consumed, exactly as the dense dec_net reads
+            # the updated state).
+            nsel = xp.arange(W, dtype=xp.uint32) == prow[6]
+            if self.dup:
+                s_deliver = apply  # redeliverable (network.rs:204-206)
+                s_drop = xp.where(
+                    nsel, vec & ~(prow[8] << prow[7]), vec
+                )
+            else:
+                nmask = prow[8] << prow[7]
+                ac = (lane_sel(apply, prow[6]) >> prow[7]) & prow[8]
+                s_deliver = xp.where(
+                    nsel,
+                    (apply & ~nmask) | (((ac - 1) & prow[8]) << prow[7]),
+                    apply,
+                )
+                vc = (lane_sel(vec, prow[6]) >> prow[7]) & prow[8]
+                s_drop = xp.where(
+                    nsel,
+                    (vec & ~nmask) | (((vc - 1) & prow[8]) << prow[7]),
+                    vec,
+                )
 
-        s_timeout = apply  # fired-timer clear already folded into tan
+            s_timeout = apply  # fired-timer clear already folded into tan
 
         csel = xp.arange(W, dtype=xp.uint32) == prow[9]
         s_crash = xp.where(csel, vec | (xp.uint32(1) << prow[10]), vec)
@@ -1023,7 +1230,9 @@ class CompiledActorEncoding(EncodedModelBase):
                          xp.where(is_crash, s_crash, vec)),
             ),
         )
-        if self.dup:
+        if self.ordered:
+            trunc = (is_deliver | is_timeout) & ord_over
+        elif self.dup:
             trunc = xp.bool_(False)
         else:
             trunc = (is_deliver | is_timeout) & xp.any(
@@ -1048,6 +1257,20 @@ class CompiledActorEncoding(EncodedModelBase):
         return self._get_field(vec, self.f_actor[i], xp)
 
     def _net_count(self, vec, k: int, xp):
+        if self.ordered:
+            # Envelope k is "in flight" iff its code appears at any
+            # position of its channel's queue (iter_deliverable yields
+            # every flow position, not just heads — network.rs:149-170).
+            env = self.E[k]
+            ch = (env.src, env.dst)
+            base = self.ch_base[ch]
+            code = self.ch_code[ch][env.msg]
+            q = self._get_field(vec, self.f_ch[self.chidx[ch]], xp)
+            cnt = xp.uint32(0)
+            for p in range(self.ch_q[ch]):
+                digit = (q // xp.uint32(base**p)) % xp.uint32(base)
+                cnt = cnt + (digit == code).astype(xp.uint32)
+            return cnt
         return self._get_field(vec, self.f_net[k], xp)
 
     # -- host side --------------------------------------------------------
@@ -1076,7 +1299,26 @@ class CompiledActorEncoding(EncodedModelBase):
         for i, timers in enumerate(state.timers_set):
             for t in timers:
                 put(self.f_timer[i][self.tidx[i][t]], 1)
-        if self.dup:
+        if self.ordered:
+            for ci, ch in enumerate(self.channels):
+                flow = state.network.flows.get(ch, ())
+                if len(flow) > self.ch_q[ch]:
+                    raise ValueError(
+                        f"channel {ch} queue depth {len(flow)} exceeds "
+                        f"the harvested bound {self.ch_q[ch]}"
+                    )
+                base = self.ch_base[ch]
+                q = 0
+                for pos, msg in enumerate(flow):
+                    code = self.ch_code[ch].get(msg)
+                    if code is None:
+                        raise KeyError(
+                            f"message outside channel {ch} alphabet: "
+                            f"{msg!r}"
+                        )
+                    q += code * base**pos
+                put(self.f_ch[ci], q)
+        elif self.dup:
             for env in state.network.envelopes:
                 put(self.f_net[self.eidx[env]], 1)
         else:
@@ -1110,7 +1352,19 @@ class CompiledActorEncoding(EncodedModelBase):
             )
             for i in range(self.n)
         )
-        if self.dup:
+        if self.ordered:
+            flows = {}
+            for ci, ch in enumerate(self.channels):
+                q = int(self._get_field(vec, self.f_ch[ci], np))
+                base = self.ch_base[ch]
+                flow = []
+                while q:
+                    flow.append(self.ch_msgs[ch][q % base - 1])
+                    q //= base
+                if flow:
+                    flows[ch] = tuple(flow)
+            net = Ordered(flows)
+        elif self.dup:
             net = UnorderedDuplicating(frozenset(
                 e for k, e in enumerate(self.E)
                 if self._net_count(vec, k, np)
@@ -1179,7 +1433,7 @@ class CompiledActorEncoding(EncodedModelBase):
             s = self._set_field(s, self.f_history, h2, jnp)
             if extra_net is not None:
                 s = extra_net(s)
-            if not self.dup:
+            if not self.dup and not self.ordered:
                 poisoned = jnp.any(
                     (s & jnp.asarray(self._net_top_mask)) != 0
                 )
@@ -1187,11 +1441,76 @@ class CompiledActorEncoding(EncodedModelBase):
                 poisoned = jnp.bool_(False)
             return s, t_noop, poisoned
 
+        def ord_sends(s, i, sch, scd):
+            """Append this transition's send sequence to its FIFO
+            queues, in emission order: per send, q += code*base^len
+            (len from Q static comparisons); a full queue poisons the
+            successor (cannot occur for harvested reachable bounds —
+            safety net only)."""
+            s_idx = self._get_actor_idx(vec, i, jnp)
+            over = jnp.bool_(False)
+            for j in range(self._smax):
+                chj = jnp.asarray(sch)[s_idx, j]
+                cdj = jnp.asarray(scd)[s_idx, j]
+                do = cdj > 0
+                for cc in range(len(self.channels)):
+                    base = self.ch_base[self.channels[cc]]
+                    Q = self.ch_q[self.channels[cc]]
+                    f = self.f_ch[cc]
+                    q = self._get_field(s, f, jnp)
+                    ln = sum(
+                        (q >= jnp.uint32(base**p)).astype(jnp.uint32)
+                        for p in range(Q)
+                    )
+                    powv = jnp.uint32(0)
+                    for p in range(Q):
+                        powv = jnp.where(
+                            ln == p, jnp.uint32(base**p), powv
+                        )
+                    sel = do & (chj == cc)
+                    full = ln >= Q
+                    q2 = jnp.where(
+                        sel & ~full, q + cdj * powv, q
+                    )
+                    s = self._set_field(s, f, q2, jnp)
+                    over = over | (sel & full)
+            return s, over
+
         # Deliver slots (model.rs:299-351).
-        for (i, k, nxt, noop, ndl, tan, tor, hcl) in self.tbl_deliver:
+        for (i, k, nxt, noop, ndl, tan, tor, hcl, sch, scd) in (
+            self.tbl_deliver
+        ):
+            crashed = self._get_field(vec, self.f_crashed[i], jnp) != 0
+            if self.ordered:
+                env = self.E[k]
+                ch = (env.src, env.dst)
+                ci = self.chidx[ch]
+                base = self.ch_base[ch]
+                code = self.ch_code[ch][env.msg]
+                fq = self.f_ch[ci]
+                q0 = self._get_field(vec, fq, jnp)
+                # Deliverable iff this message is the channel HEAD
+                # (model.rs:252-266); a no-op handler still pops.
+                present = (q0 % jnp.uint32(base)) == code
+
+                def pop_net(s, fq=fq, base=base):
+                    return self._set_field(
+                        s, fq,
+                        self._get_field(s, fq, jnp) // jnp.uint32(base),
+                        jnp,
+                    )
+
+                s, t_noop, _ = apply_transition(
+                    i, nxt, noop, ndl, tan, tor, hcl, extra_net=pop_net
+                )
+                s, poisoned = ord_sends(s, i, sch, scd)
+                enabled = present & ~crashed & ~t_noop
+                trunc = trunc | (enabled & poisoned & in_bound(s))
+                succs.append(s)
+                valids.append(enabled & ~poisoned)
+                continue
             f = self.f_net[k]
             present = self._net_count(vec, k, jnp) > 0
-            crashed = self._get_field(vec, self.f_crashed[i], jnp) != 0
 
             def dec_net(s, f=f):
                 if self.dup:
@@ -1222,14 +1541,17 @@ class CompiledActorEncoding(EncodedModelBase):
             valids.append(present)
 
         # Timeout slots (model.rs:352-371).
-        for idx, (i, j, nxt, noop, ndl, tan, tor, hcl) in enumerate(
-            self.tbl_timeout
+        for idx, (i, j, nxt, noop, ndl, tan, tor, hcl, sch, scd) in (
+            enumerate(self.tbl_timeout)
         ):
             f = self.f_timer[i][j]
             armed = self._get_field(vec, f, jnp) != 0
             s, t_noop, poisoned = apply_transition(
                 i, nxt, noop, ndl, tan, tor, hcl
             )
+            if self.ordered:
+                s, over = ord_sends(s, i, sch, scd)
+                poisoned = poisoned | over
             enabled = armed & ~t_noop
             trunc = trunc | (enabled & poisoned & in_bound(s))
             succs.append(s)

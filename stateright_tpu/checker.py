@@ -138,6 +138,16 @@ class CheckerBuilder:
 
         return TpuSimulationChecker(self, **kwargs)
 
+    def spawn_hybrid(self, **kwargs) -> "Checker":
+        """Spawn the hybrid racer: host DFS in a thread vs the device
+        sort-merge engine, first to complete wins and the loser is
+        cancelled — TPU-or-tie on shallow bugs, the full device win on
+        deep verification (see checkers/hybrid.py). kwargs go to the
+        device engine."""
+        from .checkers.hybrid import HybridChecker
+
+        return HybridChecker(self, **kwargs)
+
     def spawn_tpu_sharded_sortmerge(self, **kwargs) -> "Checker":
         """Spawn the multi-chip SORT-MERGE wave engine: the all-to-all
         routing of spawn_tpu_sharded with owner-local dedup on the

@@ -26,6 +26,11 @@ class DfsChecker(Checker):
     def __init__(self, builder: CheckerBuilder):
         super().__init__(builder)
         self.visited: set[int] = set()
+        #: optional threading.Event: when set, _run returns early with
+        #: partial results and ``cancelled`` True (the hybrid racer's
+        #: losing side; see checkers/hybrid.py).
+        self.cancel_event = None
+        self.cancelled = False
 
     def _discover(self, name: str, trace: tuple[int, ...]) -> None:
         if name not in self._discoveries:
@@ -58,7 +63,11 @@ class DfsChecker(Checker):
         self._unique_states = len(self.visited)
 
         last_report = time.monotonic()
+        cancel = self.cancel_event
         while pending:
+            if cancel is not None and cancel.is_set():
+                self.cancelled = True
+                return
             state, trace, ebits = pending.pop()
             depth = len(trace)
             self._max_depth = max(self._max_depth, depth)

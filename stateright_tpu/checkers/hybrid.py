@@ -1,0 +1,123 @@
+"""The hybrid racer: host DFS vs the device wave engine, first done wins.
+
+The measured TTFC profile (PERF.md, BENCH ttfc lane) is bimodal:
+shallow bugs favor the host (increment lost-update: host DFS 2ms vs
+the device engine's ~70ms per-dispatch floor), deep verification
+favors the device by ~83x (paxos 2c full check: 16.5s vs 0.2s). A
+tool that loses on the easy half invites the wrong engine choice, so
+``spawn_hybrid()`` runs BOTH — the host depth-first search in a
+daemon thread, the device sort-merge engine in the calling thread —
+and adopts whichever completes first, cancelling the loser at its
+next check point (per DFS pop / per device chunk readback).
+
+This is the single-machine analog of the reference racing its
+checker threads for discovery identity (bfs.rs records whichever
+thread's discovery lands first): here whole ENGINES race, and the
+winner's complete result surface (counts, discoveries, paths) is
+adopted wholesale — both sides share the fingerprint/path plumbing,
+so discoveries replay identically either way.
+
+The host thread runs pure Python and the device thread spends its
+time inside XLA dispatch (GIL released), so the race costs neither
+side more than normal thread timeslicing.
+
+Cold-cache caveat: the device program build is not interruptible, so
+the very first run of a configuration is bounded below by the XLA
+compile even when the host wins in milliseconds (the device side
+checks the cancel flag before the build and per chunk after it; the
+persistent compile cache makes every later run race at true speed).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..checker import Checker, CheckerBuilder
+from ..report import Reporter
+from .dfs import DfsChecker
+
+
+class HybridChecker(Checker):
+    """``CheckerBuilder.spawn_hybrid()``.
+
+    ``device_kwargs`` go to :meth:`spawn_tpu_sortmerge` (``encoded``,
+    capacities, ``sparse``, ...). After ``join()``, :attr:`winner` is
+    ``"host"`` or ``"device"`` and every ``Checker`` accessor reflects
+    the winning engine's run.
+    """
+
+    def __init__(self, builder: CheckerBuilder, **device_kwargs):
+        super().__init__(builder)
+        self._device_kwargs = device_kwargs
+        #: which engine completed first ("host" | "device")
+        self.winner: Optional[str] = None
+
+    def _run(self, reporter: Optional[Reporter] = None) -> None:
+        from .tpu_sortmerge import SortMergeTpuBfsChecker
+
+        host = DfsChecker(self.builder)
+        device = SortMergeTpuBfsChecker(
+            self.builder, **self._device_kwargs
+        )
+        stop_host = threading.Event()
+        stop_device = threading.Event()
+        host.cancel_event = stop_host
+        device.cancel_event = stop_device
+        lock = threading.Lock()
+        host_error: list = []
+
+        def claim(name: str) -> bool:
+            with lock:
+                if self.winner is None:
+                    self.winner = name
+                    return True
+                return False
+
+        def run_host():
+            try:
+                host._ensure_run()
+            except Exception as exc:  # surfaced if the host wins
+                host_error.append(exc)
+                return
+            if not host.cancelled and claim("host"):
+                stop_device.set()
+
+        t = threading.Thread(target=run_host, daemon=True)
+        t.start()
+        device_error = None
+        try:
+            device._ensure_run(reporter)
+        except Exception as exc:
+            device_error = exc
+        if device_error is None and not device.cancelled and claim(
+            "device"
+        ):
+            stop_host.set()
+        t.join()
+        if self.winner is None:
+            # Both failed (or the device failed and the host errored) —
+            # a side only claims after completing without an exception.
+            raise device_error or host_error[0]
+        win = host if self.winner == "host" else device
+        # Adopt the winner's result surface wholesale.
+        self._winner_checker = win
+        self._discoveries = win._discoveries
+        self._total_states = win._total_states
+        self._unique_states = win._unique_states
+        self._max_depth = win._max_depth
+
+    def discovered_property_names(self) -> set:
+        self._ensure_run()
+        w = self._winner_checker
+        if hasattr(w, "discovered_property_names"):
+            return w.discovered_property_names()
+        return set(w._discoveries)
+
+    def discoveries(self):
+        self._ensure_run()
+        return self._winner_checker.discoveries()
+
+    def assert_properties(self) -> None:
+        self._ensure_run()
+        self._winner_checker.assert_properties()

@@ -299,6 +299,11 @@ class TpuBfsChecker(Checker):
         self._discovered_fps: dict[str, int] = {}
         self._programs = None  # (seed_fn, chunk_fn)
         self._final_tables: Optional[tuple] = None
+        #: optional threading.Event: when set, _run returns after the
+        #: current chunk with partial results and ``cancelled`` True
+        #: (the hybrid racer's losing side; see checkers/hybrid.py).
+        self.cancel_event = None
+        self.cancelled = False
         #: per-run wave metrics for observability (SURVEY §5): updated
         #: at each host sync point.
         self.metrics: dict[str, float] = {}
@@ -621,6 +626,14 @@ class TpuBfsChecker(Checker):
         if n0 > F:
             raise ValueError(f"frontier capacity {F} < {n0} init states")
 
+        # A racer (checkers/hybrid.py) may have already won before the
+        # device program is even built — skip the (potentially
+        # multi-second) trace/compile entirely. A win landing DURING
+        # the build still blocks until the build returns; the chunk
+        # loop below re-checks per chunk.
+        if self.cancel_event is not None and self.cancel_event.is_set():
+            self.cancelled = True
+            return
         if self._programs is None:
             self._programs = self._lookup_programs(n0)
         seed_fn, chunk_fn = self._programs
@@ -628,6 +641,9 @@ class TpuBfsChecker(Checker):
         carry = seed_fn(jnp.asarray(init))  # the run's one upload
 
         while True:
+            if self.cancel_event is not None and self.cancel_event.is_set():
+                self.cancelled = True
+                return
             carry, stats = chunk_fn(carry)
             s = np.asarray(stats)  # the chunk's one readback
             done = bool(s[0])

@@ -116,29 +116,42 @@ def _paxos(sub: str, args: list[str]) -> None:
         # sparse action dispatch (SparseEncodedModel), so the
         # candidate budget tracks ENABLED pairs (3c peak 343,235; 4c
         # peak 686,045), not F*K slot cells; knobs per PERF.md §sparse.
+        # Measured spaces: 1c=265, 2c=16,668, 3c=1,194,428,
+        # 4c=2,372,188, 5c=4,711,569 (leader sharing + single-Put
+        # guards cap the per-client growth). 5c needs the padded-HBM
+        # sizing rule of PERF.md (a [N, W] state buffer costs ~512
+        # bytes/row on TPU regardless of W<=32) plus coarser ladders
+        # and the chunked sparse mode.
         caps = {
-            1: (1 << 10, 1 << 8, 1 << 10),
-            2: (1 << 15, 1 << 12, 1 << 14),
-            3: (5 << 18, 1 << 18, 3 << 17),
-            4: (5 << 19, 1 << 19, 1 << 21),
+            1: dict(capacity=1 << 10, frontier_capacity=1 << 8,
+                    cand_capacity=1 << 10),
+            2: dict(capacity=1 << 15, frontier_capacity=1 << 12,
+                    cand_capacity=1 << 14),
+            3: dict(capacity=5 << 18, frontier_capacity=1 << 18,
+                    cand_capacity=3 << 17),
+            4: dict(capacity=5 << 19, frontier_capacity=1 << 19,
+                    cand_capacity=1 << 21, tile_rows=1 << 19),
+            5: dict(capacity=3 << 21, frontier_capacity=3 << 19,
+                    cand_capacity=3 << 20, tile_rows=1 << 19,
+                    f_min=1 << 18, ladder_step=4, v_min=1 << 21,
+                    v_ladder_step=4, flat_budget_bytes=1 << 26,
+                    mask_budget_cells=1 << 26),
         }
         if client_count not in caps:
             raise SystemExit(
-                f"paxos check-tpu supports 1-4 clients (got "
+                f"paxos check-tpu supports 1-5 clients (got "
                 f"{client_count}): the TPU encoding's client-lane "
-                "packing caps at 4 (models/paxos_tpu.py)"
+                "packing caps at 5 (models/paxos_tpu.py)"
             )
-        cap, fcap, ccap = caps[client_count]
+        kw = dict(caps[client_count])
+        kw.setdefault("tile_rows", 1 << 18)
         _report(
             paxos_model(cfg)
             .checker()
             .spawn_tpu_sortmerge(
-                capacity=cap,
-                frontier_capacity=fcap,
-                cand_capacity=ccap,
                 pair_width=16,
-                tile_rows=1 << 18,
                 track_paths=client_count <= 2,
+                **kw,
             )
         )
     elif sub == "explore":
@@ -273,12 +286,13 @@ def _linearizable(sub: str, args: list[str]) -> None:
         )
         _report(abd_model(cfg, network).checker().spawn_dfs())
     elif sub == "check-tpu":
+        network = _network(args, 1)
         print(
             f"Model checking a linearizable register with {client_count} "
             "clients on the TPU wave engine (compiled actor encoding)."
         )
         _report(
-            abd_model(cfg)
+            abd_model(cfg, network)
             .checker()
             .spawn_tpu_sortmerge(
                 capacity=1 << (9 + 2 * client_count),

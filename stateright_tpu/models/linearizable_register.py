@@ -230,15 +230,27 @@ def abd_model(cfg: AbdModelCfg, network: Network | None = None) -> ActorModel:
     return model
 
 
-def abd_encoded(model: ActorModel):
+def abd_encoded(model: ActorModel, closure: str | None = None):
     """TPU encoding via the generic actor→encoding compiler — ABD has
     no hand-written device code at all. ABD's logical clocks are
     bounded only by system reachability (a write bumps the max quorum
-    clock), so the overapproximating closure diverges; the "reachable"
-    mode harvests component domains from a host exploration instead
-    (see actor/compile.py).
+    clock), so the UNBOUNDED overapproximating closure diverges. The
+    default mode here is bounded overapproximation (VERDICT r3 #5): the
+    protocol invariant "a logical clock never exceeds the number of
+    writes issued" (each Put bumps the adopted quorum max by exactly
+    one, linearizable-register.rs:123-170) gives
+    ``seq[0] <= client_count * put_count``, and the client loop gives
+    ``ops per thread <= put_count + 1`` — with those two bounds the
+    component fixpoint converges WITHOUT any host exploration, so the
+    device does all the search work and the compile cost no longer
+    scales with the state space (the round-3 "reachable" mode ran a
+    full host BFS at compile time — circular at scale). Soundness of
+    the bounds is pinned by the count differentials in
+    tests/test_actor_compile.py; ``closure="reachable"`` remains
+    available as the harvest/bootstrap mode.
     """
     from ..actor.compile import compile_actor_model
+    from ..actor.network import Ordered
 
     def linearizable(ctx, jnp):
         return (
@@ -254,11 +266,53 @@ def abd_encoded(model: ActorModel):
             and env.msg.value != DEFAULT_VALUE
         )
 
+    cfg = model.cfg
+    if closure is None:
+        # Ordered networks need harvested queue bounds (actor/compile).
+        closure = (
+            "reachable"
+            if isinstance(model._init_network, Ordered)
+            else "overapprox"
+        )
+    w_max = cfg.client_count * cfg.put_count
+
+    def seq_ok(seq) -> bool:
+        return seq[0] <= w_max
+
+    def actor_bound(i: int, s) -> bool:
+        if i >= cfg.server_count:
+            return True  # clients: op_count is self-bounded
+        inner = s.state  # RegisterServer wraps AbdState
+        if not seq_ok(inner.seq):
+            return False
+        ph = inner.phase
+        if isinstance(ph, Phase1):
+            return all(seq_ok(sv[0]) for sv in ph.responses.values())
+        return True
+
+    def history_bound(h) -> bool:
+        per_thread = dict(h.history_by_thread)
+        in_flight = dict(h.in_flight_by_thread)
+        for t, completed in per_thread.items():
+            ops = len(completed) + (1 if in_flight.get(t) else 0)
+            if ops > cfg.put_count + 1:
+                return False
+        # Reachable ABD histories are linearizable (the ALWAYS property
+        # this model checks). Bounding EXPANSION to linearizable
+        # histories is sound for that property: a bounded-out history
+        # is kept un-expanded, so the first non-linearizable history —
+        # were one ever reachable — still enters the domain and trips
+        # the property. This is what tames the overapprox tester-state
+        # combinatorics at 3 clients.
+        return h.serialized_history() is not None
+
     return compile_actor_model(
         model,
         properties={
             "linearizable": linearizable,
             "value chosen": value_chosen_vec,
         },
-        closure="reachable",
+        closure=closure,
+        closure_actor_bound=actor_bound,
+        closure_history_bound=history_bound,
     )

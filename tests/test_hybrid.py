@@ -1,0 +1,51 @@
+"""The hybrid racer (checkers/hybrid.py): host DFS vs the device
+engine, first complete run wins, loser cancelled. Shallow-violation
+workloads resolve at host speed; the winner's full result surface is
+adopted either way."""
+
+from stateright_tpu.models.increment import Increment
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+
+def test_hybrid_shallow_bug_matches_host():
+    host = Increment(thread_count=4).checker().spawn_dfs().join()
+    hy = (
+        Increment(thread_count=4)
+        .checker()
+        .spawn_hybrid(
+            capacity=1 << 16,
+            frontier_capacity=1 << 12,
+            cand_capacity=1 << 14,
+            track_paths=False,
+        )
+        .join()
+    )
+    assert sorted(hy.discoveries() if hy.winner == "host"
+                  else hy.discovered_property_names()) == sorted(
+        host.discoveries()
+    )
+    assert hy.winner in ("host", "device")
+    # The discovery must be replayable when the host won (the device
+    # side ran fingerprint-only here).
+    if hy.winner == "host":
+        p = hy.discovery("fin")
+        assert p is not None and len(p.actions()) >= 1
+
+
+def test_hybrid_full_verification_matches():
+    """Run-to-completion workload: whichever engine wins, the count is
+    the pinned 8,832 and the property set matches the host oracle."""
+    host = TwoPhaseSys(rm_count=5).checker().spawn_bfs().join()
+    hy = (
+        TwoPhaseSys(rm_count=5)
+        .checker()
+        .spawn_hybrid(
+            capacity=1 << 14,
+            frontier_capacity=1 << 11,
+            cand_capacity=1 << 14,
+        )
+        .join()
+    )
+    assert hy.unique_state_count() == host.unique_state_count() == 8832
+    assert sorted(hy.discoveries()) == sorted(host.discoveries())
+    hy.assert_properties()

@@ -357,6 +357,49 @@ def _interaction(sub: str, args: list[str]) -> None:
         _usage("interaction")
 
 
+def _panic(sub: str, args: list[str]) -> None:
+    """Counterpart of examples/panic.rs: a model whose action
+    enumeration raises mid-search. The reference uses it to verify a
+    worker-thread panic propagates out of ``join()`` instead of
+    hanging the checker; here the search runs in-process and the
+    checker must surface the error to the caller unchanged."""
+    from .model import Model, Property
+
+    class Adder(Model):
+        def init_states(self):
+            return [0]
+
+        def actions(self, state):
+            if state >= 5000:
+                raise RuntimeError(
+                    "panic! (the examples/panic.rs trigger: action "
+                    f"enumeration raised at state {state})"
+                )
+            return [1, 2, 3, 4, 5]
+
+        def next_state(self, state, action):
+            return state + action
+
+        def properties(self):
+            return [Property.always("true", lambda m, s: True)]
+
+    if sub == "check":
+        print(
+            "Checking the panicking adder (examples/panic.rs): the "
+            "search must fail loudly, not hang."
+        )
+        try:
+            Adder().checker().spawn_dfs().join()
+        except RuntimeError as e:
+            if "panic!" not in str(e):
+                raise  # an unrelated checker failure, not the trigger
+            print(f"Checker propagated the panic: {e}")
+            return
+        raise SystemExit("ERROR: the panic did not propagate")
+    else:
+        _usage("panic")
+
+
 _MODELS = {
     "2pc": (_2pc, ["check", "check-sym", "check-tpu", "explore"]),
     "paxos": (_paxos, ["check", "check-tpu", "explore", "spawn"]),
@@ -366,6 +409,7 @@ _MODELS = {
     "linearizable-register": (_linearizable, ["check", "check-tpu", "explore", "spawn"]),
     "timers": (_timers, ["check", "explore"]),
     "interaction": (_interaction, ["check", "explore"]),
+    "panic": (_panic, ["check"]),
 }
 
 
@@ -384,6 +428,8 @@ def _usage(model: str | None = None) -> None:
                 "explore": "[COUNT] [ADDRESS] [NETWORK]",
                 "spawn": "",
             }[sub]
+            if model == "panic":
+                extra = ""  # fixed harness: no count, no network
             print(f"  python -m stateright_tpu {model} {sub} {extra}")
     print(f"NETWORK: {' | '.join(Network.names())}")
 

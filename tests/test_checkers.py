@@ -320,3 +320,17 @@ def test_assert_properties_raises_on_violation():
     checker = model.checker().spawn_bfs().join()
     with pytest.raises(AssertionError):
         checker.assert_properties()
+
+
+def test_panic_cli_workload_propagates():
+    """examples/panic.rs parity at the CLI surface: the panicking
+    adder's error propagates cleanly out of the search."""
+    import io
+    from contextlib import redirect_stdout
+
+    from stateright_tpu.cli import main
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        main(["panic", "check"])
+    assert "propagated the panic" in buf.getvalue()

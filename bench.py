@@ -232,9 +232,15 @@ def tpu_workloads(quick=False):
                     4,
                     capacity=5 << 19,
                     frontier_capacity=1 << 19,
-                    cand_capacity=1 << 21,
-                    pair_width=16,
-                    tile_rows=1 << 19,
+                    # Pair budget tracks the measured enabled-pair peak
+                    # (686,045) with ~15% headroom; the oversized 2^21
+                    # budget cost ~1.75x (636k -> 1.12M st/s).
+                    # pair_width: max enabled slots per ROW measured 8
+                    # (exhaustive at d<=7, same as 2c/3c) — 12 keeps
+                    # 1.5x margin, overflow detected loudly.
+                    cand_capacity=3 << 18,
+                    pair_width=12,
+                    tile_rows=1 << 18,
                 ),
                 2372188,
             )

@@ -111,17 +111,13 @@ def _paxos(sub: str, args: list[str]) -> None:
             "clients on the TPU wave engine."
         )
         # Measured spaces: 1c=265, 2c=16,668, 3c=1,194,428,
-        # 4c=2,372,188 (the 4th client shares leader 0, whose
-        # single-Put guard caps the growth). The encoding provides
-        # sparse action dispatch (SparseEncodedModel), so the
-        # candidate budget tracks ENABLED pairs (3c peak 343,235; 4c
-        # peak 686,045), not F*K slot cells; knobs per PERF.md §sparse.
-        # Measured spaces: 1c=265, 2c=16,668, 3c=1,194,428,
         # 4c=2,372,188, 5c=4,711,569 (leader sharing + single-Put
-        # guards cap the per-client growth). 5c needs the padded-HBM
-        # sizing rule of PERF.md (a [N, W] state buffer costs ~512
-        # bytes/row on TPU regardless of W<=32) plus coarser ladders
-        # and the chunked sparse mode.
+        # guards cap the per-client growth). The encoding provides
+        # sparse action dispatch, so candidate budgets track ENABLED
+        # pairs (3c peak 343,235; 4c peak 686,045), not F*K slot
+        # cells; 5c additionally needs the padded-HBM sizing rule of
+        # PERF.md (a [N, W] state buffer costs ~512 bytes/row on TPU
+        # for any W<=32), coarser ladders, and the chunked sparse mode.
         caps = {
             1: dict(capacity=1 << 10, frontier_capacity=1 << 8,
                     cand_capacity=1 << 10),
@@ -130,7 +126,8 @@ def _paxos(sub: str, args: list[str]) -> None:
             3: dict(capacity=5 << 18, frontier_capacity=1 << 18,
                     cand_capacity=3 << 17),
             4: dict(capacity=5 << 19, frontier_capacity=1 << 19,
-                    cand_capacity=1 << 21, tile_rows=1 << 19),
+                    cand_capacity=3 << 18, pair_width=12,
+                    tile_rows=1 << 18),
             5: dict(capacity=3 << 21, frontier_capacity=3 << 19,
                     cand_capacity=3 << 20, tile_rows=1 << 19,
                     f_min=1 << 18, ladder_step=4, v_min=1 << 21,
@@ -145,11 +142,11 @@ def _paxos(sub: str, args: list[str]) -> None:
             )
         kw = dict(caps[client_count])
         kw.setdefault("tile_rows", 1 << 18)
+        kw.setdefault("pair_width", 16)
         _report(
             paxos_model(cfg)
             .checker()
             .spawn_tpu_sortmerge(
-                pair_width=16,
                 track_paths=client_count <= 2,
                 **kw,
             )

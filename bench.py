@@ -83,6 +83,9 @@ def tpu_workloads(quick=False):
         return spawn
 
     from stateright_tpu.models.paxos import PaxosModelCfg, paxos_model
+    from stateright_tpu.models.paxos_tpu import (
+        TUNED_ENGINE_CAPS as _pcaps,
+    )
 
     def paxos(clients, **kw):
         def spawn():
@@ -145,19 +148,13 @@ def tpu_workloads(quick=False):
                 # check N): the generalized encoding runs check 3
                 # exhaustively on chip. Count verified by host-BFS
                 # differential at depths 6-12 (tests/test_paxos_tpu.py).
+                # Sparse action dispatch (round 4): candidate budgets
+                # track ENABLED (row, slot) pairs, not F*K slot cells;
+                # r3's dense path ran this lane at 151k st/s, sparse
+                # runs ~1M. Budgets live in ONE place:
+                # models/paxos_tpu.TUNED_ENGINE_CAPS.
                 "paxos 3c/3s",
-                paxos(
-                    3,
-                    capacity=5 << 18,
-                    frontier_capacity=1 << 18,
-                    # Sparse action dispatch (round 4): the candidate
-                    # budget tracks ENABLED (row, slot) pairs — peak
-                    # 343,235 — not F*K slot cells; r3's dense path ran
-                    # this lane at 151k st/s, sparse runs ~1M.
-                    cand_capacity=3 << 17,
-                    pair_width=16,
-                    tile_rows=1 << 18,
-                ),
+                paxos(3, **_pcaps[3]),
                 1194428,
             )
         )
@@ -201,20 +198,7 @@ def tpu_workloads(quick=False):
                 # (VERDICT r3 #6); sized by the padded-HBM rule
                 # (PERF.md: a [N, W] state buffer costs ~512 B/row).
                 "paxos 5c/3s",
-                paxos(
-                    5,
-                    capacity=3 << 21,
-                    frontier_capacity=3 << 19,
-                    cand_capacity=3 << 20,
-                    pair_width=16,
-                    tile_rows=1 << 19,
-                    f_min=1 << 18,
-                    ladder_step=4,
-                    v_min=1 << 21,
-                    v_ladder_step=4,
-                    flat_budget_bytes=1 << 26,
-                    mask_budget_cells=1 << 26,
-                ),
+                paxos(5, **_pcaps[5]),
                 4711569,
             )
         )
@@ -228,20 +212,7 @@ def tpu_workloads(quick=False):
                 # (proposal-None) caps the ballot blowup. First
                 # executed round 4, via sparse dispatch.
                 "paxos 4c/3s",
-                paxos(
-                    4,
-                    capacity=5 << 19,
-                    frontier_capacity=1 << 19,
-                    # Pair budget tracks the measured enabled-pair peak
-                    # (686,045) with ~15% headroom; the oversized 2^21
-                    # budget cost ~1.75x (636k -> 1.12M st/s).
-                    # pair_width: max enabled slots per ROW measured 8
-                    # (exhaustive at d<=7, same as 2c/3c) — 12 keeps
-                    # 1.5x margin, overflow detected loudly.
-                    cand_capacity=3 << 18,
-                    pair_width=12,
-                    tile_rows=1 << 18,
-                ),
+                paxos(4, **_pcaps[4]),
                 2372188,
             )
         )

@@ -110,30 +110,8 @@ def _paxos(sub: str, args: list[str]) -> None:
             f"Model checking Single Decree Paxos with {client_count} "
             "clients on the TPU wave engine."
         )
-        # Measured spaces: 1c=265, 2c=16,668, 3c=1,194,428,
-        # 4c=2,372,188, 5c=4,711,569 (leader sharing + single-Put
-        # guards cap the per-client growth). The encoding provides
-        # sparse action dispatch, so candidate budgets track ENABLED
-        # pairs (3c peak 343,235; 4c peak 686,045), not F*K slot
-        # cells; 5c additionally needs the padded-HBM sizing rule of
-        # PERF.md (a [N, W] state buffer costs ~512 bytes/row on TPU
-        # for any W<=32), coarser ladders, and the chunked sparse mode.
-        caps = {
-            1: dict(capacity=1 << 10, frontier_capacity=1 << 8,
-                    cand_capacity=1 << 10),
-            2: dict(capacity=1 << 15, frontier_capacity=1 << 12,
-                    cand_capacity=1 << 14),
-            3: dict(capacity=5 << 18, frontier_capacity=1 << 18,
-                    cand_capacity=3 << 17),
-            4: dict(capacity=5 << 19, frontier_capacity=1 << 19,
-                    cand_capacity=3 << 18, pair_width=12,
-                    tile_rows=1 << 18),
-            5: dict(capacity=3 << 21, frontier_capacity=3 << 19,
-                    cand_capacity=3 << 20, tile_rows=1 << 19,
-                    f_min=1 << 18, ladder_step=4, v_min=1 << 21,
-                    v_ladder_step=4, flat_budget_bytes=1 << 26,
-                    mask_budget_cells=1 << 26),
-        }
+        from .models.paxos_tpu import TUNED_ENGINE_CAPS as caps
+
         if client_count not in caps:
             raise SystemExit(
                 f"paxos check-tpu supports 1-5 clients (got "
@@ -141,8 +119,6 @@ def _paxos(sub: str, args: list[str]) -> None:
                 "packing caps at 5 (models/paxos_tpu.py)"
             )
         kw = dict(caps[client_count])
-        kw.setdefault("tile_rows", 1 << 18)
-        kw.setdefault("pair_width", 16)
         _report(
             paxos_model(cfg)
             .checker()

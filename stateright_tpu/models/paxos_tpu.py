@@ -1356,6 +1356,32 @@ class PaxosEncoded(EncodedModelBase):
         return jnp.stack([linearizable, chosen])
 
 
+#: Measured engine budgets per client count, shared by the CLI and
+#: bench.py so a retune lands in exactly one place. Spaces: 1c=265,
+#: 2c=16,668, 3c=1,194,428, 4c=2,372,188, 5c=4,711,569. Candidate
+#: budgets track the measured enabled-pair peaks (3c 343,235; 4c
+#: 686,045; 5c 1,371,240) with ~15% headroom; max enabled slots per
+#: row is 8 at every client count, so pair_width 12-16 keeps margin
+#: and overflow is detected loudly. 5c additionally needs the
+#: padded-HBM sizing rule, coarser ladders, and the chunked sparse
+#: mode (PERF.md).
+TUNED_ENGINE_CAPS = {
+    1: dict(capacity=1 << 10, frontier_capacity=1 << 8,
+            cand_capacity=1 << 10, pair_width=16, tile_rows=1 << 18),
+    2: dict(capacity=1 << 15, frontier_capacity=1 << 12,
+            cand_capacity=1 << 14, pair_width=16, tile_rows=1 << 18),
+    3: dict(capacity=5 << 18, frontier_capacity=1 << 18,
+            cand_capacity=3 << 17, pair_width=16, tile_rows=1 << 18),
+    4: dict(capacity=5 << 19, frontier_capacity=1 << 19,
+            cand_capacity=3 << 18, pair_width=12, tile_rows=1 << 18),
+    5: dict(capacity=3 << 21, frontier_capacity=3 << 19,
+            cand_capacity=3 << 19, pair_width=12, tile_rows=1 << 18,
+            f_min=1 << 18, ladder_step=4, v_min=1 << 21,
+            v_ladder_step=4, flat_budget_bytes=1 << 26,
+            mask_budget_cells=1 << 26),
+}
+
+
 def paxos_encoded(
     client_count: int = 2, server_count: int = 3, put_count: int = 1
 ) -> PaxosEncoded:

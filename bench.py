@@ -341,7 +341,7 @@ def bench_ttfc(runs=2):
         )
         _stderr(
             f"ttfc {name}: host={h_sec:.3f}s tpu={t_sec:.3f}s "
-            f"hybrid={y_sec:.3f}s (winner={y.winner}; {kind})"
+            f"hybrid={y_sec:.3f}s (winner={y_winner}; {kind})"
         )
     return out
 

@@ -872,13 +872,27 @@ class CompiledActorEncoding(EncodedModelBase):
                 (i, j, nxt, noop, ndl, tan, tor, hcl, sch, scd)
             )
 
-        # History table: H × effect classes.
+        # History table: H × effect classes. Un-harvested (h, class)
+        # transitions (h beyond closure_history_bound — reachable only
+        # when a search continues past a violating state, or when the
+        # bound is tighter than the model boundary) are tracked in a
+        # parallel missing-mask and surfaced through the engines'
+        # truncation flag; defaulting them to history 0 silently
+        # corrupted post-violation successors (ADVICE r4).
         self.tbl_history = np.zeros((len(self.H), n_cls), np.uint32)
+        self.tbl_history_missing = np.ones((len(self.H), n_cls), bool)
         for hi, h in enumerate(self.H):
             for ci, cls in enumerate(classes):
                 h2 = self._hist_tr.get((h, cls[0], cls[1]))
                 if h2 is not None:
                     self.tbl_history[hi, ci] = self.hidx[h2]
+                    self.tbl_history_missing[hi, ci] = False
+        # Hot-path form: missing flag packed into bit 31 (history
+        # indices are bounded far below 2^31 by max_domain), so the
+        # per-pair/per-slot step pays ONE history gather, not two.
+        self.tbl_history_packed = self.tbl_history | (
+            self.tbl_history_missing.astype(np.uint32) << 31
+        )
         self.n_cls = n_cls
         self._build_sparse_tables()
 
@@ -995,7 +1009,7 @@ class CompiledActorEncoding(EncodedModelBase):
             if flat_rows
             else np.zeros((1, 3 + 3 * W + 2 * self._smax), np.uint32)
         )
-        self._sp_hist_flat = self.tbl_history.reshape(-1)
+        self._sp_hist_flat = self.tbl_history_packed.reshape(-1)
         # Crash: per-actor [W] AND-mask clearing every timer bit.
         cr = np.full((max(1, self.n), W), 0xFFFFFFFF, np.uint32)
         for i in range(self.n):
@@ -1079,7 +1093,10 @@ class CompiledActorEncoding(EncodedModelBase):
         )
 
     def step_slot_vec(self, vec, slot):
-        """(successor, trunc) for one enabled (state, slot) pair."""
+        """(successor, trunc, hard_trunc) for one enabled (state,
+        slot) pair — trunc is boundary-gated by the engines (count
+        poison), hard_trunc is raised unconditionally (un-harvested
+        history transition; see ``step_vec``'s hmiss notes)."""
         import jax.numpy as jnp
 
         xp = jnp
@@ -1112,9 +1129,15 @@ class CompiledActorEncoding(EncodedModelBase):
         snd_cd = frow[3 + 3 * W + self._smax : 3 + 3 * W + 2 * self._smax]
 
         h_idx = self._get_field(vec, self.f_history, xp)
-        h2 = xp.asarray(self._sp_hist_flat)[
+        # One packed gather: history index in bits 0-30, the
+        # un-harvested-transition flag in bit 31 (successor
+        # unrepresentable — reported through the hard-truncation
+        # element, ADVICE r4, matching dense step_vec's hmiss).
+        hg = xp.asarray(self._sp_hist_flat)[
             h_idx * xp.uint32(self.n_cls) + hcl
         ]
+        h2 = hg & xp.uint32(0x7FFFFFFF)
+        h_missing = (hg >> 31) != 0
 
         # deliver/timeout: the table-driven transition, composed as
         # pure [W]-vector ops (delta add/or, timer and/or, field sets
@@ -1238,7 +1261,13 @@ class CompiledActorEncoding(EncodedModelBase):
             trunc = (is_deliver | is_timeout) & xp.any(
                 (succ & xp.asarray(self._net_top_mask)) != 0
             )
-        return succ, trunc
+        # Third element = HARD truncation: un-harvested (h, class)
+        # transition, raised by the engines regardless of the boundary
+        # (the successor's history field is garbage, so the boundary
+        # cannot be evaluated faithfully on it — unlike count poison,
+        # where the count field keeps its true value).
+        hard = (is_deliver | is_timeout) & h_missing
+        return succ, trunc, hard
 
     # -- field access (host + device) ------------------------------------
 
@@ -1415,7 +1444,7 @@ class CompiledActorEncoding(EncodedModelBase):
                 vec, self.f_crashed[i], jnp
             )
         h_idx = self._get_field(vec, self.f_history, jnp)
-        h_table = jnp.asarray(self.tbl_history)
+        h_table = jnp.asarray(self.tbl_history_packed)
 
         def apply_transition(i, nxt, noop, ndl, tan, tor, hcl,
                              extra_net=None):
@@ -1429,7 +1458,8 @@ class CompiledActorEncoding(EncodedModelBase):
             else:
                 s = s + delta
             s = (s & jnp.asarray(tan)[s_idx]) | jnp.asarray(tor)[s_idx]
-            h2 = h_table[h_idx, jnp.asarray(hcl)[s_idx]]
+            hg = h_table[h_idx, jnp.asarray(hcl)[s_idx]]
+            h2 = hg & jnp.uint32(0x7FFFFFFF)
             s = self._set_field(s, self.f_history, h2, jnp)
             if extra_net is not None:
                 s = extra_net(s)
@@ -1439,7 +1469,15 @@ class CompiledActorEncoding(EncodedModelBase):
                 )
             else:
                 poisoned = jnp.bool_(False)
-            return s, t_noop, poisoned
+            # An un-harvested (h, class) transition makes the successor
+            # unrepresentable — returned SEPARATELY from the count
+            # poison, because the caller's in_bound(s) gate is sound
+            # only for count poison (the count field still holds its
+            # true value); here the history field is garbage, so the
+            # boundary cannot be trusted to evaluate faithfully and
+            # truncation must be raised unconditionally.
+            hmiss = (hg >> 31) != 0
+            return s, t_noop, poisoned, hmiss
 
         def ord_sends(s, i, sch, scd):
             """Append this transition's send sequence to its FIFO
@@ -1500,14 +1538,17 @@ class CompiledActorEncoding(EncodedModelBase):
                         jnp,
                     )
 
-                s, t_noop, _ = apply_transition(
+                s, t_noop, apply_poisoned, hmiss = apply_transition(
                     i, nxt, noop, ndl, tan, tor, hcl, extra_net=pop_net
                 )
                 s, poisoned = ord_sends(s, i, sch, scd)
+                poisoned = poisoned | apply_poisoned
                 enabled = present & ~crashed & ~t_noop
-                trunc = trunc | (enabled & poisoned & in_bound(s))
+                trunc = trunc | (
+                    enabled & ((poisoned & in_bound(s)) | hmiss)
+                )
                 succs.append(s)
-                valids.append(enabled & ~poisoned)
+                valids.append(enabled & ~poisoned & ~hmiss)
                 continue
             f = self.f_net[k]
             present = self._net_count(vec, k, jnp) > 0
@@ -1519,13 +1560,15 @@ class CompiledActorEncoding(EncodedModelBase):
                     s, f, self._get_field(s, f, jnp) - 1, jnp
                 )
 
-            s, t_noop, poisoned = apply_transition(
+            s, t_noop, poisoned, hmiss = apply_transition(
                 i, nxt, noop, ndl, tan, tor, hcl, extra_net=dec_net
             )
             enabled = present & ~crashed & ~t_noop
-            trunc = trunc | (enabled & poisoned & in_bound(s))
+            trunc = trunc | (
+                enabled & ((poisoned & in_bound(s)) | hmiss)
+            )
             succs.append(s)
-            valids.append(enabled & ~poisoned)
+            valids.append(enabled & ~poisoned & ~hmiss)
 
         # Drop slots — lossy networks only (model.rs:246-249).
         for k in self.drop_slots:
@@ -1546,16 +1589,18 @@ class CompiledActorEncoding(EncodedModelBase):
         ):
             f = self.f_timer[i][j]
             armed = self._get_field(vec, f, jnp) != 0
-            s, t_noop, poisoned = apply_transition(
+            s, t_noop, poisoned, hmiss = apply_transition(
                 i, nxt, noop, ndl, tan, tor, hcl
             )
             if self.ordered:
                 s, over = ord_sends(s, i, sch, scd)
                 poisoned = poisoned | over
             enabled = armed & ~t_noop
-            trunc = trunc | (enabled & poisoned & in_bound(s))
+            trunc = trunc | (
+                enabled & ((poisoned & in_bound(s)) | hmiss)
+            )
             succs.append(s)
-            valids.append(enabled & ~poisoned)
+            valids.append(enabled & ~poisoned & ~hmiss)
 
         # Crash slots (model.rs:372-380).
         for i in self.crash_slots:

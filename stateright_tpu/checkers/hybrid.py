@@ -99,6 +99,37 @@ class HybridChecker(Checker):
             # Both failed (or the device failed and the host errored) —
             # a side only claims after completing without an exception.
             raise device_error or host_error[0]
+        if host_error and self.winner == "device":
+            if isinstance(host_error[0], MemoryError):
+                # Host-side resource exhaustion, not a model error: the
+                # host DFS holds O(states × depth) trace tuples — on
+                # deep workloads (exactly where the device wins by
+                # ~83x) running out of host memory is the race being
+                # LOST, not a defect in the model. Keep the device's
+                # completed verification; note the host's demise.
+                import warnings
+
+                warnings.warn(
+                    "hybrid race: host engine ran out of memory; "
+                    "adopting the device engine's completed result",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+            else:
+                # The device won but the host engine RAISED (not lost
+                # the race): a genuine model error — e.g. a panicking
+                # handler, examples/panic.rs semantics — can manifest
+                # only on the host, because hand encodings never run
+                # the host model's enumeration. The reference
+                # propagates worker panics (checker.rs joins its
+                # threads); racing past one would report a clean
+                # verification for a panicking model, so surface it
+                # instead of adopting the device result.
+                raise RuntimeError(
+                    "hybrid race: the device engine completed but the "
+                    "host engine raised a model error (not a "
+                    "cancellation) — refusing to mask it"
+                ) from host_error[0]
         win = host if self.winner == "host" else device
         # Adopt the winner's result surface wholesale.
         self._winner_checker = win

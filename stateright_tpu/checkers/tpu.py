@@ -698,6 +698,12 @@ class TpuBfsChecker(Checker):
         # Keep device handles; download lazily only if a path is
         # reconstructed (_build_generated).
         self._capture_final(carry)
+        if getattr(self, "keep_final_carry", False):
+            # Tooling hook (tools/profile_stages.py): stash the whole
+            # final carry so a stage profiler can rerun wave stages on
+            # REAL mid-run frontier/visited data (spawn, set the
+            # attribute, then join).
+            self._final_carry = carry
         disc_found = s[11 : 11 + n_props]
         disc_lo = s[11 + n_props : 11 + 2 * n_props]
         disc_hi = s[11 + 2 * n_props : 11 + 3 * n_props]

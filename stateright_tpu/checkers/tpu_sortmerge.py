@@ -61,7 +61,11 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..encoding import EncodedModelBase, SparseEncodedModel
+from ..encoding import (
+    EncodedModelBase,
+    SparseEncodedModel,
+    normalize_step_slot_result,
+)
 from ..model import Expectation
 from ..ops.fingerprint import fingerprint_u32v
 from ..ops.u64 import U64, u64_add
@@ -934,32 +938,38 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 needs_scan = sparse_boundary or sparse_has_trunc
 
                 def step_pairs(st, sl):
-                    """(succ, trunc|None) for a pair block;
-                    step_slot_vec MAY return (succ, trunc): trunc marks
-                    pairs pruned by an internal encoding bound
-                    (compiled envelope counts) — excluded from
+                    """(succ, trunc|None, hard|None) for a pair block;
+                    trunc marks pairs pruned by an internal encoding
+                    bound (compiled envelope counts) — excluded from
                     candidates and, when in-boundary, raised as
-                    e_overflow (the dense truncation contract)."""
-                    res = jax.vmap(enc.step_slot_vec)(st, sl)
-                    return res if isinstance(res, tuple) else (res, None)
+                    e_overflow (the dense truncation contract); hard
+                    marks unrepresentable successors (un-harvested
+                    history transitions) — excluded and raised
+                    REGARDLESS of boundary, since the garbage successor
+                    can't faithfully evaluate it."""
+                    return normalize_step_slot_result(
+                        jax.vmap(enc.step_slot_vec)(st, sl)
+                    )
 
                 def eval_pairs(pidx_b, live_b, slot_b):
                     """fingerprint keys + validity (+ scan stats) for a
                     block of compacted pairs."""
                     prow_b = pidx_b // jnp.uint32(EV)
-                    succ_b, ptr_b = step_pairs(
+                    succ_b, ptr_b, hard_b = step_pairs(
                         frontier_f[prow_b], slot_b
                     )
+                    eov = jnp.bool_(False)
+                    if hard_b is not None:
+                        eov = jnp.any(live_b & hard_b)
+                        live_b = live_b & ~hard_b
                     if sparse_boundary:
                         inb = jax.vmap(enc.within_boundary_vec)(succ_b)
                         ok = live_b & inb
                     else:
                         ok = live_b
                     if ptr_b is not None:
-                        eov = jnp.any(ok & ptr_b)
+                        eov = eov | jnp.any(ok & ptr_b)
                         ok = ok & ~ptr_b
-                    else:
-                        eov = jnp.bool_(False)
                     lo, hi = fingerprint_u32v(succ_b, jnp)
                     lo, hi = clamp_keys(lo, hi)
                     lo = jnp.where(ok, lo, jnp.uint32(_SENT))
@@ -1041,7 +1051,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     # SparseEncodedModel purity contract.
                     pidx_w = pidx[nf_row]
                     par_row = pidx_w // jnp.uint32(EV)
-                    succ_w, _ = step_pairs(
+                    succ_w, _, _ = step_pairs(
                         frontier_f[par_row], pslot[nf_row]
                     )
                     return (

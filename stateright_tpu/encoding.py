@@ -112,8 +112,30 @@ class SparseEncodedModel(Protocol):
         """Pure jax function: ``(uint32[width], uint32 slot) ->
         uint32[width]`` — the successor for one enabled (state, slot)
         pair, with ``slot`` a traced index. Runs only on compacted
-        pairs; table gathers by ``slot`` are the intended idiom."""
+        pairs; table gathers by ``slot`` are the intended idiom.
+
+        MAY instead return ``(succ, trunc)`` or ``(succ, trunc,
+        hard_trunc)``: ``trunc`` marks pairs pruned by an internal
+        encoding bound — excluded from candidates and raised as
+        truncation when the successor is IN boundary (the dense
+        third-element contract); ``hard_trunc`` marks pairs whose
+        successor is unrepresentable outright (e.g. an un-harvested
+        history transition) — excluded and raised UNCONDITIONALLY,
+        because the garbage successor cannot be trusted even to
+        evaluate the boundary."""
         ...
+
+
+def normalize_step_slot_result(res) -> tuple:
+    """``step_slot_vec`` results to canonical ``(succ, trunc|None,
+    hard_trunc|None)`` (see :class:`SparseEncodedModel` for the three
+    accepted shapes). Lives beside the contract so every engine and
+    tool interprets encodings identically."""
+    if not isinstance(res, tuple):
+        return res, None, None
+    if len(res) == 2:
+        return res[0], res[1], None
+    return res
 
 
 class EncodedModelBase:

@@ -168,7 +168,7 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             _ladder,
             sparse_pair_candidates,
         )
-        from ..encoding import EncodedModelBase
+        from ..encoding import EncodedModelBase, normalize_step_slot_result
 
         enc = self.encoded
         props = list(self.model.properties())
@@ -529,16 +529,23 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                     needs_scan = sparse_boundary or sparse_has_trunc
 
                     def step_pairs(st, sl):
-                        res = jax.vmap(enc.step_slot_vec)(st, sl)
-                        return (
-                            res if sparse_has_trunc else (res, None)
+                        return normalize_step_slot_result(
+                            jax.vmap(enc.step_slot_vec)(st, sl)
                         )
 
                     def eval_pairs(pidx_b, live_b, slot_b):
                         prow_b = pidx_b // jnp.uint32(EV)
-                        succ_b, ptr_b = step_pairs(
+                        succ_b, ptr_b, hard_b = step_pairs(
                             frontier_c[prow_b], slot_b
                         )
+                        # hard trunc (unrepresentable successor, e.g.
+                        # an un-harvested history transition) is raised
+                        # regardless of the boundary — the garbage
+                        # successor can't faithfully evaluate it.
+                        eov = jnp.bool_(False)
+                        if hard_b is not None:
+                            eov = jnp.any(live_b & hard_b)
+                            live_b = live_b & ~hard_b
                         if sparse_boundary:
                             inb = jax.vmap(enc.within_boundary_vec)(
                                 succ_b
@@ -547,10 +554,8 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                         else:
                             ok = live_b
                         if ptr_b is not None:
-                            eov = jnp.any(ok & ptr_b)
+                            eov = eov | jnp.any(ok & ptr_b)
                             ok = ok & ~ptr_b
-                        else:
-                            eov = jnp.bool_(False)
                         lo, hi = fingerprint_u32v(succ_b, jnp)
                         lo, hi = clamp_keys(lo, hi)
                         return succ_b, lo, hi, ok, prow_b, eov
@@ -655,7 +660,7 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                     def cand_rows(srow):
                         if cand_state is not None:
                             return cand_state[srow]
-                        succ_t, _ = step_pairs(
+                        succ_t, _, _ = step_pairs(
                             frontier_c[cand_par[srow]], pslot[srow]
                         )
                         return succ_t

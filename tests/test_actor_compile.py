@@ -323,17 +323,18 @@ def _sparse_contract_check(enc, max_states=20000):
     )
     mask = np.asarray(jax.jit(jax.vmap(enc.enabled_mask_vec))(vecs))
     rows, slots = np.nonzero(mask)
-    sp, ptr = (
+    sp, ptr, hard = (
         np.asarray(a)
         for a in jax.jit(jax.vmap(enc.step_slot_vec))(
             vecs[jnp.asarray(rows)],
             jnp.asarray(slots.astype(np.uint32)),
         )
     )
+    bad = ptr | hard
     eff = mask.copy()
-    eff[rows[ptr], slots[ptr]] = False
+    eff[rows[bad], slots[bad]] = False
     assert (eff == valid).all(), "enabled & ~trunc diverges from dense"
-    ok = ~ptr
+    ok = ~bad
     assert (sp[ok] == succs[rows[ok], slots[ok]]).all(), (
         "step_slot_vec diverges from step_vec"
     )
@@ -580,14 +581,14 @@ def test_abd_3clients_bounded_overapprox_compiles_and_agrees():
     vecs = jnp.asarray(np.array(sorted(seen), dtype=np.uint32))
     mask = np.asarray(jax.jit(jax.vmap(enc.enabled_mask_vec))(vecs))
     rows, slots = np.nonzero(mask)
-    sp, ptr = (
+    sp, ptr, hard = (
         np.asarray(a)
         for a in jax.jit(jax.vmap(enc.step_slot_vec))(
             vecs[jnp.asarray(rows)],
             jnp.asarray(slots.astype(np.uint32)),
         )
     )
-    assert not ptr.any()
+    assert not ptr.any() and not hard.any()
     got = {}
     for j in range(len(rows)):
         got.setdefault(int(rows[j]), set()).add(tuple(sp[j].tolist()))

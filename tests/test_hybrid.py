@@ -32,6 +32,31 @@ def test_hybrid_shallow_bug_matches_host():
         assert p is not None and len(p.actions()) >= 1
 
 
+def test_hybrid_does_not_mask_host_panic():
+    """A model error that manifests only on the host (a raising
+    actions(), examples/panic.rs semantics — hand encodings never run
+    the host enumeration) must surface even when the device engine
+    completes and would otherwise claim the win (ADVICE r4)."""
+    import pytest
+
+    class PanickingIncrement(Increment):
+        def actions(self, state):
+            raise RuntimeError("panic! (host-only model error)")
+
+    with pytest.raises(RuntimeError, match="panic|refusing to mask"):
+        (
+            PanickingIncrement(thread_count=4)
+            .checker()
+            .spawn_hybrid(
+                capacity=1 << 16,
+                frontier_capacity=1 << 12,
+                cand_capacity=1 << 14,
+                track_paths=False,
+            )
+            .join()
+        )
+
+
 def test_hybrid_full_verification_matches():
     """Run-to-completion workload: whichever engine wins, the count is
     the pinned 8,832 and the property set matches the host oracle."""

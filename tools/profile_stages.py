@@ -1,0 +1,417 @@
+#!/usr/bin/env python
+"""Per-STAGE profile of the sparse sort-merge wave at real workload
+shapes with REAL mid-run data (VERDICT r4: explain where the
+~76ms/wave at paxos check 4 goes, and why check 5 runs at half the
+per-state rate of check 4).
+
+Method: run the real engine with ``target_state_count`` ≈ half the
+space and ``keep_final_carry`` set, so the final carry's frontier is a
+genuine mid-growth wave's new-state set and the visited array holds
+the genuine prefix. Then re-run each wave stage in isolation on that
+data, amortized over REPS in-jit repetitions (the axon tunnel hides
+per-dispatch execution; see tools/profile_sortmerge.py).
+
+Usage:
+  python tools/profile_stages.py --paxos 4
+  python tools/profile_stages.py --paxos 5
+  python tools/profile_stages.py --twopc 8
+  python tools/profile_stages.py --paxos 4 --wave-profile   # per-wave ms
+"""
+
+import argparse
+import time
+
+REPS = 8
+
+
+def _timed(build, args, reps=REPS):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def run(*arrs):
+        out = lax.fori_loop(0, reps, build, arrs)
+        first = out[0] if isinstance(out, (tuple, list)) else out
+        return jnp.sum(first.reshape(-1)[:1].astype(jnp.uint32))
+
+    f = jax.jit(run)
+    float(f(*args))  # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.monotonic()
+        float(f(*args))
+        best = min(best, time.monotonic() - t0)
+    return best / reps * 1000.0  # ms/op (incl. ~100ms/REPS sync share)
+
+
+def _spawn(kind, n, caps, target=None, waves_per_sync=64):
+    if kind == "paxos":
+        from stateright_tpu.models.paxos import PaxosModelCfg, paxos_model
+
+        b = paxos_model(
+            PaxosModelCfg(client_count=n, server_count=3)
+        ).checker()
+    else:
+        from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+        b = TwoPhaseSys(rm_count=n).checker()
+    if target is not None:
+        b = b.target_state_count(target)
+    return b.spawn_tpu_sortmerge(
+        track_paths=False, waves_per_sync=waves_per_sync, **caps
+    )
+
+
+def stage_profile(kind, n, caps, target):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from stateright_tpu.checkers.tpu_sortmerge import (
+        _SENT,
+        _divisor_at_least,
+        _ladder,
+        sparse_pair_candidates,
+    )
+    from stateright_tpu.checkers.tpu import frontier_props
+    from stateright_tpu.ops.fingerprint import fingerprint_u32v
+
+    print(f"\n## stage profile: {kind} {n} (target={target})")
+    c = _spawn(kind, n, caps, target=target)
+    c.keep_final_carry = True
+    c.join()
+    carry = c._final_carry
+    enc = c.encoded
+    frontier = carry["frontier"]
+    nonzero = np.asarray(jnp.any(frontier != 0, axis=1))
+    n_rows = int(nonzero.sum())
+    V_cnt = int(np.asarray(carry["new"]))
+    print(f"captured frontier rows={n_rows}  visited={V_cnt}  "
+          f"depth={int(np.asarray(carry['depth']))}")
+
+    K, W = enc.max_actions, enc.width
+    F = c.frontier_capacity
+    f_ladder = _ladder(c.f_min, F, c.ladder_step)
+    v_ladder = _ladder(c.v_min, c.capacity, c.v_ladder_step)
+    F_f = next(v for v in f_ladder if v >= n_rows)
+    V_v = next(v for v in v_ladder if v >= V_cnt)
+    EV = c._pair_width()
+    B_user = min(c.cand_capacity or F * K, F * K)
+    NPg = F_f * EV
+    B_p = min(B_user, NPg)
+    compaction = NPg > B_p
+    want_tiles = -(-NPg // c.tile_rows)
+    if F_f == F:
+        want_tiles = max(want_tiles, c.tiles)
+    NT = _divisor_at_least(F_f, want_tiles) if compaction else 1
+    T = F_f // NT
+    Ba = (B_p + T * EV) if compaction else NPg
+    chunked = compaction and (Ba * W * 4 > c.flat_budget_bytes)
+    NC = Bc = 0
+    if chunked:
+        NC = -(-(Ba * W * 4) // c.flat_budget_bytes)
+        Bc = -(-Ba // NC)
+        Ba = NC * Bc
+    print(f"class: F_f={F_f} V_v={V_v} K={K} W={W} EV={EV} "
+          f"B_p={B_p} NT={NT} Ba={Ba} chunked={chunked}")
+
+    frontier_f = frontier[:F_f]
+    fval_f = jnp.asarray(nonzero)[:F_f]
+    ebits_f = carry["ebits"][:F_f]
+    props = list(c.model.properties())
+    from stateright_tpu.model import Expectation
+
+    evt_idx = [i for i, p in enumerate(props)
+               if p.expectation == Expectation.EVENTUALLY]
+
+    results = {}
+
+    # -- stage: property conditions over the frontier -------------------
+    def s_props(i, a):
+        (fr,) = a
+        fr = fr.at[0, 0].set(fr[0, 0] ^ i.astype(jnp.uint32))
+        cond, eb, f_lo, f_hi = frontier_props(
+            enc, props, evt_idx, fr, fval_f, ebits_f
+        )
+        return (fr + f_lo[:, None].astype(jnp.uint32) % jnp.uint32(2),)
+
+    results["props(frontier)"] = _timed(s_props, (frontier_f,))
+
+    # -- stage: enabled mask only (the [F,K] predicate pass) ------------
+    L = (K + 31) // 32
+    mb = c.mask_budget_cells
+
+    def mask_only(fr):
+        def mask_bits(tf, tfv):
+            m = jax.vmap(enc.enabled_mask_vec)(tf)
+            m = m & tfv[:, None]
+            tc = jnp.sum(m, axis=1, dtype=jnp.uint32)
+            mp = jnp.pad(m, ((0, 0), (0, L * 32 - K)))
+            tb = jnp.sum(
+                mp.reshape(-1, L, 32).astype(jnp.uint32)
+                * (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)),
+                axis=2, dtype=jnp.uint32,
+            )
+            return tb, tc
+
+        if F_f * K > mb:
+            NTm = _divisor_at_least(F_f, -(-F_f * K // mb))
+            Tm = F_f // NTm
+
+            def mtile(ti, acc):
+                bits_a, cnt_a = acc
+                off = ti * Tm
+                tf = lax.dynamic_slice(fr, (off, 0), (Tm, W))
+                tfv = lax.dynamic_slice(fval_f, (off,), (Tm,))
+                tb, tc = mask_bits(tf, tfv)
+                return (
+                    lax.dynamic_update_slice(bits_a, tb, (off, 0)),
+                    lax.dynamic_update_slice(cnt_a, tc, (off,)),
+                )
+
+            return lax.fori_loop(
+                0, NTm, mtile,
+                (jnp.zeros((F_f, L), jnp.uint32),
+                 jnp.zeros(F_f, jnp.uint32)),
+            )
+        return mask_bits(fr, fval_f)
+
+    def s_mask(i, a):
+        (fr,) = a
+        fr = fr.at[0, 0].set(fr[0, 0] ^ i.astype(jnp.uint32))
+        bits, cnt = mask_only(fr)
+        return (fr + (cnt[0] % jnp.uint32(2)),)
+
+    results["enabled-mask [F,K]"] = _timed(s_mask, (frontier_f,))
+
+    # -- stage: full pair pipeline (mask + peel + compaction) -----------
+    def s_pairs(i, a):
+        (fr,) = a
+        fr = fr.at[0, 0].set(fr[0, 0] ^ i.astype(jnp.uint32))
+        pidx, live, pslot, cnt, n_pairs, ovf, tmax = (
+            sparse_pair_candidates(
+                enc, fr, fval_f, jnp.bool_(True),
+                EV=EV, B_p=B_p, NT=NT, T=T,
+                mask_budget_cells=mb, Ba=Ba,
+            )
+        )
+        return (fr + (n_pairs % jnp.uint32(2)),)
+
+    results["pairs(mask+peel+compact)"] = _timed(s_pairs, (frontier_f,))
+
+    # materialize real pairs once for the downstream stages
+    pidx, live, pslot, cnt, n_pairs, ovf, tmax = jax.jit(
+        lambda fr: sparse_pair_candidates(
+            enc, fr, fval_f, jnp.bool_(True),
+            EV=EV, B_p=B_p, NT=NT, T=T, mask_budget_cells=mb, Ba=Ba,
+        )
+    )(frontier_f)
+    n_pairs_i = int(np.asarray(n_pairs))
+    print(f"real pairs this wave: {n_pairs_i} (Ba={Ba})")
+
+    from stateright_tpu.encoding import normalize_step_slot_result
+
+    def step_pairs(st, sl):
+        return normalize_step_slot_result(
+            jax.vmap(enc.step_slot_vec)(st, sl)
+        )
+
+    has_boundary = not getattr(enc, "trivial_boundary", False)
+
+    # -- stage: step + fingerprint over Ba pairs ------------------------
+    def eval_block(fr, pidx_b, live_b, slot_b):
+        prow_b = pidx_b // jnp.uint32(EV)
+        succ_b, ptr_b, hard_b = step_pairs(fr[prow_b], slot_b)
+        ok = live_b
+        if hard_b is not None:
+            ok = ok & ~hard_b
+        if has_boundary:
+            inb = jax.vmap(enc.within_boundary_vec)(succ_b)
+            ok = ok & inb
+        if ptr_b is not None:
+            ok = ok & ~ptr_b
+        lo, hi = fingerprint_u32v(succ_b, jnp)
+        lo = jnp.where(ok, lo, jnp.uint32(_SENT))
+        hi = jnp.where(ok, hi, jnp.uint32(_SENT))
+        return lo, hi
+
+    if chunked:
+        def s_stepfp(i, a):
+            fr, pi = a
+            pi = pi.at[0].set(pi[0] ^ (i.astype(jnp.uint32) & 1))
+
+            def fchunk(ti, acc):
+                cl, ch = acc
+                off = ti * Bc
+                lo, hi = eval_block(
+                    fr,
+                    lax.dynamic_slice(pi, (off,), (Bc,)),
+                    lax.dynamic_slice(live, (off,), (Bc,)),
+                    lax.dynamic_slice(pslot, (off,), (Bc,)),
+                )
+                return (
+                    lax.dynamic_update_slice(cl, lo, (off,)),
+                    lax.dynamic_update_slice(ch, hi, (off,)),
+                )
+
+            cl, ch = lax.fori_loop(
+                0, NC, fchunk,
+                (jnp.full(Ba, _SENT, jnp.uint32),
+                 jnp.full(Ba, _SENT, jnp.uint32)),
+            )
+            return fr, pi + (cl[0] % jnp.uint32(2))
+    else:
+        def s_stepfp(i, a):
+            fr, pi = a
+            pi = pi.at[0].set(pi[0] ^ (i.astype(jnp.uint32) & 1))
+            lo, hi = eval_block(fr, pi, live, pslot)
+            return fr, pi + (lo[0] % jnp.uint32(2))
+
+    results[f"step+fp ({Ba} pairs)"] = _timed(
+        s_stepfp, (frontier_f, pidx)
+    )
+
+    # real candidate keys for the merge stages
+    ck_lo, ck_hi = jax.jit(
+        lambda fr: eval_block(fr, pidx, live, pslot)
+    )(frontier_f)
+
+    v_lo_full, v_hi_full = carry["v_lo"], carry["v_hi"]
+    M = V_v + Ba
+
+    # -- stage: 3-lane merge sort --------------------------------------
+    def s_merge3(i, a):
+        vh, vl, kh, kl = a
+        kh = kh.at[0].set(kh[0] ^ (i.astype(jnp.uint32) & 1))
+        m_hi = jnp.concatenate([vh[:V_v], kh])
+        m_lo = jnp.concatenate([vl[:V_v], kl])
+        m_pos = jnp.concatenate([
+            jnp.zeros(V_v, jnp.uint32),
+            jnp.arange(1, Ba + 1, dtype=jnp.uint32),
+        ])
+        m_hi, m_lo, m_pos = lax.sort((m_hi, m_lo, m_pos), num_keys=2)
+        return vh, vl, kh + (m_pos[0] % jnp.uint32(2)), kl
+
+    results[f"merge3 ({V_v}+{Ba})"] = _timed(
+        s_merge3, (v_hi_full, v_lo_full, ck_hi, ck_lo)
+    )
+
+    # -- stage: 2-lane rebuild sort ------------------------------------
+    def s_rebuild(i, a):
+        (uh, ul) = a
+        uh = uh.at[0].set(uh[0] ^ (i.astype(jnp.uint32) & 1))
+        uh2, ul2 = lax.sort((uh, ul), num_keys=2)
+        return uh2, ul2
+
+    u_hi = jnp.concatenate([v_hi_full[:V_v], ck_hi])
+    u_lo = jnp.concatenate([v_lo_full[:V_v], ck_lo])
+    results[f"rebuild2 ({M})"] = _timed(s_rebuild, (u_hi, u_lo))
+
+    # -- stage: 1-lane frontier compaction sort ------------------------
+    def s_nfpos(i, a):
+        (pos,) = a
+        pos = pos.at[0].set(pos[0] ^ (i.astype(jnp.uint32) & 1))
+        (pos2,) = lax.sort((pos,), num_keys=1)
+        return (pos2,)
+
+    nf_pos = jnp.arange(M, dtype=jnp.uint32)
+    results[f"nfpos1 ({M})"] = _timed(s_nfpos, (nf_pos,))
+
+    # -- stage: fetch winners (gather + recompute successors) ----------
+    def s_fetch(i, a):
+        fr, nf = a
+        nf = (nf + i.astype(jnp.uint32)) % jnp.uint32(Ba)
+        pidx_w = pidx[nf]
+        par_row = pidx_w // jnp.uint32(EV)
+        succ_w, _, _ = step_pairs(fr[par_row], pslot[nf])
+        return fr, nf + (succ_w[0, 0] % jnp.uint32(2))
+
+    nf_row = jnp.arange(F, dtype=jnp.uint32) % jnp.uint32(Ba)
+    results[f"fetch ({F} winners)"] = _timed(s_fetch, (frontier_f, nf_row))
+
+    print(f"\n{'stage':42s} {'ms/wave':>9s}")
+    total = 0.0
+    for k, v in results.items():
+        print(f"  {k:40s} {v:9.2f}")
+        total += v
+    print(f"  {'SUM (stages, incl per-rep sync share)':40s} {total:9.2f}")
+
+
+def wave_profile(kind, n, caps):
+    from stateright_tpu.report import Reporter
+
+    rows = []
+
+    class Rec(Reporter):
+        def __init__(self):
+            self.last = time.monotonic()
+
+        def delay(self):
+            return 0.0
+
+        def report_checking(self, data):
+            now = time.monotonic()
+            rows.append(
+                (now - self.last, data.unique_states, data.max_depth)
+            )
+            self.last = now
+
+    _spawn(kind, n, caps).join()  # warm compile at the same shapes? (no:
+    # waves_per_sync differs; still warms the persistent XLA cache)
+    c2 = _spawn(kind, n, caps, waves_per_sync=1)
+    rec = Rec()
+    t0 = time.monotonic()
+    c2._ensure_run(rec)
+    total = time.monotonic() - t0
+    rows.append((time.monotonic() - rec.last, c2.unique_state_count(),
+                 c2.max_depth()))
+    print(f"\n## wave profile: {kind} {n} (total {total:.3f}s incl "
+          f"per-wave sync, unique={c2.unique_state_count()})")
+    prev = 0
+    for i, (dt, u, d) in enumerate(rows):
+        print(f"  wave {i:3d}: {dt*1000:8.1f} ms  new={u-prev:8d}  "
+              f"unique={u:9d} depth={d}")
+        prev = u
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--paxos", type=int)
+    ap.add_argument("--twopc", type=int)
+    ap.add_argument("--target", type=int)
+    ap.add_argument("--wave-profile", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    print(f"backend: {jax.devices()}")
+
+    if args.paxos:
+        from stateright_tpu.models.paxos_tpu import TUNED_ENGINE_CAPS
+
+        caps = dict(TUNED_ENGINE_CAPS[args.paxos])
+        kind, n = "paxos", args.paxos
+        default_target = {3: 600_000, 4: 1_200_000, 5: 2_400_000}.get(
+            args.paxos, 1_000_000
+        )
+    elif args.twopc:
+        kind, n = "twopc", args.twopc
+        caps = {
+            8: dict(capacity=1 << 21, frontier_capacity=1 << 19,
+                    cand_capacity=3 << 20),
+            9: dict(capacity=11 << 20, frontier_capacity=3 << 19,
+                    cand_capacity=17 << 20, tile_rows=1 << 20),
+        }[n]
+        default_target = {8: 900_000, 9: 5_000_000}[n]
+    else:
+        raise SystemExit("pass --paxos N or --twopc N")
+
+    if args.wave_profile:
+        wave_profile(kind, n, caps)
+    else:
+        stage_profile(kind, n, caps, args.target or default_target)
+
+
+if __name__ == "__main__":
+    main()

@@ -49,14 +49,18 @@ at compile time, over component domains — never on device.
 
 **Limits** (explicit, checked):
 
-* Ordered (FIFO) networks compile in ``closure="reachable"`` mode only
-  (queue-length bounds are harvested from the host exploration), and
-  lossy ordered networks are rejected (the reference drops arbitrary
-  flow positions, which the head-only queue encoding cannot express).
-  Channels encode as INTEGER QUEUES — base-(alphabet+1) numbers, head
-  at the least-significant digit; pop is a divide, push adds
-  ``code*base^len`` (network.rs:67, 221-244 semantics, including the
-  no-op-delivery exception of model.rs:317-319).
+* Ordered (FIFO) networks need per-channel queue-length bounds:
+  harvested by ``closure="reachable"`` from its host exploration, or
+  DECLARED via ``closure_queue_bound`` so overapprox mode compiles
+  with no host search (a protocol bound like ABD's clock/ops bounds;
+  under-declared bounds raise the truncation flag instead of silently
+  truncating). Lossy ordered networks are rejected (the reference
+  drops arbitrary flow positions, which the head-only queue encoding
+  cannot express). Channels encode as INTEGER QUEUES —
+  base-(alphabet+1) numbers, head at the least-significant digit; pop
+  is a divide, push adds ``code*base^len`` (network.rs:67, 221-244
+  semantics, including the no-op-delivery exception of
+  model.rs:317-319).
 * Component domains must close finitely; systems whose local closure
   diverges under overapproximation (e.g. paxos ballots, which are
   bounded only by *system*-level reachability) exceed ``max_domain``
@@ -207,6 +211,7 @@ def compile_actor_model(
     closure: str = "overapprox",
     closure_actor_bound: Optional[Callable[[int, Any], bool]] = None,
     closure_history_bound: Optional[Callable[[Any], bool]] = None,
+    closure_queue_bound=None,
     max_domain: int = 1 << 15,
     closure_max_states: int = 1 << 21,
 ) -> "CompiledActorEncoding":
@@ -235,6 +240,18 @@ def compile_actor_model(
       overapproximation (e.g. ABD timestamps, which are bounded only
       by system-level reachability). The host explores once; use it
       as the bootstrap / differential mode, not the scale mode.
+
+    ``closure_queue_bound`` makes ordered (FIFO) networks compile in
+    overapprox mode (VERDICT r4 item 4): a declared per-channel
+    queue-length bound — an ``int`` (uniform), a ``dict``
+    ``{(src, dst): depth}`` with int actor ids, or a callable
+    ``(src, dst) -> depth`` — replaces the queue bounds that
+    ``closure="reachable"`` harvests from its host exploration. A
+    protocol bound in the same family as ABD's clock/ops bounds: the
+    device prunes a push past the declared depth and raises the
+    truncation flag when the successor is in boundary, so an
+    under-declared bound fails loudly rather than silently
+    truncating. Ignored for unordered networks.
     """
     return CompiledActorEncoding(
         model,
@@ -245,6 +262,7 @@ def compile_actor_model(
         closure_history_bound,
         max_domain,
         closure_max_states,
+        closure_queue_bound=closure_queue_bound,
     )
 
 
@@ -259,18 +277,24 @@ class CompiledActorEncoding(EncodedModelBase):
         closure_history_bound,
         max_domain: int,
         closure_max_states: int,
+        closure_queue_bound=None,
     ):
         if closure_mode not in ("overapprox", "reachable"):
             raise ValueError(f"unknown closure mode {closure_mode!r}")
         self.ordered = isinstance(model._init_network, Ordered)
+        self._queue_bound_decl = closure_queue_bound
         if self.ordered:
             # FIFO queue lengths are bounded only by system-level
-            # reachability (like ABD timestamps): harvest the bound.
-            if closure_mode != "reachable":
+            # reachability (like ABD timestamps): either harvest the
+            # bound from a reachable-mode host exploration, or accept
+            # it as a DECLARED protocol bound so overapprox mode needs
+            # no host search at all (VERDICT r4 item 4).
+            if closure_mode != "reachable" and closure_queue_bound is None:
                 raise ValueError(
-                    "ordered (FIFO) networks compile in "
-                    'closure="reachable" mode only (queue-length bounds '
-                    "are harvested from the host exploration)"
+                    "ordered (FIFO) networks need queue-length bounds: "
+                    'use closure="reachable" (harvested bounds) or pass '
+                    "closure_queue_bound (declared protocol bounds; "
+                    "under-declared bounds raise the truncation flag)"
                 )
             if model.lossy_network:
                 raise ValueError(
@@ -337,6 +361,17 @@ class CompiledActorEncoding(EncodedModelBase):
                 for name, fn in sorted(self.property_specs.items())
             ),
             spec_fp(self.boundary_spec),
+            # Ordered: the queue bounds shape the integer-queue layout
+            # (field widths), so two compilations differing only in
+            # declared bounds must not share a chunk program.
+            tuple(
+                sorted(
+                    (int(c[0]), int(c[1]), self.ch_q[c])
+                    for c in self.channels
+                )
+            )
+            if self.ordered
+            else None,
         )
 
     # -- closure ---------------------------------------------------------
@@ -416,6 +451,13 @@ class CompiledActorEncoding(EncodedModelBase):
         self._msg_tr: dict = {}    # (i, s, env) -> (s2, noop, sends, tmap)
         self._tmo_tr: dict = {}    # (i, s, t)  -> (s2, noop, sends, tmap)
         self._hist_tr: dict = {}   # (h, env|None, sends) -> h2
+        #: (i, s, env) pairs whose handler RAISED under overapprox
+        #: (possibly system-unreachable). Ordered networks must keep
+        #: these UNDELIVERABLE rather than forcing the usual noop-pop
+        #: (a raising handler is not a pop): if such a pair is
+        #: reachable, the host model raises there and the differential
+        #: replay flags the divergence — same contract as unordered.
+        self._raised_msg: set = set()
 
         def run_msg(i: int, s: Any, env: Envelope):
             key = (i, s, env)
@@ -442,6 +484,7 @@ class CompiledActorEncoding(EncodedModelBase):
                 # row; if the pair IS reachable the host model crashes
                 # identically and the differential replay flags it.
                 self._msg_tr[key] = (s, True, (), {})
+                self._raised_msg.add(key)
                 return
             noop = is_no_op(cow, out)
             sends, tmap = self._fold_commands(Id(i), out)
@@ -632,6 +675,20 @@ class CompiledActorEncoding(EncodedModelBase):
                     seen.add(ns)
                     queue.append(ns)
 
+    def _declared_queue_bound(self, ch) -> int:
+        """Resolve ``closure_queue_bound`` for channel ``ch`` =
+        (src, dst): int (uniform), {(src, dst): depth} (int actor
+        ids), or callable (src, dst) -> depth. 0 when undeclared."""
+        decl = self._queue_bound_decl
+        if decl is None:
+            return 0
+        if isinstance(decl, int):
+            return decl
+        key = (int(ch[0]), int(ch[1]))
+        if isinstance(decl, dict):
+            return int(decl.get(key, decl.get(ch, 0)))
+        return int(decl(*key))
+
     def _fold_commands(self, id: Id, out: Out):
         """Sends in emission order + net timer effect (last op wins,
         mirroring _process_commands's sequential set algebra)."""
@@ -696,11 +753,44 @@ class CompiledActorEncoding(EncodedModelBase):
                 )
                 self.ch_msgs[ch] = msgs
                 self.ch_code[ch] = {m: j + 1 for j, m in enumerate(msgs)}
-            #: per channel: harvested queue-length bound and base
-            self.ch_q = {
-                ch: max(1, self._q_bound.get(ch, 0))
-                for ch in self.channels
-            }
+            #: per channel: queue-length bound (harvested in reachable
+            #: mode, declared via closure_queue_bound in overapprox
+            #: mode; with both, the max wins so a declared bound can
+            #: never shrink below what the host exploration observed)
+            #: and base
+            harvested = getattr(self, "_q_bound", {})
+            self.ch_q = {}
+            for ch in self.channels:
+                q = max(
+                    1,
+                    harvested.get(ch, 0),
+                    self._declared_queue_bound(ch),
+                )
+                # A DECLARED (not harvested) bound is a safety
+                # ceiling, not an observed depth: cap it to the
+                # deepest queue the 32-bit lane can hold at this
+                # channel's alphabet. If the cap ever truncates a
+                # reachable queue, the engines' truncation flag
+                # raises — loud, never silent.
+                if q > harvested.get(ch, 0):
+                    base = len(self.ch_msgs[ch]) + 1
+                    fit = q
+                    while fit > 1 and (base**fit - 1).bit_length() > 32:
+                        fit -= 1
+                    if fit < q and fit > harvested.get(ch, 0):
+                        import warnings
+
+                        warnings.warn(
+                            f"ordered channel {ch}: declared queue "
+                            f"bound {q} needs more than one uint32 "
+                            f"lane at alphabet {base - 1}; capped to "
+                            f"{fit} (a reachable queue beyond the cap "
+                            "raises the truncation error)",
+                            RuntimeWarning,
+                            stacklevel=2,
+                        )
+                        q = fit
+                self.ch_q[ch] = q
             self.ch_base = {
                 ch: len(self.ch_msgs[ch]) + 1 for ch in self.channels
             }
@@ -837,9 +927,14 @@ class CompiledActorEncoding(EncodedModelBase):
                     i, tr, force=self.ordered
                 )
                 if self.ordered:
-                    # Ordered records history on no-op pops too.
-                    noop[si] = False
-                    hcl[si] = cls_idx[(env, tr[2])]
+                    if (i, s, env) in self._raised_msg:
+                        # A raising handler is NOT a pop: keep the
+                        # row undeliverable (see _raised_msg notes).
+                        noop[si] = True
+                    else:
+                        # Ordered records history on no-op pops too.
+                        noop[si] = False
+                        hcl[si] = cls_idx[(env, tr[2])]
                 elif not noop[si]:
                     hcl[si] = cls_idx[(env, tr[2])]
             self.tbl_deliver.append(
@@ -879,19 +974,19 @@ class CompiledActorEncoding(EncodedModelBase):
         # parallel missing-mask and surfaced through the engines'
         # truncation flag; defaulting them to history 0 silently
         # corrupted post-violation successors (ADVICE r4).
-        self.tbl_history = np.zeros((len(self.H), n_cls), np.uint32)
-        self.tbl_history_missing = np.ones((len(self.H), n_cls), bool)
+        # Only the PACKED form is kept: history index in bits 0-30
+        # (bounded far below 2^31 by max_domain), missing flag in bit
+        # 31 — one gather serves both in the per-pair/per-slot step.
+        hist = np.zeros((len(self.H), n_cls), np.uint32)
+        missing = np.ones((len(self.H), n_cls), bool)
         for hi, h in enumerate(self.H):
             for ci, cls in enumerate(classes):
                 h2 = self._hist_tr.get((h, cls[0], cls[1]))
                 if h2 is not None:
-                    self.tbl_history[hi, ci] = self.hidx[h2]
-                    self.tbl_history_missing[hi, ci] = False
-        # Hot-path form: missing flag packed into bit 31 (history
-        # indices are bounded far below 2^31 by max_domain), so the
-        # per-pair/per-slot step pays ONE history gather, not two.
-        self.tbl_history_packed = self.tbl_history | (
-            self.tbl_history_missing.astype(np.uint32) << 31
+                    hist[hi, ci] = self.hidx[h2]
+                    missing[hi, ci] = False
+        self.tbl_history_packed = hist | (
+            missing.astype(np.uint32) << 31
         )
         self.n_cls = n_cls
         self._build_sparse_tables()
@@ -1022,6 +1117,25 @@ class CompiledActorEncoding(EncodedModelBase):
         """Lets the sparse engine skip the per-pair boundary pass and
         the terminal scatter-back when no boundary spec exists."""
         return self.boundary_spec is None
+
+    @property
+    def pair_width_hint(self):
+        """Static bound on enabled slots per state for the sparse
+        engine's per-row peel. Ordered networks have a tight one: only
+        each channel's HEAD is deliverable (one deliver slot per
+        channel), plus armed timers and crash slots — far below the
+        K = |E| deliver-slot universe (ABD 2c/3s: 16 vs K=110; the
+        unhinted EV=K sizing OOMed the engine's pair buffers).
+        Unordered networks have no useful static bound (any present
+        envelope is deliverable): None defers to the engine default."""
+        if not self.ordered:
+            return None
+        return max(
+            1,
+            len(self.channels)
+            + len(self.timeout_slots)
+            + len(self.crash_slots),
+        )
 
     def enabled_mask_vec(self, vec):
         """bool[A]: present/armed AND the precomputed no-op tables —
@@ -1334,7 +1448,8 @@ class CompiledActorEncoding(EncodedModelBase):
                 if len(flow) > self.ch_q[ch]:
                     raise ValueError(
                         f"channel {ch} queue depth {len(flow)} exceeds "
-                        f"the harvested bound {self.ch_q[ch]}"
+                        f"the queue bound {self.ch_q[ch]} (harvested or "
+                        "declared via closure_queue_bound)"
                     )
                 base = self.ch_base[ch]
                 q = 0

@@ -174,3 +174,27 @@ class RegisterServer(Actor):
         self.inner.on_timeout(id, inner_cow, timer, out)
         if inner_cow.owned:
             state.set(ServerState(inner_cow.value))
+
+
+def register_specs(default_value=DEFAULT_VALUE):
+    """Device property specs for the register test-actor family
+    (single-copy, ABD): the standard linearizable / value-chosen pair
+    every register example checks (single-copy-register.rs:73-91,
+    linearizable-register.rs:243-257), as actor-compiler specs
+    (actor/compile.py) usable with any register-shaped ActorModel."""
+
+    def linearizable(ctx, jnp):
+        return (
+            ctx.history_value(
+                lambda h: int(h.serialized_history() is not None)
+            )
+            == 1
+        )
+
+    def value_chosen(ctx, jnp):
+        return ctx.network_any(
+            lambda env: isinstance(env.msg, GetOk)
+            and env.msg.value != default_value
+        )
+
+    return {"linearizable": linearizable, "value chosen": value_chosen}

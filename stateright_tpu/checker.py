@@ -71,7 +71,14 @@ class CheckerBuilder:
         return self
 
     def threads(self, n: int) -> "CheckerBuilder":
-        """API parity with checker.rs:253-258; see module docstring."""
+        """Worker threads for the host BFS (checker.rs:253-258):
+        ``spawn_bfs`` runs n workers over the shared pending deque in
+        1,500-state blocks (the reference's work-share granularity).
+        Counts and the discovered property SET match the sequential
+        run; which state discovers a property can differ run-to-run,
+        as in the reference's thread race. Note CPython's GIL: on
+        pure-Python models this is parity, not speedup — device
+        engines (spawn_tpu*) are the parallelism story here."""
         self._threads = n
         return self
 

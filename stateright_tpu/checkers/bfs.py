@@ -1,4 +1,4 @@
-"""Host breadth-first checker — the sequential correctness oracle.
+"""Host breadth-first checker — the host correctness oracle.
 
 Re-implements the semantics of the reference's parallel BFS
 (stateright src/checker/bfs.rs): FIFO frontier, fingerprint-keyed
@@ -8,15 +8,23 @@ revisit false-negative (bfs.rs:285-303), terminal-state eventually
 counterexamples (bfs.rs:317-324), and early exit once every property
 has a discovery or the state target is reached (bfs.rs:128-145).
 
-Where the reference gets parallelism from worker threads + a
-work-stealing job market, this host engine is deliberately sequential:
-it exists to define ground truth for the vectorized TPU engine
-(:mod:`stateright_tpu.checkers.tpu`), which runs the same wave
-semantics as device kernels.
+``CheckerBuilder.threads(n)`` spawns n worker threads over a shared
+pending deque in blocks of 1,500 states — the reference's work-share
+granularity (bfs.rs:124, job_market.rs:66-147). The model callbacks
+(actions/next_state/properties) run outside the lock; dedup, counter
+updates, and discovery recording apply under it, so counts are exact
+and the discovered property SET matches the sequential run (which
+state discovers a property first can differ between runs — the same
+race the reference's worker threads have). CPython's GIL means the
+speedup is real only where the model's callbacks release it (C-backed
+hashing, numpy); on pure-Python models threads(n) is parity, not
+speed — the vectorized TPU engines are this framework's parallelism
+story (:mod:`stateright_tpu.checkers.tpu`).
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from typing import Optional
@@ -27,6 +35,9 @@ from ..fingerprint import fingerprint
 from ..path import Path
 from ..report import ReportData, Reporter
 from .common import ParentTraceMixin
+
+#: states handed to a worker per lock acquisition (bfs.rs:124).
+JOB_BLOCK = 1500
 
 
 class BfsChecker(ParentTraceMixin, Checker):
@@ -59,6 +70,10 @@ class BfsChecker(ParentTraceMixin, Checker):
                 self.generated[fp] = None
                 pending.append((init, fp, ebits_init, 1))
         self._unique_states = len(self.generated)
+
+        if self.builder._threads > 1:
+            self._run_parallel(pending, reporter)
+            return
 
         last_report = time.monotonic()
         while pending:
@@ -130,3 +145,150 @@ class BfsChecker(ParentTraceMixin, Checker):
                             done=False,
                         )
                     )
+
+    # -- worker-pool variant (threads(n), bfs.rs + job_market.rs) --------
+
+    def _run_parallel(
+        self, pending: deque, reporter: Optional[Reporter]
+    ) -> None:
+        """N workers over the shared pending deque in JOB_BLOCK
+        chunks: model callbacks run outside the lock, dedup /
+        counters / discovery recording under it. Early exit
+        (all-discovered, target_state_count) is approximate by up to
+        the blocks in flight — the same slack the reference's
+        work-sharing has (checker.rs "approximately", bfs.rs:128-145).
+        """
+        model = self.model
+        props = list(model.properties())
+        target_states = self.builder._target_state_count
+        target_depth = self.builder._target_max_depth
+        visitor = self.builder._visitor
+
+        cv = threading.Condition()
+        run = {"active": 0, "stop": False}
+        errors: list = []
+
+        def evaluate(job):
+            """One job's model callbacks; touches NO shared state
+            (reads of self.generated for the visitor are safe: CPython
+            dict reads are atomic under the GIL and parents of a
+            popped state are never re-written)."""
+            state, fp, ebits, depth = job
+            discovered = []
+            for i, prop in enumerate(props):
+                if prop.expectation == Expectation.ALWAYS:
+                    if not prop.condition(model, state):
+                        discovered.append(prop.name)
+                elif prop.expectation == Expectation.SOMETIMES:
+                    if prop.condition(model, state):
+                        discovered.append(prop.name)
+                else:  # EVENTUALLY
+                    if ebits & (1 << i) and prop.condition(model, state):
+                        ebits &= ~(1 << i)
+            if visitor is not None:
+                visitor.visit(
+                    model,
+                    Path.from_fingerprints(
+                        model, self._reconstruct_fps(fp)
+                    ),
+                )
+            succs = []
+            is_terminal = True
+            if target_depth is None or depth < target_depth:
+                for action in model.actions(state):
+                    next_state = model.next_state(state, action)
+                    if next_state is None:
+                        continue
+                    if not model.within_boundary(next_state):
+                        continue
+                    is_terminal = False
+                    succs.append((next_state, fingerprint(next_state)))
+            else:
+                is_terminal = False  # depth-cut, not terminal
+            term_evt = (
+                [
+                    prop.name
+                    for i, prop in enumerate(props)
+                    if ebits & (1 << i)
+                ]
+                if is_terminal and ebits
+                else []
+            )
+            return fp, ebits, depth, discovered, succs, term_evt
+
+        def worker():
+            while True:
+                with cv:
+                    while (
+                        not pending
+                        and run["active"] > 0
+                        and not run["stop"]
+                    ):
+                        cv.wait(0.05)
+                    if run["stop"] or (
+                        not pending and run["active"] == 0
+                    ):
+                        cv.notify_all()
+                        return
+                    block = [
+                        pending.popleft()
+                        for _ in range(min(JOB_BLOCK, len(pending)))
+                    ]
+                    run["active"] += 1
+                try:
+                    results = [evaluate(j) for j in block]
+                except Exception as exc:  # propagate model panics
+                    with cv:
+                        errors.append(exc)
+                        run["stop"] = True
+                        run["active"] -= 1
+                        cv.notify_all()
+                    return
+                with cv:
+                    for fp, ebits, depth, disc, succs, term in results:
+                        self._max_depth = max(self._max_depth, depth)
+                        for name in disc:
+                            self._discover(name, fp)
+                        for next_state, next_fp in succs:
+                            self._total_states += 1
+                            if next_fp not in self.generated:
+                                self.generated[next_fp] = fp
+                                self._unique_states += 1
+                                pending.append(
+                                    (next_state, next_fp, ebits,
+                                     depth + 1)
+                                )
+                        for name in term:
+                            self._discover(name, fp)
+                    if self._all_discovered() or (
+                        target_states is not None
+                        and self._unique_states >= target_states
+                    ):
+                        run["stop"] = True
+                    run["active"] -= 1
+                    cv.notify_all()
+
+        workers = [
+            threading.Thread(target=worker, daemon=True)
+            for _ in range(self.builder._threads)
+        ]
+        for t in workers:
+            t.start()
+        delay = reporter.delay() if reporter is not None else 0.5
+        while any(t.is_alive() for t in workers):
+            for t in workers:
+                t.join(timeout=max(delay, 0.05))
+            if reporter is not None and any(
+                t.is_alive() for t in workers
+            ):
+                with cv:
+                    data = ReportData(
+                        total_states=self._total_states,
+                        unique_states=self._unique_states,
+                        max_depth=self._max_depth,
+                        duration_sec=self.duration_sec(),
+                        done=False,
+                    )
+                reporter.report_checking(data)
+        if errors:
+            raise errors[0]

@@ -660,26 +660,49 @@ class TpuBfsChecker(Checker):
                 ),
                 waves=int(s[4]),
             )
+            overflow_msg = None
             if bool(s[1]):
-                raise RuntimeError(
+                overflow_msg = (
                     f"visited table overflow (capacity={self.capacity}); "
                     "re-run with a larger capacity"
                 )
-            if bool(s[2]):
-                raise RuntimeError(
+            elif bool(s[2]):
+                overflow_msg = (
                     f"frontier overflow: a wave produced more than "
-                    f"{F} new states; re-run with a larger frontier_capacity"
+                    f"{F} new states; re-run with a larger "
+                    "frontier_capacity"
                 )
-            if bool(s[9]):
-                raise RuntimeError(self._cand_overflow_message())
-            if bool(s[10]):
-                raise RuntimeError(
-                    "encoding-bound overflow: a successor was pruned by an "
-                    "internal encoding bound (e.g. a compiled envelope "
-                    "count reached 128) — the state space would be "
-                    "silently truncated. Bound the model (boundary/"
-                    "closure bounds) or use an encoding with wider fields."
+            elif bool(s[9]):
+                overflow_msg = self._cand_overflow_message()
+            elif bool(s[10]):
+                overflow_msg = (
+                    "encoding-bound overflow: a successor was pruned by "
+                    "an internal encoding bound (e.g. a compiled envelope "
+                    "count reached 128, a declared FIFO queue bound, or "
+                    "an un-harvested history transition) — the state "
+                    "space would be silently truncated. Bound the model "
+                    "(boundary/closure bounds) or use an encoding with "
+                    "wider fields."
                 )
+            if overflow_msg is not None:
+                # Record discoveries BEFORE raising: with a
+                # violation-gated closure bound (e.g. the register
+                # models' linearizable-expansion history bound), the
+                # violating state's own successors are unrepresentable
+                # — truncation fires in the same chunk that finds the
+                # counterexample, and the counterexample is the thing
+                # the check exists to surface. It stays available on
+                # the checker (discoveries()/discovered_property_names)
+                # after catching the raise.
+                self._record_discoveries(s, props)
+                if self._discovered_fps:
+                    overflow_msg += (
+                        "  Discoveries recorded before truncation "
+                        f"(valid counterexamples): "
+                        f"{sorted(self._discovered_fps)} — accessible "
+                        "on the checker after catching this error."
+                    )
+                raise RuntimeError(overflow_msg)
             if not done:
                 self._maybe_warn_occupancy(self.metrics["occupancy"])
             if done:
@@ -704,15 +727,24 @@ class TpuBfsChecker(Checker):
             # REAL mid-run frontier/visited data (spawn, set the
             # attribute, then join).
             self._final_carry = carry
+        self._consume_extra_stats(s[11 + 3 * n_props :])
+        self._record_discoveries(s, props, reconstruct=True)
+
+    def _record_discoveries(self, s, props, reconstruct=False) -> None:
+        """Parse the cumulative discovery lanes out of a chunk's packed
+        stats (disc_found persists in the device carry, so ANY chunk's
+        stats hold the discoveries so far). Paths are reconstructed
+        only on the clean-completion call: on the overflow path the
+        parent log may be mid-wave."""
+        n_props = len(props)
         disc_found = s[11 : 11 + n_props]
         disc_lo = s[11 + n_props : 11 + 2 * n_props]
         disc_hi = s[11 + 2 * n_props : 11 + 3 * n_props]
-        self._consume_extra_stats(s[11 + 3 * n_props :])
         for i, prop in enumerate(props):
             if disc_found[i]:
                 fp = _fp_int(disc_lo[i], disc_hi[i])
                 self._discovered_fps[prop.name] = fp
-                if self.track_paths:
+                if reconstruct and self.track_paths:
                     self._discoveries[prop.name] = self._reconstruct(fp)
 
     def _lookup_programs(self, n0: int):

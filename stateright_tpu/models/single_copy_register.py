@@ -65,9 +65,40 @@ def single_copy_register_model(
     )
 
     def to_encoded():
-        from .single_copy_register_tpu import SingleCopyEncoded
+        from ..actor.network import UnorderedNonDuplicating
 
-        return SingleCopyEncoded(cfg, network)
+        if cfg.client_count <= 2 and isinstance(
+            model._init_network, UnorderedNonDuplicating
+        ):
+            from .single_copy_register_tpu import SingleCopyEncoded
+
+            return SingleCopyEncoded(cfg, network)
+        # Configurations beyond the hand encoding's envelope (e.g. the
+        # driver's `single-copy-register check 3`) go through the
+        # generic actor→encoding compiler with the register specs; the
+        # client loop bounds ops per thread at put_count+1, and the
+        # linearizable-expansion bound (see abd_encoded's
+        # history_bound rationale — sound for the ALWAYS property
+        # because a bounded-out history still enters the domain and
+        # trips the property before expansion stops) tames the
+        # tester-state combinatorics at 3 clients.
+        from ..actor.compile import compile_actor_model
+        from ..actor.register import register_specs
+
+        def history_bound(h) -> bool:
+            per_thread = dict(h.history_by_thread)
+            in_flight = dict(h.in_flight_by_thread)
+            for t, completed in per_thread.items():
+                ops = len(completed) + (1 if in_flight.get(t) else 0)
+                if ops > cfg.put_count + 1:
+                    return False
+            return h.serialized_history() is not None
+
+        return compile_actor_model(
+            model,
+            properties=register_specs(DEFAULT_VALUE),
+            closure_history_bound=history_bound,
+        )
 
     model.to_encoded = to_encoded
     model.add_actors(

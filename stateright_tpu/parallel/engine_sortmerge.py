@@ -228,6 +228,10 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                 B_c = min(B_user, NPg)
                 compaction = NPg > B_c
                 want = -(-NPg // self.tile_rows)
+                if compaction:
+                    # Keep the packed-append headroom ≤ B_c/4 (see
+                    # the single-chip engine's make_sparse_wave).
+                    want = max(want, -(-(4 * NPg) // max(B_c, 1)))
                 NT = _divisor_at_least(F_c, want) if compaction else 1
                 T = F_c // NT
                 R_src = (B_c + T * EV) if compaction else NPg
@@ -262,6 +266,13 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             both = (lo == jnp.uint32(_SENT)) & (hi == jnp.uint32(_SENT))
             return lo, jnp.where(both, jnp.uint32(_SENT - 1), hi)
 
+        # Unsorted append-only visited arrays (see the C_pad notes in
+        # checkers/tpu_sortmerge.py): the dedup merge sorts the
+        # concatenation anyway, so each shard just appends its wave
+        # winners' keys as a sentinel-padded F-row block at its
+        # running local-unique offset — no per-wave rebuild sort.
+        C_pad = C + F
+
         def seed_local(init_rows):
             me = lax.axis_index("shard").astype(jnp.uint32)
             lo0, hi0 = fingerprint_u32v(init_rows, jnp)
@@ -275,12 +286,23 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             n_mine = jnp.sum(mine).astype(jnp.uint32)
             fval = jnp.arange(F) < n_mine
             ebits = jnp.where(fval, jnp.uint32(ebits_init), jnp.uint32(0))
-            v_hi = jnp.where(mine, hi0, jnp.uint32(_SENT))
-            v_lo = jnp.where(mine, lo0, jnp.uint32(_SENT))
-            pad = C - v_hi.shape[0]
-            v_hi = jnp.concatenate([v_hi, jnp.full(pad, _SENT, jnp.uint32)])
-            v_lo = jnp.concatenate([v_lo, jnp.full(pad, _SENT, jnp.uint32)])
-            v_hi, v_lo = lax.sort((v_hi, v_lo), num_keys=2)
+            # Compact this shard's init keys to a dense prefix (the
+            # append invariant: rows [0, u_loc) are real keys) — a
+            # stable 1-key sort on the validity bit, NOT on a limb (a
+            # real key may equal the sentinel in one limb).
+            mk = jnp.where(mine, jnp.uint32(0), jnp.uint32(1))
+            _, sk_lo, sk_hi = lax.sort((mk, lo0, hi0), num_keys=1)
+            sk_lo = jnp.where(mine.sum() > jnp.arange(sk_lo.shape[0]),
+                              sk_lo, jnp.uint32(_SENT))
+            sk_hi = jnp.where(mine.sum() > jnp.arange(sk_hi.shape[0]),
+                              sk_hi, jnp.uint32(_SENT))
+            pad = C_pad - sk_lo.shape[0]
+            v_lo = jnp.concatenate(
+                [sk_lo, jnp.full(pad, _SENT, jnp.uint32)]
+            )
+            v_hi = jnp.concatenate(
+                [sk_hi, jnp.full(pad, _SENT, jnp.uint32)]
+            )
             return dict(
                 v_lo=v_lo,
                 v_hi=v_hi,
@@ -355,26 +377,10 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                 )
                 is_new = real & ~prev_same & (m_pos > 0)
                 new_count = jnp.sum(is_new)
-
-                u_hi = jnp.where(prev_same, jnp.uint32(_SENT), m_hi)
-                u_lo = jnp.where(prev_same, jnp.uint32(_SENT), m_lo)
-                u_hi, u_lo = lax.sort((u_hi, u_lo), num_keys=2)
-                if M <= C:
-                    v_hi_new = lax.dynamic_update_slice(
-                        c["v_hi"], u_hi, (0,)
-                    )
-                    v_lo_new = lax.dynamic_update_slice(
-                        c["v_lo"], u_lo, (0,)
-                    )
-                    overflow = overflow0
-                else:
-                    overflow = overflow0 | bool_any(
-                        ~(
-                            (u_hi[C] == jnp.uint32(_SENT))
-                            & (u_lo[C] == jnp.uint32(_SENT))
-                        )
-                    )
-                    v_hi_new, v_lo_new = u_hi[:C], u_lo[:C]
+                overflow = overflow0 | bool_any(
+                    c["u_loc"][0] + new_count.astype(jnp.uint32)
+                    > jnp.uint32(C)
+                )
 
                 nf_pos = jnp.where(is_new, m_pos, jnp.uint32(_SENT))
                 (nf_pos,) = lax.sort((nf_pos,), num_keys=1)
@@ -392,6 +398,22 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                     nf_valid[:, None], next_fe[:, :W], jnp.uint32(0)
                 )
                 next_ebits = jnp.where(nf_valid, next_fe[:, EB], 0)
+
+                # Visited append (unsorted visited design): winners'
+                # keys as one sentinel-padded block at this shard's
+                # running local-unique offset.
+                app_lo = jnp.where(
+                    nf_valid, next_fe[:, E], jnp.uint32(_SENT)
+                )
+                app_hi = jnp.where(
+                    nf_valid, next_fe[:, E + 1], jnp.uint32(_SENT)
+                )
+                v_lo_new = lax.dynamic_update_slice(
+                    c["v_lo"], app_lo, (c["u_loc"][0],)
+                )
+                v_hi_new = lax.dynamic_update_slice(
+                    c["v_hi"], app_hi, (c["u_loc"][0],)
+                )
 
                 if track_paths:
                     nc_lo = jnp.where(nf_valid, next_fe[:, E], 0)

@@ -133,22 +133,10 @@ def test_ping_pong_crashes_regenerated():
     assert sorted(tpu.discoveries()) == sorted(host.discoveries())
 
 
-def register_specs(default_value):
-    def linearizable(ctx, jnp):
-        return (
-            ctx.history_value(
-                lambda h: int(h.serialized_history() is not None)
-            )
-            == 1
-        )
-
-    def value_chosen(ctx, jnp):
-        return ctx.network_any(
-            lambda env: isinstance(env.msg, GetOk)
-            and env.msg.value != default_value
-        )
-
-    return {"linearizable": linearizable, "value chosen": value_chosen}
+# The register-family device specs now live in the library
+# (actor/register.py) so models can compile themselves; re-exported
+# here for the existing test call sites.
+from stateright_tpu.actor.register import register_specs  # noqa: E402
 
 
 def test_single_copy_regenerated_matches_hand_encoding():
@@ -499,6 +487,63 @@ def test_compiled_ordered_abd():
     assert sorted(tpu.discoveries()) == sorted(host.discoveries())
     p = tpu.discovery("value chosen")
     assert p is not None and len(p.actions()) >= 1
+
+
+def test_compiled_ordered_overapprox_declared_bounds():
+    """Ordered networks under bounded overapproximation (VERDICT r4
+    item 4): a DECLARED per-channel queue bound replaces the
+    reachable-mode host exploration entirely — same count, property
+    set, and replayable paths as the harvested-bounds compile."""
+    cfg = PingPongCfg(maintains_history=True, max_nat=3)
+    model = ping_pong_model(cfg).init_network(Network.new_ordered())
+    harvested = compile_actor_model(
+        model, closure="reachable", **ping_pong_specs(cfg)
+    )
+    bounds = {
+        (int(ch[0]), int(ch[1])): harvested.ch_q[ch]
+        for ch in harvested.channels
+    }
+    enc = compile_actor_model(
+        model,
+        closure="overapprox",
+        closure_queue_bound=bounds,
+        **ping_pong_specs(cfg),
+    )
+    assert enc.closure_mode == "overapprox"
+    host = model.checker().spawn_bfs().join()
+    assert_matches_host(model, enc, host.unique_state_count())
+    # A uniform int bound works too (max(harvested, declared) rule
+    # keeps the layout sound even when generous).
+    enc2 = compile_actor_model(
+        model,
+        closure="overapprox",
+        closure_queue_bound=max(bounds.values()),
+        **ping_pong_specs(cfg),
+    )
+    tpu = spawn_compiled(model, enc2, sparse=True).join()
+    assert tpu.unique_state_count() == host.unique_state_count()
+
+
+def test_compiled_ordered_overapprox_underdeclared_bound_is_loud():
+    """An under-declared queue bound must raise the truncation flag,
+    never silently verify a truncated space."""
+    cfg = PingPongCfg(maintains_history=True, max_nat=3)
+    model = ping_pong_model(cfg).init_network(Network.new_ordered())
+    enc = compile_actor_model(
+        model,
+        closure="overapprox",
+        closure_queue_bound=1,
+        **ping_pong_specs(cfg),
+    )
+    host = model.checker().spawn_bfs().join()
+    try:
+        c = spawn_compiled(model, enc).join()
+    except RuntimeError as exc:
+        assert "truncat" in str(exc) or "encoding-bound" in str(exc)
+    else:
+        # A bound of 1 may genuinely suffice for this protocol; the
+        # test then degenerates to the agreement check.
+        assert c.unique_state_count() == host.unique_state_count()
 
 
 def test_compiled_ordered_rejects_unsupported_modes():

@@ -104,6 +104,40 @@ def test_bfs_eventually_revisit_false_negative():
     assert checker.discovery("reaches 3") is None
 
 
+def test_bfs_threads_matches_sequential():
+    """threads(n) runs a real worker pool (bfs.rs + job_market.rs
+    work-share semantics): counts and the discovered property SET
+    must match the sequential oracle exactly on a full-space run."""
+    seq = LinearEquation(a=2, b=4, c=33).checker().spawn_bfs().join()
+    par = (
+        LinearEquation(a=2, b=4, c=33)
+        .checker()
+        .threads(4)
+        .spawn_bfs()
+        .join()
+    )
+    assert par.unique_state_count() == seq.unique_state_count() == 65536
+    assert sorted(par.discoveries()) == sorted(seq.discoveries())
+
+
+def test_bfs_threads_finds_discovery_and_replays():
+    par = (
+        LinearEquation(a=2, b=10, c=28)
+        .checker()
+        .threads(3)
+        .spawn_bfs()
+        .join()
+    )
+    path = par.assert_any_discovery("solvable")
+    x, y = path.last_state()
+    assert (2 * x + 10 * y) % 256 == 28
+
+
+def test_bfs_threads_propagates_model_panic():
+    with pytest.raises(PanickerError):
+        Panicker().checker().threads(4).spawn_bfs().join()
+
+
 def test_bfs_target_max_depth():
     checker = (
         LinearEquation(a=2, b=4, c=33)

@@ -24,15 +24,26 @@ import time
 REPS = 8
 
 
-def _timed(build, args, reps=REPS):
+def _timed_raw(build, args, reps=REPS):
+    """Time `reps` sequential applications of build inside one jitted
+    program. build(i, carry) -> carry MUST fold a FULL reduction of
+    each stage output back into the carry — folding a single element
+    lets XLA dead-code-eliminate the rest of the stage (the round-5
+    profiler bug: step+fp showed 1.5ms because only succ[0] was
+    live)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     def run(*arrs):
         out = lax.fori_loop(0, reps, build, arrs)
-        first = out[0] if isinstance(out, (tuple, list)) else out
-        return jnp.sum(first.reshape(-1)[:1].astype(jnp.uint32))
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        # Consume EVERY carry element — returning only out[0] lets XLA
+        # DCE stages that fold their work into a later carry slot.
+        return sum(
+            jnp.sum(a.reshape(-1)[:1].astype(jnp.uint32)) for a in out
+        )
 
     f = jax.jit(run)
     float(f(*args))  # compile + warm
@@ -41,7 +52,22 @@ def _timed(build, args, reps=REPS):
         t0 = time.monotonic()
         float(f(*args))
         best = min(best, time.monotonic() - t0)
-    return best / reps * 1000.0  # ms/op (incl. ~100ms/REPS sync share)
+    return best
+
+
+def _timed(build, args, reps=REPS):
+    """ms per op, empty-loop baseline (dispatch floor + carry
+    movement at the same shapes) subtracted."""
+    base = _timed_raw(lambda i, c: c, args, reps)
+    return (_timed_raw(build, args, reps) - base) / reps * 1000.0
+
+
+def _fold(x):
+    """Full-output reduction to defeat DCE: cheap relative to any
+    measured stage (one pass over x)."""
+    import jax.numpy as jnp
+
+    return jnp.sum(x.reshape(-1).astype(jnp.uint32)) % jnp.uint32(2)
 
 
 def _spawn(kind, n, caps, target=None, waves_per_sync=64):
@@ -104,6 +130,10 @@ def stage_profile(kind, n, caps, target):
     want_tiles = -(-NPg // c.tile_rows)
     if F_f == F:
         want_tiles = max(want_tiles, c.tiles)
+    if compaction:
+        # Mirror make_sparse_wave's packed-append headroom clamp so
+        # the profiled Ba/NT/T match what the engine actually runs.
+        want_tiles = max(want_tiles, -(-(4 * NPg) // max(B_p, 1)))
     NT = _divisor_at_least(F_f, want_tiles) if compaction else 1
     T = F_f // NT
     Ba = (B_p + T * EV) if compaction else NPg
@@ -126,17 +156,21 @@ def stage_profile(kind, n, caps, target):
                if p.expectation == Expectation.EVENTUALLY]
 
     results = {}
+    acc0 = jnp.zeros(8, jnp.uint32)
 
     # -- stage: property conditions over the frontier -------------------
     def s_props(i, a):
-        (fr,) = a
+        fr, acc = a
         fr = fr.at[0, 0].set(fr[0, 0] ^ i.astype(jnp.uint32))
         cond, eb, f_lo, f_hi = frontier_props(
             enc, props, evt_idx, fr, fval_f, ebits_f
         )
-        return (fr + f_lo[:, None].astype(jnp.uint32) % jnp.uint32(2),)
+        acc = acc.at[0].add(
+            _fold(cond) + _fold(eb) + _fold(f_lo) + _fold(f_hi)
+        )
+        return fr, acc
 
-    results["props(frontier)"] = _timed(s_props, (frontier_f,))
+    results["props(frontier)"] = _timed(s_props, (frontier_f, acc0))
 
     # -- stage: enabled mask only (the [F,K] predicate pass) ------------
     L = (K + 31) // 32
@@ -178,16 +212,17 @@ def stage_profile(kind, n, caps, target):
         return mask_bits(fr, fval_f)
 
     def s_mask(i, a):
-        (fr,) = a
+        fr, acc = a
         fr = fr.at[0, 0].set(fr[0, 0] ^ i.astype(jnp.uint32))
         bits, cnt = mask_only(fr)
-        return (fr + (cnt[0] % jnp.uint32(2)),)
+        acc = acc.at[0].add(_fold(bits) + _fold(cnt))
+        return fr, acc
 
-    results["enabled-mask [F,K]"] = _timed(s_mask, (frontier_f,))
+    results["enabled-mask [F,K]"] = _timed(s_mask, (frontier_f, acc0))
 
     # -- stage: full pair pipeline (mask + peel + compaction) -----------
     def s_pairs(i, a):
-        (fr,) = a
+        fr, acc = a
         fr = fr.at[0, 0].set(fr[0, 0] ^ i.astype(jnp.uint32))
         pidx, live, pslot, cnt, n_pairs, ovf, tmax = (
             sparse_pair_candidates(
@@ -196,9 +231,14 @@ def stage_profile(kind, n, caps, target):
                 mask_budget_cells=mb, Ba=Ba,
             )
         )
-        return (fr + (n_pairs % jnp.uint32(2)),)
+        acc = acc.at[0].add(
+            _fold(pidx) + _fold(pslot) + _fold(cnt) + n_pairs
+        )
+        return fr, acc
 
-    results["pairs(mask+peel+compact)"] = _timed(s_pairs, (frontier_f,))
+    results["pairs(mask+peel+compact)"] = _timed(
+        s_pairs, (frontier_f, acc0)
+    )
 
     # materialize real pairs once for the downstream stages
     pidx, live, pslot, cnt, n_pairs, ovf, tmax = jax.jit(
@@ -238,11 +278,11 @@ def stage_profile(kind, n, caps, target):
 
     if chunked:
         def s_stepfp(i, a):
-            fr, pi = a
+            fr, pi, acc = a
             pi = pi.at[0].set(pi[0] ^ (i.astype(jnp.uint32) & 1))
 
-            def fchunk(ti, acc):
-                cl, ch = acc
+            def fchunk(ti, fc_acc):
+                cl, ch = fc_acc
                 off = ti * Bc
                 lo, hi = eval_block(
                     fr,
@@ -260,16 +300,18 @@ def stage_profile(kind, n, caps, target):
                 (jnp.full(Ba, _SENT, jnp.uint32),
                  jnp.full(Ba, _SENT, jnp.uint32)),
             )
-            return fr, pi + (cl[0] % jnp.uint32(2))
+            acc = acc.at[0].add(_fold(cl) + _fold(ch))
+            return fr, pi, acc
     else:
         def s_stepfp(i, a):
-            fr, pi = a
+            fr, pi, acc = a
             pi = pi.at[0].set(pi[0] ^ (i.astype(jnp.uint32) & 1))
             lo, hi = eval_block(fr, pi, live, pslot)
-            return fr, pi + (lo[0] % jnp.uint32(2))
+            acc = acc.at[0].add(_fold(lo) + _fold(hi))
+            return fr, pi, acc
 
     results[f"step+fp ({Ba} pairs)"] = _timed(
-        s_stepfp, (frontier_f, pidx)
+        s_stepfp, (frontier_f, pidx, acc0)
     )
 
     # real candidate keys for the merge stages
@@ -282,7 +324,7 @@ def stage_profile(kind, n, caps, target):
 
     # -- stage: 3-lane merge sort --------------------------------------
     def s_merge3(i, a):
-        vh, vl, kh, kl = a
+        vh, vl, kh, kl, acc = a
         kh = kh.at[0].set(kh[0] ^ (i.astype(jnp.uint32) & 1))
         m_hi = jnp.concatenate([vh[:V_v], kh])
         m_lo = jnp.concatenate([vl[:V_v], kl])
@@ -291,51 +333,58 @@ def stage_profile(kind, n, caps, target):
             jnp.arange(1, Ba + 1, dtype=jnp.uint32),
         ])
         m_hi, m_lo, m_pos = lax.sort((m_hi, m_lo, m_pos), num_keys=2)
-        return vh, vl, kh + (m_pos[0] % jnp.uint32(2)), kl
+        acc = acc.at[0].add(_fold(m_hi) + _fold(m_lo) + _fold(m_pos))
+        return vh, vl, kh, kl, acc
 
     results[f"merge3 ({V_v}+{Ba})"] = _timed(
-        s_merge3, (v_hi_full, v_lo_full, ck_hi, ck_lo)
+        s_merge3, (v_hi_full, v_lo_full, ck_hi, ck_lo, acc0)
     )
 
-    # -- stage: 2-lane rebuild sort ------------------------------------
+    # -- stage: 2-lane rebuild sort (the cost the round-5 unsorted-
+    # visited append removed; kept for the ablation record) ------------
     def s_rebuild(i, a):
-        (uh, ul) = a
+        uh, ul, acc = a
         uh = uh.at[0].set(uh[0] ^ (i.astype(jnp.uint32) & 1))
         uh2, ul2 = lax.sort((uh, ul), num_keys=2)
-        return uh2, ul2
+        acc = acc.at[0].add(_fold(uh2) + _fold(ul2))
+        return uh, ul, acc
 
     u_hi = jnp.concatenate([v_hi_full[:V_v], ck_hi])
     u_lo = jnp.concatenate([v_lo_full[:V_v], ck_lo])
-    results[f"rebuild2 ({M})"] = _timed(s_rebuild, (u_hi, u_lo))
+    results[f"rebuild2 ({M})"] = _timed(s_rebuild, (u_hi, u_lo, acc0))
 
     # -- stage: 1-lane frontier compaction sort ------------------------
     def s_nfpos(i, a):
-        (pos,) = a
+        pos, acc = a
         pos = pos.at[0].set(pos[0] ^ (i.astype(jnp.uint32) & 1))
         (pos2,) = lax.sort((pos,), num_keys=1)
-        return (pos2,)
+        acc = acc.at[0].add(_fold(pos2))
+        return pos, acc
 
     nf_pos = jnp.arange(M, dtype=jnp.uint32)
-    results[f"nfpos1 ({M})"] = _timed(s_nfpos, (nf_pos,))
+    results[f"nfpos1 ({M})"] = _timed(s_nfpos, (nf_pos, acc0))
 
     # -- stage: fetch winners (gather + recompute successors) ----------
     def s_fetch(i, a):
-        fr, nf = a
+        fr, nf, acc = a
         nf = (nf + i.astype(jnp.uint32)) % jnp.uint32(Ba)
         pidx_w = pidx[nf]
         par_row = pidx_w // jnp.uint32(EV)
         succ_w, _, _ = step_pairs(fr[par_row], pslot[nf])
-        return fr, nf + (succ_w[0, 0] % jnp.uint32(2))
+        acc = acc.at[0].add(_fold(succ_w))
+        return fr, nf, acc
 
     nf_row = jnp.arange(F, dtype=jnp.uint32) % jnp.uint32(Ba)
-    results[f"fetch ({F} winners)"] = _timed(s_fetch, (frontier_f, nf_row))
+    results[f"fetch ({F} winners)"] = _timed(
+        s_fetch, (frontier_f, nf_row, acc0)
+    )
 
-    print(f"\n{'stage':42s} {'ms/wave':>9s}")
+    print(f"\n{'stage':42s} {'ms/wave':>9s}  (baseline-subtracted)")
     total = 0.0
     for k, v in results.items():
         print(f"  {k:40s} {v:9.2f}")
         total += v
-    print(f"  {'SUM (stages, incl per-rep sync share)':40s} {total:9.2f}")
+    print(f"  {'SUM (stage compute)':40s} {total:9.2f}")
 
 
 def wave_profile(kind, n, caps):

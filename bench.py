@@ -434,6 +434,21 @@ def main():
 
     _stderr(f"backend: {jax.devices()}")
 
+    # The host↔device sync floor: ANY blocking readback costs this
+    # much (the axon-tunnel RTT, ~110-130ms; PERF.md §sync-floor).
+    # The small driver-config lanes (2pc rm=3/5, increment_lock,
+    # single-copy, paxos 2c) complete in ~ONE such round trip warm —
+    # their states/sec measures the link, not the engine.
+    import jax.numpy as jnp
+    import numpy as _np
+
+    _tiny = jax.jit(lambda x: x + 1)
+    _np.asarray(_tiny(jnp.uint32(0)))
+    _t0 = time.monotonic()
+    _np.asarray(_tiny(jnp.uint32(1)))
+    sync_floor_ms = round((time.monotonic() - _t0) * 1000, 1)
+    _stderr(f"sync floor (blocking readback RTT): {sync_floor_ms} ms")
+
     host_sps = bench_host_oracle()
 
     detail = {}
@@ -469,6 +484,7 @@ def main():
                 "value": round(headline_sps),
                 "unit": "states/sec",
                 "vs_baseline": round(headline_sps / host_sps, 2),
+                "sync_floor_ms": sync_floor_ms,
                 "detail": detail,
             }
         )

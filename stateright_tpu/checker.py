@@ -15,7 +15,11 @@ Departures from the reference, by design:
   engine (``spawn_tpu``), where a whole frontier wave is one device
   program and scale-out is a sharded mesh, replacing the reference's
   thread pool + work-stealing job market (src/job_market.rs).
-* ``threads(n)`` is accepted for API parity and ignored by host engines.
+* ``threads(n)`` drives a real worker pool in the host BFS (1,500-state
+  work-share blocks over a shared pending deque, mirroring the
+  reference's job-market granularity) — though CPython's GIL keeps
+  pure-Python model callbacks serialized, so wall-clock gains are
+  bounded by the callbacks' native time (hashing, dataclass compare).
 """
 
 from __future__ import annotations
@@ -184,6 +188,7 @@ class Checker:
         self._unique_states = 0
         self._max_depth = 0
         self._done = False
+        self._run_error: Optional[Exception] = None
         self._started_at: Optional[float] = None
         self._finished_at: Optional[float] = None
 
@@ -195,9 +200,23 @@ class Checker:
 
     def _ensure_run(self, reporter: Optional[Reporter] = None) -> None:
         if self._done:
+            if self._run_error is not None:
+                raise self._run_error
             return
         self._started_at = time.monotonic()
-        self._run(reporter)
+        try:
+            self._run(reporter)
+        except Exception as exc:
+            # A failed run is terminal: remember the error and replay
+            # it on every later accessor instead of re-executing the
+            # whole search (which would raise the same error again
+            # after repaying the full runtime — and, on the TPU
+            # engines, would also discard discoveries recorded before
+            # an overflow raise).
+            self._finished_at = time.monotonic()
+            self._done = True
+            self._run_error = exc
+            raise
         self._finished_at = time.monotonic()
         self._done = True
 

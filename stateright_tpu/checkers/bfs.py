@@ -276,8 +276,12 @@ class BfsChecker(ParentTraceMixin, Checker):
             t.start()
         delay = reporter.delay() if reporter is not None else 0.5
         while any(t.is_alive() for t in workers):
+            # One deadline per report cycle (joining every worker with
+            # the full delay would stretch the cadence to
+            # n_threads × delay).
+            deadline = time.monotonic() + max(delay, 0.05)
             for t in workers:
-                t.join(timeout=max(delay, 0.05))
+                t.join(timeout=max(deadline - time.monotonic(), 0.01))
             if reporter is not None and any(
                 t.is_alive() for t in workers
             ):

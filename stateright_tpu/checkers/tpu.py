@@ -312,14 +312,26 @@ class TpuBfsChecker(Checker):
 
     def discovered_property_names(self) -> set:
         """Names with a discovery — available even with
-        ``track_paths=False`` (where full paths are not)."""
-        self._ensure_run()
+        ``track_paths=False`` (where full paths are not), and after a
+        run that raised (e.g. an encoding-bound overflow in the same
+        chunk that found the counterexample — the discovery, recorded
+        before the raise, is the thing the check exists to surface)."""
+        try:
+            self._ensure_run()
+        except RuntimeError:
+            if not self._discovered_fps:
+                raise
         return set(self._discovered_fps)
 
     def discovery_fingerprints(self) -> dict[str, int]:
         """Property name -> discovery-state fingerprint. The fast-mode
-        (track_paths=False) substitute for :meth:`discoveries`."""
-        self._ensure_run()
+        (track_paths=False) substitute for :meth:`discoveries`; like
+        :meth:`discovered_property_names`, survives a raising run."""
+        try:
+            self._ensure_run()
+        except RuntimeError:
+            if not self._discovered_fps:
+                raise
         return dict(self._discovered_fps)
 
     def discoveries(self):
@@ -699,8 +711,10 @@ class TpuBfsChecker(Checker):
                     overflow_msg += (
                         "  Discoveries recorded before truncation "
                         f"(valid counterexamples): "
-                        f"{sorted(self._discovered_fps)} — accessible "
-                        "on the checker after catching this error."
+                        f"{sorted(self._discovered_fps)} — read them "
+                        "via discovered_property_names() / "
+                        "discovery_fingerprints() after catching this "
+                        "error."
                     )
                 raise RuntimeError(overflow_msg)
             if not done:

@@ -208,36 +208,43 @@ def sparse_pair_candidates(enc, frontier_f, fval_f, expand, *, EV, B_p,
     keys = jnp.where(valid_g, pair_idx, jnp.uint32(_SENT)).reshape(NPg)
 
     if compaction:
-        # Tiled 1-lane packed-append compaction (the sparse analog of
-        # the dense tiled key compaction; sort is superlinear so NT
-        # small sorts beat one big one).
+        # Tiled packed-append compaction (the sparse analog of the
+        # dense tiled key compaction; sort is superlinear so NT small
+        # sorts beat one big one). The slot rides the sort as a VALUE
+        # lane so no post-compaction ``slots_flat[pidx]`` gather is
+        # needed (PERF.md §gathers: one Ba-row gather ≈ a whole extra
+        # sort).
         def tile_body(ti, acc):
-            pk, app_off, tmax = acc
+            pk, ps, app_off, tmax = acc
             off = ti * (T * EV)
             tk = lax.dynamic_slice(keys, (off,), (T * EV,))
+            ts = lax.dynamic_slice(slots_flat, (off,), (T * EV,))
             tc = jnp.sum(tk != jnp.uint32(_SENT), dtype=jnp.uint32)
             tmax = jnp.maximum(tmax, tc)
-            (sk,) = lax.sort((tk,), num_keys=1)
+            sk, ss = lax.sort((tk, ts), num_keys=1)
             pk = lax.dynamic_update_slice(pk, sk, (app_off,))
-            return pk, app_off + tc, tmax
+            ps = lax.dynamic_update_slice(ps, ss, (app_off,))
+            return pk, ps, app_off + tc, tmax
 
-        pk, _, tile_max = lax.fori_loop(
+        pk, psl, _, tile_max = lax.fori_loop(
             0,
             NT,
             tile_body,
             (
                 pv(jnp.full(Ba, _SENT, jnp.uint32)),
+                pv(jnp.zeros(Ba, jnp.uint32)),
                 pv(jnp.uint32(0)),
                 pv(jnp.uint32(0)),
             ),
         )
     else:
         pk = keys
+        psl = slots_flat
         tile_max = n_pairs
 
     live = pk != jnp.uint32(_SENT)
     pidx = jnp.where(live, pk, jnp.uint32(0))
-    pslot = slots_flat[pidx]
+    pslot = jnp.where(live, psl, jnp.uint32(0))
     return pidx, live, pslot, cnt, n_pairs, pair_ovf, tile_max
 
 
@@ -370,6 +377,9 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 "property indices < 32; reorder properties() so eventually "
                 f"properties come first (got index {max(evt_idx)})"
             )
+        # XLA:CPU needs a gather-arrangement workaround in the sparse
+        # fetch (see the pay_fetch branches below).
+        cpu_backend = jax.default_backend() == "cpu"
         K, W, F = enc.max_actions, enc.width, self.frontier_capacity
         C = self.capacity
         B_user = min(self.cand_capacity or F * K, F * K)
@@ -488,7 +498,15 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             frontier-compaction sort, and a sentinel-padded block
             APPEND of the winners' keys (the unsorted-visited design —
             see the C_pad notes above; the former 2-lane rebuild sort
-            is gone)."""
+            is gone).
+
+            ``fetch(nf_row)`` returns ``(state_rows, par_lo, par_hi,
+            row_ebits, key_lo, key_hi)`` — the winners' fingerprint
+            keys ride the SAME packed gather as their payload (round
+            5): a device trace showed 74% of chunk time in gather
+            fusions at ~12ns/row REGARDLESS of lane count, so each
+            same-index table must be one multi-lane gather, never N
+            scalar gathers (PERF.md §gathers)."""
             V_v = v_ladder[vc]
             M = V_v + B_eff
 
@@ -522,32 +540,41 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
 
                 # Compact the new states' candidate positions into the
                 # next frontier (new rows first, in candidate order).
+                # Fetch width NF: a wave can't produce more new states
+                # than it has candidates, so the fetch gathers (and the
+                # frontier write) shrink with the candidate budget at
+                # small classes; rows [NF, F) are statically zero.
+                NF = min(F, B_eff)
                 nf_pos = jnp.where(is_new, m_pos, jnp.uint32(_SENT))
                 (nf_pos,) = lax.sort((nf_pos,), num_keys=1)
-                if M >= F:
-                    nf_pos = nf_pos[:F]
-                else:
-                    nf_pos = jnp.concatenate(
-                        [nf_pos, jnp.full(F - M, _SENT, jnp.uint32)]
-                    )
-                nf_valid = jnp.arange(F) < new_count
+                # M = V_v + B_eff >= B_eff >= NF, so the slice always
+                # has enough rows.
+                nf_pos = nf_pos[:NF]
+                nf_valid = jnp.arange(NF) < new_count
                 f_overflow = c["f_overflow"] | (new_count > F)
                 nf_row = jnp.where(nf_valid, nf_pos - 1, jnp.uint32(0))
-                state_rows, par_lo, par_hi, row_ebits = fetch(nf_row)
-                next_frontier = jnp.where(
+                (state_rows, par_lo, par_hi, row_ebits,
+                 key_lo, key_hi) = fetch(nf_row)
+
+                def fpad(x, fill=0):
+                    if NF == F:
+                        return x
+                    pad_shape = (F - NF,) + x.shape[1:]
+                    return jnp.concatenate(
+                        [x, jnp.full(pad_shape, fill, x.dtype)]
+                    )
+
+                next_frontier = fpad(jnp.where(
                     nf_valid[:, None], state_rows, jnp.uint32(0)
-                )
-                next_ebits = jnp.where(nf_valid, row_ebits, 0)
+                ))
+                next_ebits = fpad(jnp.where(nf_valid, row_ebits, 0))
 
                 # Visited append: the winners' keys as one contiguous
                 # sentinel-padded block at the running unique-count
-                # offset (no sort, no scatter).
-                app_lo = jnp.where(
-                    nf_valid, ck_lo[nf_row], jnp.uint32(_SENT)
-                )
-                app_hi = jnp.where(
-                    nf_valid, ck_hi[nf_row], jnp.uint32(_SENT)
-                )
+                # offset (no sort, no scatter; keys came packed with
+                # the payload gather).
+                app_lo = jnp.where(nf_valid, key_lo, jnp.uint32(_SENT))
+                app_hi = jnp.where(nf_valid, key_hi, jnp.uint32(_SENT))
                 v_lo_new = lax.dynamic_update_slice(
                     c["v_lo"], app_lo, (c["new"],)
                 )
@@ -559,8 +586,8 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 # running offset (no scatter); rows past new_count are
                 # garbage that the next wave's block overwrites.
                 if track_paths:
-                    nc_lo = jnp.where(nf_valid, ck_lo[nf_row], 0)
-                    nc_hi = jnp.where(nf_valid, ck_hi[nf_row], 0)
+                    nc_lo = jnp.where(nf_valid, key_lo, 0)
+                    nc_hi = jnp.where(nf_valid, key_hi, 0)
                     np_lo = jnp.where(nf_valid, par_lo, 0)
                     np_hi = jnp.where(nf_valid, par_hi, 0)
                     off = (c["pl_n"],)
@@ -576,12 +603,12 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     pl_par_hi = lax.dynamic_update_slice(
                         c["pl_par_hi"], np_hi, off
                     )
-                    # Clamp to the F rows the block write actually
+                    # Clamp to the NF rows the block write actually
                     # wrote: on an f_overflow wave new_count can exceed
                     # F, and _run raises before reconstruction — but
                     # the live-count invariant should hold regardless.
                     pl_n = c["pl_n"] + jnp.minimum(
-                        new_count.astype(jnp.uint32), jnp.uint32(F)
+                        new_count.astype(jnp.uint32), jnp.uint32(NF)
                     )
                 else:
                     pl_child_lo = c["pl_child_lo"]
@@ -620,7 +647,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     pl_par_hi=pl_par_hi,
                     pl_n=pl_n,
                     frontier=next_frontier,
-                    fval=nf_valid & cont,
+                    fval=fpad(nf_valid, False) & cont,
                     ebits=next_ebits,
                     n_frontier=jnp.where(
                         cont, new_count.astype(jnp.uint32), jnp.uint32(0)
@@ -735,14 +762,28 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                         c_overflow = c["c_overflow"]
                         tile_max = n_cand
 
+                    # Packed fetch (PERF.md §gathers): candidate meta
+                    # (key limbs + source row) rides ONE 3-lane gather;
+                    # frontier-side meta (ebits + parent fp) another.
+                    meta3 = jnp.stack([ck_lo, ck_hi, crow], axis=1)
+                    fr_meta = jnp.stack(
+                        [ex["ebits"]]
+                        + ([ex["f_lo"], ex["f_hi"]] if track_paths
+                           else []),
+                        axis=1,
+                    )
+
                     def fetch(nf_row):
-                        srow = crow[nf_row]
-                        prow = srow // jnp.uint32(K)
+                        m = meta3[nf_row]
+                        srow = m[:, 2]
+                        q = fr_meta[srow // jnp.uint32(K)]
                         return (
                             flat[srow],
-                            ex["f_lo"][prow] if track_paths else None,
-                            ex["f_hi"][prow] if track_paths else None,
-                            ex["ebits"][prow],
+                            q[:, 1] if track_paths else None,
+                            q[:, 2] if track_paths else None,
+                            q[:, 0],
+                            m[:, 0],
+                            m[:, 1],
                         )
 
                     cand_B = Ba
@@ -763,9 +804,16 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 # Per-tile payload path (successor tensor too big to
                 # keep): expansion, fingerprinting, compaction, and a
                 # Bt-row payload gather all happen inside each tile.
+                # Payload lanes are PACKED into one [B_eff, EP] buffer
+                # (state, key limbs, ebits, parent fp) so the merge
+                # fetch is a single multi-lane gather (PERF.md
+                # §gathers); the key limbs are kept as separate 1-D
+                # arrays too — the merge sort concatenates those.
+                EP = W + 3 + (2 if track_paths else 0)
+
                 def tile_body(t, acc):
                     (
-                        ck_lo, ck_hi, cst, cplo, cphi, ceb,
+                        ck_lo, ck_hi, cpay,
                         dfound, dlo, dhi, n_cand, c_ovf, e_ovf, tmax,
                     ) = acc
                     off = t * T
@@ -793,31 +841,26 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                         (k_hi, k_lo, rows), num_keys=2
                     )
                     s_hi, s_lo, s_row = s_hi[:Bt], s_lo[:Bt], s_row[:Bt]
-                    st = flat[s_row]
                     prow = s_row // jnp.uint32(K)
+                    parts = [flat[s_row], s_lo[:, None], s_hi[:, None],
+                             ex["ebits"][prow][:, None]]
+                    if track_paths:
+                        parts += [ex["f_lo"][prow][:, None],
+                                  ex["f_hi"][prow][:, None]]
+                    blk = jnp.concatenate(parts, axis=1)
                     o = t * Bt
                     ck_lo = lax.dynamic_update_slice(ck_lo, s_lo, (o,))
                     ck_hi = lax.dynamic_update_slice(ck_hi, s_hi, (o,))
-                    cst = lax.dynamic_update_slice(cst, st, (o, 0))
-                    if track_paths:
-                        cplo = lax.dynamic_update_slice(
-                            cplo, ex["f_lo"][prow], (o,)
-                        )
-                        cphi = lax.dynamic_update_slice(
-                            cphi, ex["f_hi"][prow], (o,)
-                        )
-                    ceb = lax.dynamic_update_slice(
-                        ceb, ex["ebits"][prow], (o,)
-                    )
+                    cpay = lax.dynamic_update_slice(cpay, blk, (o, 0))
                     return (
-                        ck_lo, ck_hi, cst, cplo, cphi, ceb,
+                        ck_lo, ck_hi, cpay,
                         dfound, dlo, dhi,
                         n_cand + t_cand.astype(jnp.uint32), c_ovf, e_ovf,
                         tmax,
                     )
 
                 (
-                    ck_lo, ck_hi, b_state, b_par_lo, b_par_hi, b_ebits,
+                    ck_lo, ck_hi, b_pay,
                     disc_found, disc_lo, disc_hi, n_cand, c_overflow,
                     e_overflow, tile_max,
                 ) = lax.fori_loop(
@@ -827,10 +870,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     (
                         jnp.full(B_eff, _SENT, jnp.uint32),
                         jnp.full(B_eff, _SENT, jnp.uint32),
-                        jnp.zeros((B_eff, W), jnp.uint32),
-                        jnp.zeros(B_eff if track_paths else 0, jnp.uint32),
-                        jnp.zeros(B_eff if track_paths else 0, jnp.uint32),
-                        jnp.zeros(B_eff, jnp.uint32),
+                        jnp.zeros((B_eff, EP), jnp.uint32),
                         c["disc_found"],
                         c["disc_lo"],
                         c["disc_hi"],
@@ -842,11 +882,14 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 )
 
                 def fetch(nf_row):
+                    p = b_pay[nf_row]
                     return (
-                        b_state[nf_row],
-                        b_par_lo[nf_row] if track_paths else None,
-                        b_par_hi[nf_row] if track_paths else None,
-                        b_ebits[nf_row],
+                        p[:, :W],
+                        p[:, W + 3] if track_paths else None,
+                        p[:, W + 4] if track_paths else None,
+                        p[:, W + 2],
+                        p[:, W],
+                        p[:, W + 1],
                     )
 
                 return lax.switch(
@@ -935,6 +978,18 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 NC = -(-(Ba * W * 4) // self.flat_budget_bytes)
                 Bc = -(-Ba // NC)
                 Ba = NC * Bc  # pad so chunks tile it exactly
+            # Fetch mode (PERF.md §gathers): keep the [Ba, W+3] packed
+            # candidate payload (successor lanes + key limbs + parent
+            # row) alive through the merge when its PADDED residency —
+            # ~512 B/row on TPU regardless of lane count — fits the
+            # flat budget, so the winners' fetch is ONE multi-lane
+            # gather + one frontier-meta gather. Otherwise fetch
+            # recomputes winners' successors from a packed 4-lane
+            # (key_lo, key_hi, pair, slot) meta gather (the chunked
+            # path never materializes [Ba, W] at all).
+            pay_fetch = (not chunked) and (
+                Ba * 512 <= self.flat_budget_bytes
+            )
 
             def wave(c):
                 if target_depth is None:
@@ -975,8 +1030,8 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     )
 
                 def eval_pairs(pidx_b, live_b, slot_b):
-                    """fingerprint keys + validity (+ scan stats) for a
-                    block of compacted pairs."""
+                    """fingerprint keys + successors + validity (+ scan
+                    stats) for a block of compacted pairs."""
                     prow_b = pidx_b // jnp.uint32(EV)
                     succ_b, ptr_b, hard_b = step_pairs(
                         frontier_f[prow_b], slot_b
@@ -997,7 +1052,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     lo, hi = clamp_keys(lo, hi)
                     lo = jnp.where(ok, lo, jnp.uint32(_SENT))
                     hi = jnp.where(ok, hi, jnp.uint32(_SENT))
-                    return lo, hi, ok, prow_b, eov
+                    return lo, hi, ok, prow_b, eov, succ_b
 
                 if chunked:
                     # Chunked fingerprint pass: the [Ba, W] successor
@@ -1008,7 +1063,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                         pidx_b = lax.dynamic_slice(pidx, (off,), (Bc,))
                         live_b = lax.dynamic_slice(live, (off,), (Bc,))
                         slot_b = lax.dynamic_slice(pslot, (off,), (Bc,))
-                        lo, hi, ok, prow_b, ev = eval_pairs(
+                        lo, hi, ok, prow_b, ev, _succ = eval_pairs(
                             pidx_b, live_b, slot_b
                         )
                         cl = lax.dynamic_update_slice(cl, lo, (off,))
@@ -1041,9 +1096,24 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                         has_succ = cnt > 0
                         n_cand = n_pairs
                 else:
-                    ck_lo, ck_hi, pair_ok, prow, eov = eval_pairs(
+                    ck_lo, ck_hi, pair_ok, prow, eov, succ = eval_pairs(
                         pidx, live, pslot
                     )
+                    if pay_fetch and not cpu_backend:
+                        # Without this barrier XLA fuses the pair-step
+                        # producer (frontier/params/sendtab gathers +
+                        # the whole transition ALU) separately into
+                        # BOTH consumers — the fingerprint path and
+                        # the payload concat — running every pair-stage
+                        # gather twice per wave (seen in the round-5
+                        # device trace as duplicate [Ba, *] gather
+                        # fusions). Materialize once; the extra
+                        # [Ba, W] write is bandwidth-cheap.
+                        ck_lo, ck_hi, succ, prow = (
+                            lax.optimization_barrier(
+                                (ck_lo, ck_hi, succ, prow)
+                            )
+                        )
                     e_overflow = e_overflow | eov
                     if needs_scan:
                         # Terminal = no surviving successor at all:
@@ -1067,22 +1137,73 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     c["disc_found"], c["disc_lo"], c["disc_hi"],
                 )
 
-                def fetch(nf_row):
-                    # Winners' successors are recomputed from their
-                    # (row, slot) pairs — cheaper than keeping [Ba, W]
-                    # alive through the merge, and exact by the
-                    # SparseEncodedModel purity contract.
-                    pidx_w = pidx[nf_row]
-                    par_row = pidx_w // jnp.uint32(EV)
-                    succ_w, _, _ = step_pairs(
-                        frontier_f[par_row], pslot[nf_row]
+                if pay_fetch and not cpu_backend:
+                    # Packed candidate payload kept alive through the
+                    # merge: winners' states, key limbs, and parent
+                    # meta (ebits + parent fp, pre-gathered per pair as
+                    # one [Ba, 1-3] gather) ride ONE multi-lane fetch
+                    # gather — on TPU a gather costs ~12ns/row
+                    # regardless of lane count (PERF.md §gathers).
+                    fr_meta = jnp.stack(
+                        [eb] + ([f_lo, f_hi] if track_paths else []),
+                        axis=1,
                     )
-                    return (
-                        succ_w,
-                        f_lo[par_row] if track_paths else None,
-                        f_hi[par_row] if track_paths else None,
-                        eb[par_row],
+                    pay = jnp.concatenate(
+                        [succ, ck_lo[:, None], ck_hi[:, None],
+                         fr_meta[prow]],
+                        axis=1,
                     )
+
+                    def fetch(nf_row):
+                        p = pay[nf_row]
+                        return (
+                            p[:, :W],
+                            p[:, W + 3] if track_paths else None,
+                            p[:, W + 4] if track_paths else None,
+                            p[:, W + 2],
+                            p[:, W],
+                            p[:, W + 1],
+                        )
+                elif pay_fetch:
+                    # XLA:CPU workaround (round 5): gathering a
+                    # CONCATENATED [Ba, W+k] payload in this sparse
+                    # program livelocks the XLA:CPU thunk runtime
+                    # inside the chunk while-loop (one Eigen thread
+                    # spins forever; bisected to exactly this op
+                    # arrangement — the same packed fetch is fine in
+                    # the dense wave, and fine on TPU). Same math,
+                    # separate gathers: the successor tensor is still
+                    # reused (no transition recompute).
+                    def fetch(nf_row):
+                        par_row = pidx[nf_row] // jnp.uint32(EV)
+                        return (
+                            succ[nf_row],
+                            f_lo[par_row] if track_paths else None,
+                            f_hi[par_row] if track_paths else None,
+                            eb[par_row],
+                            ck_lo[nf_row],
+                            ck_hi[nf_row],
+                        )
+                else:
+                    # Recompute mode (chunked or over-budget payload):
+                    # winners' successors are recomputed from their
+                    # (row, slot) pairs — exact by the
+                    # SparseEncodedModel purity contract. Index-feeding
+                    # gathers stay 1-D (the XLA:CPU hazard above).
+                    def fetch(nf_row):
+                        pidx_w = pidx[nf_row]
+                        par_row = pidx_w // jnp.uint32(EV)
+                        succ_w, _, _ = step_pairs(
+                            frontier_f[par_row], pslot[nf_row]
+                        )
+                        return (
+                            succ_w,
+                            f_lo[par_row] if track_paths else None,
+                            f_hi[par_row] if track_paths else None,
+                            eb[par_row],
+                            ck_lo[nf_row],
+                            ck_hi[nf_row],
+                        )
 
                 return lax.switch(
                     v_class,

@@ -292,10 +292,11 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             # real key may equal the sentinel in one limb).
             mk = jnp.where(mine, jnp.uint32(0), jnp.uint32(1))
             _, sk_lo, sk_hi = lax.sort((mk, lo0, hi0), num_keys=1)
-            sk_lo = jnp.where(mine.sum() > jnp.arange(sk_lo.shape[0]),
-                              sk_lo, jnp.uint32(_SENT))
-            sk_hi = jnp.where(mine.sum() > jnp.arange(sk_hi.shape[0]),
-                              sk_hi, jnp.uint32(_SENT))
+            live_pref = n_mine > jnp.arange(
+                sk_lo.shape[0], dtype=jnp.uint32
+            )
+            sk_lo = jnp.where(live_pref, sk_lo, jnp.uint32(_SENT))
+            sk_hi = jnp.where(live_pref, sk_hi, jnp.uint32(_SENT))
             pad = C_pad - sk_lo.shape[0]
             v_lo = jnp.concatenate(
                 [sk_lo, jnp.full(pad, _SENT, jnp.uint32)]
@@ -678,14 +679,6 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                     )
                     cand_valid = pair_ok
                     cand_par = prow
-
-                    def cand_rows(srow):
-                        if cand_state is not None:
-                            return cand_state[srow]
-                        succ_t, _, _ = step_pairs(
-                            frontier_c[cand_par[srow]], pslot[srow]
-                        )
-                        return succ_t
                 else:
                     ex = expand_frontier(
                         enc, props, evt_idx, frontier_c, fval_c,
@@ -699,9 +692,6 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                     k_lo, k_hi = fingerprint_u32v(cand_state, jnp)
                     k_lo, k_hi = clamp_keys(k_lo, k_hi)
                     cand_par = None  # parent row = candidate // K
-
-                    def cand_rows(srow):
-                        return cand_state[srow]
 
                 # Discoveries: local per-wave hits, globally folded
                 # (the lowest hitting shard index wins, mirroring
@@ -749,32 +739,83 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                 route_ovf = jnp.any(counts > jnp.uint32(Bd_c))
                 c_overflow = c_overflow | bool_any(route_ovf)
 
+                # Build the send tiles from ONE routed payload gather +
+                # per-destination SLICES (PERF.md §gathers: TPU gathers
+                # cost ~12ns/row regardless of lane count, so the old
+                # per-destination payload/fp/ebits/key gathers — ~6×
+                # R_src rows per wave — collapse into a single
+                # [R_src, E+2] multi-lane gather; slices are free).
+                # Parent meta (ebits + parent fp) packs into the same
+                # payload: broadcast for dense (candidate // K is a
+                # K-fold repeat, no gather), one packed gather for
+                # sparse. Buffers are padded by one tile so a
+                # destination run ending at R_src slices without the
+                # dynamic_slice start-clamp silently shifting live rows.
+                fr_meta = jnp.stack(
+                    [ex["ebits"]]
+                    + ([ex["f_lo"], ex["f_hi"]] if track_paths else []),
+                    axis=1,
+                )
+                if cand_par is None:
+                    pmeta = jnp.broadcast_to(
+                        fr_meta[:, None, :],
+                        (F_c, K, fr_meta.shape[1]),
+                    ).reshape(R_src, fr_meta.shape[1])
+                else:
+                    pmeta = fr_meta[cand_par]
+                if cand_state is not None:
+                    parts = [cand_state]
+                    if track_paths:
+                        parts += [pmeta[:, 1:2], pmeta[:, 2:3]]
+                    parts += [pmeta[:, 0:1], k_lo[:, None],
+                              k_hi[:, None]]
+                    cpay = jnp.concatenate(parts, axis=1)
+                    spay = jnp.pad(
+                        cpay[s_row], ((0, Bd_c), (0, 0))
+                    )
+
+                    def dest_block(start):
+                        return lax.dynamic_slice(
+                            spay, (start, jnp.uint32(0)), (Bd_c, E + 2)
+                        )
+                else:
+                    # Chunked sparse: successors are never materialized
+                    # at [R_src, W]; recompute per destination from a
+                    # packed (pair, slot, meta, key) gather.
+                    mparts = [pidx[:, None], pslot[:, None], pmeta]
+                    smeta = jnp.pad(
+                        jnp.concatenate(mparts, axis=1)[s_row],
+                        ((0, Bd_c), (0, 0)),
+                    )
+                    skeys = jnp.pad(
+                        jnp.stack([s_lo, s_hi], axis=1),
+                        ((0, Bd_c), (0, 0)),
+                    )
+                    NM = 2 + fr_meta.shape[1]
+
+                    def dest_block(start):
+                        z = jnp.uint32(0)
+                        m = lax.dynamic_slice(
+                            smeta, (start, z), (Bd_c, NM)
+                        )
+                        kk = lax.dynamic_slice(
+                            skeys, (start, z), (Bd_c, 2)
+                        )
+                        par = m[:, 0] // jnp.uint32(EV)
+                        succ_t, _, _ = step_pairs(
+                            frontier_c[par], m[:, 1]
+                        )
+                        parts = [succ_t]
+                        if track_paths:
+                            parts += [m[:, 3:4], m[:, 4:5]]
+                        parts += [m[:, 2:3], kk[:, 0:1], kk[:, 1:2]]
+                        return jnp.concatenate(parts, axis=1)
+
                 def dest_tile(d):
                     start = starts[d]
                     cnt_d = counts[d]
                     live_d = jnp.arange(Bd_c, dtype=jnp.uint32) < cnt_d
-                    idx = jnp.clip(
-                        start + jnp.arange(Bd_c, dtype=jnp.uint32),
-                        0,
-                        jnp.uint32(R_src - 1),
-                    )
-                    srow = s_row[idx]
-                    if cand_par is None:
-                        par = srow // jnp.uint32(K)
-                    else:
-                        par = cand_par[srow]
-                    parts = [cand_rows(srow)]
-                    if track_paths:
-                        parts += [
-                            ex["f_lo"][par][:, None],
-                            ex["f_hi"][par][:, None],
-                        ]
-                    parts.append(ex["ebits"][par][:, None])
-                    parts += [
-                        jnp.where(live_d, s_lo[idx], 0)[:, None],
-                        jnp.where(live_d, s_hi[idx], 0)[:, None],
-                    ]
-                    tile = jnp.concatenate(parts, axis=1)
+                    tile = dest_block(start)
                     return jnp.where(
                         live_d[:, None], tile, jnp.uint32(0)
                     )

@@ -151,3 +151,38 @@ def test_sortmerge_tiled_matches_untiled(tiles):
     for name, path in tiled.discoveries().items():
         prop = tiled.model.property_by_name(name)
         assert prop.condition(tiled.model, path.last_state())
+
+
+def test_discoveries_survive_overflow_raise():
+    """A discovery recorded before a capacity-overflow raise stays
+    readable through the public accessors, and later accessors replay
+    the stored error instead of re-running the whole search (round-5
+    review finding: the advertised recovery path was unreachable)."""
+    from stateright_tpu.models.increment import Increment
+
+    total = (
+        Increment(thread_count=4)
+        .checker()
+        .spawn_bfs()
+        .join()
+        .unique_state_count()
+    )
+    c = Increment(thread_count=4).checker().spawn_tpu_sortmerge(
+        capacity=total - 10,
+        frontier_capacity=1 << 12,
+        cand_capacity=1 << 14,
+        track_paths=False,
+    )
+    with pytest.raises(RuntimeError, match="table overflow"):
+        c.join()
+    # The 'fin' violation is found long before the visited array fills;
+    # the names/fingerprints survive the raise.
+    assert "fin" in c.discovered_property_names()
+    assert c.discovery_fingerprints()["fin"] != 0
+    # Non-discovery accessors replay the SAME error, immediately.
+    import time as _time
+
+    t0 = _time.monotonic()
+    with pytest.raises(RuntimeError, match="table overflow"):
+        c.unique_state_count()
+    assert _time.monotonic() - t0 < 1.0

@@ -364,20 +364,54 @@ def stage_profile(kind, n, caps, target):
     nf_pos = jnp.arange(M, dtype=jnp.uint32)
     results[f"nfpos1 ({M})"] = _timed(s_nfpos, (nf_pos, acc0))
 
-    # -- stage: fetch winners (gather + recompute successors) ----------
-    def s_fetch(i, a):
-        fr, nf, acc = a
-        nf = (nf + i.astype(jnp.uint32)) % jnp.uint32(Ba)
-        pidx_w = pidx[nf]
-        par_row = pidx_w // jnp.uint32(EV)
-        succ_w, _, _ = step_pairs(fr[par_row], pslot[nf])
-        acc = acc.at[0].add(_fold(succ_w))
-        return fr, nf, acc
+    # -- stage: fetch winners (round 5: packed gathers — payload mode
+    # when the padded [Ba, W+3] fits the flat budget, else a packed
+    # 4-lane meta gather + successor recompute; PERF.md §gathers) -----
+    pay_fetch = (not chunked) and (Ba * 512 <= c.flat_budget_bytes)
+    ebits_dummy = jnp.zeros(F_f, jnp.uint32)
 
-    nf_row = jnp.arange(F, dtype=jnp.uint32) % jnp.uint32(Ba)
-    results[f"fetch ({F} winners)"] = _timed(
-        s_fetch, (frontier_f, nf_row, acc0)
-    )
+    if pay_fetch:
+        succ_all = jax.jit(
+            lambda fr: step_pairs(
+                fr[pidx // jnp.uint32(EV)], pslot
+            )[0]
+        )(frontier_f)
+        pay = jnp.concatenate(
+            [succ_all, ck_lo[:, None], ck_hi[:, None],
+             (pidx // jnp.uint32(EV))[:, None]],
+            axis=1,
+        )
+        W_ = W
+
+        def s_fetch(i, a):
+            py, eb_, nf, acc = a
+            nf = (nf + i.astype(jnp.uint32)) % jnp.uint32(Ba)
+            p = py[nf]
+            q = eb_[p[:, W_ + 2]]
+            acc = acc.at[0].add(_fold(p) + _fold(q))
+            return py, eb_, nf, acc
+
+        nf_row = jnp.arange(F, dtype=jnp.uint32) % jnp.uint32(Ba)
+        results[f"fetch ({F} winners, payload)"] = _timed(
+            s_fetch, (pay, ebits_dummy, nf_row, acc0)
+        )
+    else:
+        meta4 = jnp.stack([ck_lo, ck_hi, pidx, pslot], axis=1)
+
+        def s_fetch(i, a):
+            fr, m4, eb_, nf, acc = a
+            nf = (nf + i.astype(jnp.uint32)) % jnp.uint32(Ba)
+            m = m4[nf]
+            par_row = m[:, 2] // jnp.uint32(EV)
+            succ_w, _, _ = step_pairs(fr[par_row], m[:, 3])
+            q = eb_[par_row]
+            acc = acc.at[0].add(_fold(succ_w) + _fold(m) + _fold(q))
+            return fr, m4, eb_, nf, acc
+
+        nf_row = jnp.arange(F, dtype=jnp.uint32) % jnp.uint32(Ba)
+        results[f"fetch ({F} winners, recompute)"] = _timed(
+            s_fetch, (frontier_f, meta4, ebits_dummy, nf_row, acc0)
+        )
 
     print(f"\n{'stage':42s} {'ms/wave':>9s}  (baseline-subtracted)")
     total = 0.0

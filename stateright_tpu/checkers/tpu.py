@@ -315,22 +315,29 @@ class TpuBfsChecker(Checker):
         ``track_paths=False`` (where full paths are not), and after a
         run that raised (e.g. an encoding-bound overflow in the same
         chunk that found the counterexample — the discovery, recorded
-        before the raise, is the thing the check exists to surface)."""
+        before the raise, is the thing the check exists to surface).
+        The error is suppressed only on REPLAY (the run already
+        finished and raised once): a first call still surfaces it, so
+        a caller that skipped ``join()`` can't mistake a truncated
+        search for a clean one."""
+        already_failed = self._done and self._run_error is not None
         try:
             self._ensure_run()
         except RuntimeError:
-            if not self._discovered_fps:
+            if not (already_failed and self._discovered_fps):
                 raise
         return set(self._discovered_fps)
 
     def discovery_fingerprints(self) -> dict[str, int]:
         """Property name -> discovery-state fingerprint. The fast-mode
         (track_paths=False) substitute for :meth:`discoveries`; like
-        :meth:`discovered_property_names`, survives a raising run."""
+        :meth:`discovered_property_names`, survives a raising run
+        (replay only)."""
+        already_failed = self._done and self._run_error is not None
         try:
             self._ensure_run()
         except RuntimeError:
-            if not self._discovered_fps:
+            if not (already_failed and self._discovered_fps):
                 raise
         return dict(self._discovered_fps)
 

@@ -79,6 +79,36 @@ from .tpu import (
 _SENT = 0xFFFFFFFF
 
 
+def payload_width(W: int, track_paths: bool) -> int:
+    """Lanes of the packed candidate payload (see payload_pack)."""
+    return W + 3 + (2 if track_paths else 0)
+
+
+def payload_pack(jnp, state, key_lo, key_hi, ebits, par_lo=None,
+                 par_hi=None):
+    """THE single-chip packed-payload lane layout:
+    ``[state 0:W | key_lo W | key_hi W+1 | ebits W+2 | par_lo W+3 |
+    par_hi W+4]`` — every pack site and fetch unpack goes through this
+    pair so the six call sites can't drift (round-5 review finding)."""
+    parts = [state, key_lo[:, None], key_hi[:, None], ebits[:, None]]
+    if par_lo is not None:
+        parts += [par_lo[:, None], par_hi[:, None]]
+    return jnp.concatenate(parts, axis=1)
+
+
+def payload_unpack(p, W: int, track_paths: bool):
+    """Inverse of payload_pack, in the merge-fetch return order:
+    ``(state, par_lo, par_hi, ebits, key_lo, key_hi)``."""
+    return (
+        p[:, :W],
+        p[:, W + 3] if track_paths else None,
+        p[:, W + 4] if track_paths else None,
+        p[:, W + 2],
+        p[:, W],
+        p[:, W + 1],
+    )
+
+
 def _ladder(lo: int, hi: int, step: int) -> list[int]:
     """Geometric size ladder [min(lo,hi), ..., hi] with ratio `step`."""
     vals = []
@@ -540,41 +570,71 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
 
                 # Compact the new states' candidate positions into the
                 # next frontier (new rows first, in candidate order).
-                # Fetch width NF: a wave can't produce more new states
-                # than it has candidates, so the fetch gathers (and the
-                # frontier write) shrink with the candidate budget at
-                # small classes; rows [NF, F) are statically zero.
+                # Fetch width: the payload gather is the merge's
+                # costliest op at big shapes (paxos-5: a static
+                # min(F, B_eff)=1.57M-row gather cost ~62ms/wave while
+                # typical waves produced ~120k new states), so the
+                # fetch runs under its own class switch sized to THIS
+                # wave's new_count — the third ladder axis, after the
+                # frontier and visited classes.
                 NF = min(F, B_eff)
                 nf_pos = jnp.where(is_new, m_pos, jnp.uint32(_SENT))
                 (nf_pos,) = lax.sort((nf_pos,), num_keys=1)
                 # M = V_v + B_eff >= B_eff >= NF, so the slice always
                 # has enough rows.
-                nf_pos = nf_pos[:NF]
-                nf_valid = jnp.arange(NF) < new_count
+                nf_ladder = [n for n in f_ladder if n < NF] + [NF]
+                nf_class = jnp.int32(0)
+                for n in nf_ladder[:-1]:
+                    nf_class = nf_class + (
+                        new_count > n
+                    ).astype(jnp.int32)
                 f_overflow = c["f_overflow"] | (new_count > F)
-                nf_row = jnp.where(nf_valid, nf_pos - 1, jnp.uint32(0))
-                (state_rows, par_lo, par_hi, row_ebits,
-                 key_lo, key_hi) = fetch(nf_row)
 
-                def fpad(x, fill=0):
-                    if NF == F:
-                        return x
-                    pad_shape = (F - NF,) + x.shape[1:]
-                    return jnp.concatenate(
-                        [x, jnp.full(pad_shape, fill, x.dtype)]
-                    )
+                def make_fetch(NF_c):
+                    def br(_):
+                        pos = nf_pos[:NF_c]
+                        valid = jnp.arange(NF_c) < new_count
+                        nf_row = jnp.where(
+                            valid, pos - 1, jnp.uint32(0)
+                        )
+                        (state_rows, par_lo, par_hi, row_ebits,
+                         key_lo, key_hi) = fetch(nf_row)
 
-                next_frontier = fpad(jnp.where(
-                    nf_valid[:, None], state_rows, jnp.uint32(0)
-                ))
-                next_ebits = fpad(jnp.where(nf_valid, row_ebits, 0))
+                        def pad(x, fill):
+                            if NF_c == F:
+                                return x
+                            ps = (F - NF_c,) + x.shape[1:]
+                            return jnp.concatenate(
+                                [x, jnp.full(ps, fill, x.dtype)]
+                            )
+
+                        return (
+                            pad(jnp.where(valid[:, None], state_rows,
+                                          jnp.uint32(0)), 0),
+                            pad(jnp.where(valid, row_ebits, 0), 0),
+                            pad(jnp.where(valid, key_lo,
+                                          jnp.uint32(_SENT)), _SENT),
+                            pad(jnp.where(valid, key_hi,
+                                          jnp.uint32(_SENT)), _SENT),
+                            pad(jnp.where(valid, par_lo, 0), 0)
+                            if track_paths else jnp.zeros(0, jnp.uint32),
+                            pad(jnp.where(valid, par_hi, 0), 0)
+                            if track_paths else jnp.zeros(0, jnp.uint32),
+                        )
+                    return br
+
+                (next_frontier, next_ebits, app_lo, app_hi,
+                 np_lo, np_hi) = lax.switch(
+                    nf_class,
+                    [make_fetch(n) for n in nf_ladder],
+                    0,
+                )
+                nf_valid_f = jnp.arange(F) < new_count
 
                 # Visited append: the winners' keys as one contiguous
                 # sentinel-padded block at the running unique-count
                 # offset (no sort, no scatter; keys came packed with
                 # the payload gather).
-                app_lo = jnp.where(nf_valid, key_lo, jnp.uint32(_SENT))
-                app_hi = jnp.where(nf_valid, key_hi, jnp.uint32(_SENT))
                 v_lo_new = lax.dynamic_update_slice(
                     c["v_lo"], app_lo, (c["new"],)
                 )
@@ -586,10 +646,8 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 # running offset (no scatter); rows past new_count are
                 # garbage that the next wave's block overwrites.
                 if track_paths:
-                    nc_lo = jnp.where(nf_valid, key_lo, 0)
-                    nc_hi = jnp.where(nf_valid, key_hi, 0)
-                    np_lo = jnp.where(nf_valid, par_lo, 0)
-                    np_hi = jnp.where(nf_valid, par_hi, 0)
+                    nc_lo = jnp.where(nf_valid_f, app_lo, 0)
+                    nc_hi = jnp.where(nf_valid_f, app_hi, 0)
                     off = (c["pl_n"],)
                     pl_child_lo = lax.dynamic_update_slice(
                         c["pl_child_lo"], nc_lo, off
@@ -603,12 +661,12 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     pl_par_hi = lax.dynamic_update_slice(
                         c["pl_par_hi"], np_hi, off
                     )
-                    # Clamp to the NF rows the block write actually
+                    # Clamp to the F rows the block write actually
                     # wrote: on an f_overflow wave new_count can exceed
                     # F, and _run raises before reconstruction — but
                     # the live-count invariant should hold regardless.
                     pl_n = c["pl_n"] + jnp.minimum(
-                        new_count.astype(jnp.uint32), jnp.uint32(NF)
+                        new_count.astype(jnp.uint32), jnp.uint32(F)
                     )
                 else:
                     pl_child_lo = c["pl_child_lo"]
@@ -647,7 +705,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     pl_par_hi=pl_par_hi,
                     pl_n=pl_n,
                     frontier=next_frontier,
-                    fval=fpad(nf_valid, False) & cont,
+                    fval=nf_valid_f & cont,
                     ebits=next_ebits,
                     n_frontier=jnp.where(
                         cont, new_count.astype(jnp.uint32), jnp.uint32(0)
@@ -805,11 +863,11 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 # keep): expansion, fingerprinting, compaction, and a
                 # Bt-row payload gather all happen inside each tile.
                 # Payload lanes are PACKED into one [B_eff, EP] buffer
-                # (state, key limbs, ebits, parent fp) so the merge
-                # fetch is a single multi-lane gather (PERF.md
-                # §gathers); the key limbs are kept as separate 1-D
-                # arrays too — the merge sort concatenates those.
-                EP = W + 3 + (2 if track_paths else 0)
+                # (payload_pack layout) so the merge fetch is a single
+                # multi-lane gather (PERF.md §gathers); the key limbs
+                # are kept as separate 1-D arrays too — the merge sort
+                # concatenates those.
+                EP = payload_width(W, track_paths)
 
                 def tile_body(t, acc):
                     (
@@ -842,12 +900,12 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     )
                     s_hi, s_lo, s_row = s_hi[:Bt], s_lo[:Bt], s_row[:Bt]
                     prow = s_row // jnp.uint32(K)
-                    parts = [flat[s_row], s_lo[:, None], s_hi[:, None],
-                             ex["ebits"][prow][:, None]]
-                    if track_paths:
-                        parts += [ex["f_lo"][prow][:, None],
-                                  ex["f_hi"][prow][:, None]]
-                    blk = jnp.concatenate(parts, axis=1)
+                    blk = payload_pack(
+                        jnp, flat[s_row], s_lo, s_hi,
+                        ex["ebits"][prow],
+                        ex["f_lo"][prow] if track_paths else None,
+                        ex["f_hi"][prow] if track_paths else None,
+                    )
                     o = t * Bt
                     ck_lo = lax.dynamic_update_slice(ck_lo, s_lo, (o,))
                     ck_hi = lax.dynamic_update_slice(ck_hi, s_hi, (o,))
@@ -882,14 +940,8 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 )
 
                 def fetch(nf_row):
-                    p = b_pay[nf_row]
-                    return (
-                        p[:, :W],
-                        p[:, W + 3] if track_paths else None,
-                        p[:, W + 4] if track_paths else None,
-                        p[:, W + 2],
-                        p[:, W],
-                        p[:, W + 1],
+                    return payload_unpack(
+                        b_pay[nf_row], W, track_paths
                     )
 
                 return lax.switch(
@@ -1148,21 +1200,16 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                         [eb] + ([f_lo, f_hi] if track_paths else []),
                         axis=1,
                     )
-                    pay = jnp.concatenate(
-                        [succ, ck_lo[:, None], ck_hi[:, None],
-                         fr_meta[prow]],
-                        axis=1,
+                    pm = fr_meta[prow]
+                    pay = payload_pack(
+                        jnp, succ, ck_lo, ck_hi, pm[:, 0],
+                        pm[:, 1] if track_paths else None,
+                        pm[:, 2] if track_paths else None,
                     )
 
                     def fetch(nf_row):
-                        p = pay[nf_row]
-                        return (
-                            p[:, :W],
-                            p[:, W + 3] if track_paths else None,
-                            p[:, W + 4] if track_paths else None,
-                            p[:, W + 2],
-                            p[:, W],
-                            p[:, W + 1],
+                        return payload_unpack(
+                            pay[nf_row], W, track_paths
                         )
                 elif pay_fetch:
                     # XLA:CPU workaround (round 5): gathering a

@@ -1371,7 +1371,7 @@ TUNED_ENGINE_CAPS = {
     2: dict(capacity=1 << 15, frontier_capacity=1 << 12,
             cand_capacity=1 << 14, pair_width=16, tile_rows=1 << 18),
     3: dict(capacity=5 << 18, frontier_capacity=1 << 18,
-            cand_capacity=3 << 17, pair_width=16, tile_rows=1 << 18),
+            cand_capacity=3 << 17, pair_width=10, tile_rows=1 << 18),
     4: dict(capacity=5 << 19, frontier_capacity=1 << 19,
             cand_capacity=3 << 18, pair_width=10, tile_rows=1 << 18,
             # pair_width 10: 9 overflowed (a >depth-7 row enables 9+
@@ -1381,9 +1381,14 @@ TUNED_ENGINE_CAPS = {
             # 1.80M st/s vs 1.72M at (12, 32) after the gather packing.
             tiles=64),
     5: dict(capacity=3 << 21, frontier_capacity=3 << 19,
-            cand_capacity=3 << 19, pair_width=12, tile_rows=1 << 18,
-            f_min=1 << 18, ladder_step=4, v_min=1 << 21,
-            v_ladder_step=4, flat_budget_bytes=1 << 26,
+            cand_capacity=3 << 19, pair_width=10, tile_rows=1 << 18,
+            # Round-5 retune after the gather packing + NF-class fetch:
+            # fine f-ladder (the coarse round-4 ladder quantized
+            # mid-size waves up to 1.57M-row classes: 843k -> 1.34M
+            # st/s), payload-resident fetch (the [Ba, W+3] padded
+            # payload is ~900MB — fits), pair_width 10 as at 4c.
+            f_min=1 << 17, ladder_step=2, v_min=1 << 20,
+            v_ladder_step=2, flat_budget_bytes=2 << 30,
             mask_budget_cells=1 << 26),
 }
 

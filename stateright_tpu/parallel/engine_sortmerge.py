@@ -173,6 +173,9 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
         enc = self.encoded
         props = list(self.model.properties())
         n_props = len(props)
+        # XLA:CPU needs a gather-arrangement workaround in the tile
+        # build (see dest_block below).
+        cpu_backend = jax.default_backend() == "cpu"
         evt_idx = [
             i for i, p in enumerate(props)
             if p.expectation == Expectation.EVENTUALLY
@@ -763,7 +766,44 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                     ).reshape(R_src, fr_meta.shape[1])
                 else:
                     pmeta = fr_meta[cand_par]
-                if cand_state is not None:
+                if cpu_backend:
+                    # XLA:CPU workaround (round 5, mirrors the
+                    # single-chip engine): gathering a CONCATENATED
+                    # multi-lane payload livelocks the CPU thunk
+                    # runtime inside the chunk while-loop with some
+                    # encodings (observed with compiled actor
+                    # encodings + paths). Same math, per-destination
+                    # separate gathers.
+                    def dest_block(start):
+                        idx = jnp.clip(
+                            start + jnp.arange(Bd_c, dtype=jnp.uint32),
+                            0,
+                            jnp.uint32(R_src - 1),
+                        )
+                        srow = s_row[idx]
+                        if cand_par is None:
+                            par = srow // jnp.uint32(K)
+                        else:
+                            par = cand_par[srow]
+                        if cand_state is not None:
+                            st = cand_state[srow]
+                        else:
+                            st, _, _ = step_pairs(
+                                frontier_c[par], pslot[srow]
+                            )
+                        parts = [st]
+                        if track_paths:
+                            parts += [
+                                ex["f_lo"][par][:, None],
+                                ex["f_hi"][par][:, None],
+                            ]
+                        parts += [
+                            ex["ebits"][par][:, None],
+                            s_lo[idx][:, None],
+                            s_hi[idx][:, None],
+                        ]
+                        return jnp.concatenate(parts, axis=1)
+                elif cand_state is not None:
                     parts = [cand_state]
                     if track_paths:
                         parts += [pmeta[:, 1:2], pmeta[:, 2:3]]

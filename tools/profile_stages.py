@@ -371,6 +371,9 @@ def stage_profile(kind, n, caps, target):
     ebits_dummy = jnp.zeros(F_f, jnp.uint32)
 
     if pay_fetch:
+        # Mirror the engine's packed payload (succ ++ keys ++ meta);
+        # profile the fetch at BOTH the max width and a typical
+        # NF-class width (the engine's third ladder axis).
         succ_all = jax.jit(
             lambda fr: step_pairs(
                 fr[pidx // jnp.uint32(EV)], pslot
@@ -378,39 +381,36 @@ def stage_profile(kind, n, caps, target):
         )(frontier_f)
         pay = jnp.concatenate(
             [succ_all, ck_lo[:, None], ck_hi[:, None],
-             (pidx // jnp.uint32(EV))[:, None]],
+             ebits_dummy[pidx // jnp.uint32(EV)][:, None]],
             axis=1,
         )
-        W_ = W
 
-        def s_fetch(i, a):
-            py, eb_, nf, acc = a
-            nf = (nf + i.astype(jnp.uint32)) % jnp.uint32(Ba)
-            p = py[nf]
-            q = eb_[p[:, W_ + 2]]
-            acc = acc.at[0].add(_fold(p) + _fold(q))
-            return py, eb_, nf, acc
+        for NF_c in sorted({min(F, Ba), min(131072, Ba)}, reverse=True):
+            nf_row = jnp.arange(NF_c, dtype=jnp.uint32) % jnp.uint32(Ba)
 
-        nf_row = jnp.arange(F, dtype=jnp.uint32) % jnp.uint32(Ba)
-        results[f"fetch ({F} winners, payload)"] = _timed(
-            s_fetch, (pay, ebits_dummy, nf_row, acc0)
-        )
+            def s_fetch(i, a):
+                py, nf, acc = a
+                nf = (nf + i.astype(jnp.uint32)) % jnp.uint32(Ba)
+                p = py[nf]
+                acc = acc.at[0].add(_fold(p))
+                return py, nf, acc
+
+            results[f"fetch ({NF_c} winners, payload)"] = _timed(
+                s_fetch, (pay, nf_row, acc0)
+            )
     else:
-        meta4 = jnp.stack([ck_lo, ck_hi, pidx, pslot], axis=1)
-
         def s_fetch(i, a):
-            fr, m4, eb_, nf, acc = a
+            fr, nf, acc = a
             nf = (nf + i.astype(jnp.uint32)) % jnp.uint32(Ba)
-            m = m4[nf]
-            par_row = m[:, 2] // jnp.uint32(EV)
-            succ_w, _, _ = step_pairs(fr[par_row], m[:, 3])
-            q = eb_[par_row]
-            acc = acc.at[0].add(_fold(succ_w) + _fold(m) + _fold(q))
-            return fr, m4, eb_, nf, acc
+            par_row = pidx[nf] // jnp.uint32(EV)
+            succ_w, _, _ = step_pairs(fr[par_row], pslot[nf])
+            q = ebits_dummy[par_row]
+            acc = acc.at[0].add(_fold(succ_w) + _fold(q))
+            return fr, nf, acc
 
-        nf_row = jnp.arange(F, dtype=jnp.uint32) % jnp.uint32(Ba)
-        results[f"fetch ({F} winners, recompute)"] = _timed(
-            s_fetch, (frontier_f, meta4, ebits_dummy, nf_row, acc0)
+        nf_row = jnp.arange(min(F, Ba), dtype=jnp.uint32) % jnp.uint32(Ba)
+        results[f"fetch ({min(F, Ba)} winners, recompute)"] = _timed(
+            s_fetch, (frontier_f, nf_row, acc0)
         )
 
     print(f"\n{'stage':42s} {'ms/wave':>9s}  (baseline-subtracted)")

@@ -704,6 +704,11 @@ class TpuBfsChecker(Checker):
                     "wider fields."
                 )
             if overflow_msg is not None:
+                # Surface the engine-variant peak metrics (e.g.
+                # max_wave_candidates) before raising — the overflow
+                # messages point at them, and the auto-budget retry
+                # sizes from them.
+                self._consume_extra_stats(s[11 + 3 * n_props:])
                 # Record discoveries BEFORE raising: with a
                 # violation-gated closure bound (e.g. the register
                 # models' linearizable-expansion history bound), the

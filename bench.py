@@ -136,7 +136,13 @@ def tpu_workloads(quick=False):
                     SingleCopyRegisterCfg(client_count=n)
                 )
                 .checker()
-                .spawn_tpu_sortmerge(track_paths=False, **kw)
+                # Dense dispatch: the SPARSE chunk program for this
+                # compiled encoding reliably gets the axon remote
+                # compile helper SIGKILLed (round 5; the dense program
+                # compiles and runs fine, and at K=21 the dense wave
+                # is cheap anyway).
+                .spawn_tpu_sortmerge(track_paths=False, sparse=False,
+                                     **kw)
             )
 
         return spawn
@@ -218,7 +224,47 @@ def tpu_workloads(quick=False):
             296448,
         ),
     ]
+    # Driver config family `linearizable-register check N ordered`
+    # (BASELINE.md:32, bench.sh:33): ABD over FIFO channels, compiled
+    # by the actor→encoding compiler in overapprox mode from DECLARED
+    # queue bounds (abd_queue_bounds — no host exploration), budgets
+    # AUTO-SIZED from measured peaks (no caps table). The 1,212,979
+    # count is device-derived, pinned by the depth-prefix host
+    # differential in tests/test_actor_compile.py and reproduced
+    # across runs; the 4-client driver config's closure is the
+    # round-5 frontier (see linearizable_register.py max_domain).
+    from stateright_tpu.actor.network import Network
+    from stateright_tpu.models.linearizable_register import (
+        AbdModelCfg,
+        abd_model,
+    )
+
+    def abd_ordered(n, **kw):
+        def spawn():
+            return (
+                abd_model(
+                    AbdModelCfg(client_count=n, server_count=3),
+                    Network.new_ordered(),
+                )
+                .checker()
+                .spawn_tpu_sortmerge(track_paths=False, **kw)
+            )
+
+        return spawn
+
     if not quick:
+        loads.append(
+            (
+                "abd 2c/3s ordered",
+                abd_ordered(
+                    2,
+                    capacity=1 << 21,
+                    frontier_capacity=1 << 18,
+                    cand_capacity="auto",
+                ),
+                1212979,
+            )
+        )
         loads.append(
             (
                 # The north-star workload family (examples/paxos.rs

@@ -425,7 +425,13 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
     def _run(self, reporter=None) -> None:
         if not self.auto_budget:
             return super()._run(reporter)
-        for _attempt in range(4):
+        last_exc = None
+        for _attempt in range(6):
+            if last_exc is not None:
+                # Reset at the TOP of the retry so a final failed
+                # attempt keeps its recorded discoveries (the
+                # discoveries-survive-overflow contract in tpu.py).
+                self._reset_for_retry()
             try:
                 super()._run(reporter)
                 self._save_budget()
@@ -435,32 +441,29 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 if ("pair-buffer overflow" not in msg
                         and "candidate-buffer overflow" not in msg):
                     raise
-                peak = self.metrics.get("max_wave_candidates", 0)
+                last_exc = exc
                 rowen = self.metrics.get("max_row_enabled", 0)
-                grew = False
                 if (self._use_sparse()
                         and rowen > self._pair_width()):
                     # The mask counts are exact even on the overflow
-                    # run, so one resize suffices for pair_width.
+                    # run, so one resize suffices for pair_width —
+                    # and a pure pair_width overflow must NOT also
+                    # inflate (and persist) the candidate budget.
                     self.pair_width = int(rowen)
-                    grew = True
+                    continue
                 # The observed peak only covers waves BEFORE the
                 # overflow, so grow geometrically past it — the
                 # converged budget still ends within ~4x of the true
                 # peak and one clean re-run records the exact value.
-                new_cand = max(
+                peak = self.metrics.get("max_wave_candidates", 0)
+                self.cand_capacity = max(
                     int(peak * 1.15) + 1024,
                     4 * (self.cand_capacity or 1),
                 )
-                if new_cand > (self.cand_capacity or 0):
-                    self.cand_capacity = new_cand
-                    grew = True
-                if not grew:
-                    raise
-                self._reset_for_retry()
         raise RuntimeError(
-            "auto budget did not converge in 4 attempts"
-        )
+            "auto budget did not converge in 6 attempts; last overflow: "
+            f"{last_exc}"
+        ) from last_exc
 
     def _reset_for_retry(self) -> None:
         """Discard one failed attempt's partial results so the resized
@@ -1163,9 +1166,16 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             # winning rows' successors at fetch time. Extra cost: one
             # step_slot pass over the winners; saving: the whole [Ba,W]
             # tensor is never alive.
-            chunked = compaction and (Ba * W * 4 > self.flat_budget_bytes)
+            # Chunk-mode gate and chunk count use the PADDED row cost
+            # (~512 B/row on TPU for any [N, W<=32] buffer — PERF.md):
+            # gating on unpadded W*4 bytes let an ABD-ordered probe
+            # build ~86GB of padded step temps at Ba=8.4M (round 5).
+            row_pad = -(-W // 128) * 512
+            chunked = compaction and (
+                Ba * row_pad > self.flat_budget_bytes
+            )
             if chunked:
-                NC = -(-(Ba * W * 4) // self.flat_budget_bytes)
+                NC = -(-(Ba * row_pad) // self.flat_budget_bytes)
                 Bc = -(-Ba // NC)
                 Ba = NC * Bc  # pad so chunks tile it exactly
             # Fetch mode (PERF.md §gathers): keep the [Ba, W+3] packed

@@ -111,6 +111,14 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             )
         self.mesh = mesh
         self.n_shards = int(mesh.devices.size)
+        if cand_capacity == "auto":
+            raise ValueError(
+                'cand_capacity="auto" is single-chip only: the sharded '
+                "engine's budgets are per shard and its overflow "
+                "message/metrics differ — pass explicit capacities "
+                "(the single-chip auto run's persisted budget is a "
+                "good starting point)"
+            )
         super().__init__(
             builder,
             encoded=encoded,
@@ -591,9 +599,13 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                     # blow the flat budget, fingerprint pairs in chunks
                     # and RECOMPUTE the routed tiles' successors inside
                     # dest_tile (step_slot purity makes this exact).
-                    chunked = R_src * W * 4 > self.flat_budget_bytes
+                    row_pad = -(-W // 128) * 512
+                    chunked = (
+                        R_src * row_pad > self.flat_budget_bytes
+                    )
                     if chunked:
-                        NC = -(-(R_src * W * 4) // self.flat_budget_bytes)
+                        NC = -(-(R_src * row_pad)
+                               // self.flat_budget_bytes)
                         Bc = -(-R_src // NC)
                         pad = NC * Bc - R_src
                         pidx_p = jnp.pad(pidx, (0, pad))

@@ -692,3 +692,39 @@ def test_abd_3clients_bounded_overapprox_compiles_and_agrees():
             for n in model.next_states(seen[k])
         }
         assert got.get(i, set()) == host_succ
+
+
+def test_compiled_ordered_abd_3s_depth_differential():
+    """The bench lane `abd 2c/3s ordered` (driver family
+    `linearizable-register check N ordered`, BASELINE.md:32): the
+    overapprox-compiled FIFO encoding matches host BFS state-for-state
+    at a bounded depth, pinning the encoding semantics the full
+    1,212,979-state device run (bench.py; reproduced across runs on
+    real TPU, round 5) builds on."""
+    from stateright_tpu.models.linearizable_register import (
+        AbdModelCfg,
+        abd_model,
+    )
+
+    def mk():
+        return abd_model(
+            AbdModelCfg(client_count=2, server_count=3),
+            Network.new_ordered(),
+        )
+
+    host = mk().checker().target_max_depth(7).spawn_bfs().join()
+    m = mk()
+    tpu = (
+        m.checker()
+        .target_max_depth(7)
+        .spawn_tpu_sortmerge(
+            encoded=m.to_encoded(),
+            capacity=1 << 13,
+            frontier_capacity=1 << 11,
+            cand_capacity=1 << 13,
+            track_paths=False,
+        )
+        .join()
+    )
+    assert tpu.unique_state_count() == host.unique_state_count()
+    assert tpu.discovered_property_names() == set(host.discoveries())

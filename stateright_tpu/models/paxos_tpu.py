@@ -1390,7 +1390,7 @@ TUNED_ENGINE_CAPS = {
             # mid-size waves up to 1.57M-row classes: 843k -> 1.34M
             # st/s), payload-resident fetch (the [Ba, W+3] padded
             # payload is ~900MB — fits), pair_width 10 as at 4c.
-            f_min=1 << 17, ladder_step=2, v_min=1 << 20,
+            f_min=1 << 16, ladder_step=2, v_min=1 << 20,
             v_ladder_step=2, flat_budget_bytes=2 << 30,
             mask_budget_cells=1 << 26),
 }

@@ -350,6 +350,12 @@ def abd_encoded(model: ActorModel, closure: str | None = None,
         # client count: 2c fits the 32k default; the driver config
         # `linearizable-register check 4 ordered` (BASELINE.md:32)
         # needs a wider divergence guard, not a different bound.
+        # Measured closure wall time on the build box's single CPU
+        # core (round 5): 2c/3s ordered ≈ 2s, 3c/3s ≈ 120s, 4c/3s
+        # exceeded 2h without finishing (each client multiplies the
+        # serializer-checked history domain ~60x) — the 4c closure is
+        # a batch job, and its run needs the sharded mesh anyway
+        # (PERF.md §ordered).
         max_domain = 1 << 15 if cfg.client_count <= 2 else 1 << 22
     return compile_actor_model(
         model,

@@ -1374,14 +1374,14 @@ TUNED_ENGINE_CAPS = {
             cand_capacity=3 << 17, pair_width=10, tile_rows=1 << 18,
             v_min=1 << 17, v_ladder_step=2),
     4: dict(capacity=5 << 19, frontier_capacity=1 << 19,
-            cand_capacity=11 << 16, pair_width=10, tile_rows=1 << 18,
+            cand_capacity=11 << 16, pair_width=10, tile_rows=1 << 17,
             v_min=1 << 18, v_ladder_step=2,
             # pair_width 10: 9 overflowed (a >depth-7 row enables 9+
             # slots — detected loudly, round 5); 10 runs clean and
             # shrinks every F_f×EV grid 17% vs 12. tiles=64 halves the
             # packed-append headroom; cand 11<<16 = 720,896 keeps 5%
             # over the measured 686,045-pair peak (overflow loud).
-            # Measured 1.93M st/s (round 5; 1.11M round 4).
+            # Measured 2.03M st/s (round 5; 1.11M round 4).
             tiles=64),
     5: dict(capacity=3 << 21, frontier_capacity=3 << 19,
             cand_capacity=1500000, pair_width=10, tile_rows=1 << 18,

@@ -1384,7 +1384,7 @@ TUNED_ENGINE_CAPS = {
             # Measured 2.03M st/s (round 5; 1.11M round 4).
             tiles=64),
     5: dict(capacity=3 << 21, frontier_capacity=3 << 19,
-            cand_capacity=1500000, pair_width=10, tile_rows=1 << 18,
+            cand_capacity=1500000, pair_width=10, tile_rows=1 << 17,
             # Round-5 retune after the gather packing + NF-class fetch:
             # fine f-ladder (the coarse round-4 ladder quantized
             # mid-size waves up to 1.57M-row classes: 843k -> 1.34M

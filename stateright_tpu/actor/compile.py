@@ -41,6 +41,17 @@ non-duplicating multiset); history transitions collapse to
 "effect classes" (distinct (incoming-envelope, send-sequence)
 signatures) so the history table is ``|H| × #classes``.
 
+**Codegen shapes.** The sparse-dispatch surface emits the same op
+shapes the hand encodings use (PERF.md §ordered priced the old forms
+at ~8x hand-encoding per-state cost): ``enabled_bits_vec`` builds the
+enabled mask as a packed ``uint32[ceil(K/32)]`` bitmap from shift-mask
+field extracts and host-packed not-noop bit tables (no per-slot table
+gathers, no dense bool mask — GPUexplore-style guards-as-bitwise-ops,
+arXiv:1801.05857), and ``step_slot_vec`` runs every per-row chain as
+flat 1-D lane ops with static-lane selects for assembly (no
+``[N, 1]``-shaped compute). tests/test_codegen_shapes.py pins both at
+the jaxpr level.
+
 **Properties and boundaries** are declared as *specs*: small functions
 ``spec(ctx, jnp) -> bool`` where ``ctx`` offers component-tabulated
 values (:meth:`_SpecCtx.actor_values`, :meth:`_SpecCtx.history_value`,
@@ -83,6 +94,7 @@ from typing import Any, Callable, Optional, Sequence
 import numpy as np
 
 from ..encoding import EncodedModelBase
+from ..ops.bitmask import pack_bits_host
 from ..fingerprint import stable_hash
 from .base import CancelTimer, Cow, Id, Out, Send, SetTimer, is_no_op, \
     is_no_op_with_timer
@@ -195,6 +207,8 @@ class _LayoutBuilder:
 
 def _bits_for(n: int) -> int:
     return max(1, (n - 1).bit_length()) if n > 1 else 1
+
+
 
 
 def _domain_sort_key(value: Any):
@@ -1112,6 +1126,27 @@ class CompiledActorEncoding(EncodedModelBase):
                 cr[i, ftm.lane] &= ~np.uint32(1 << ftm.shift)
         self._sp_crash_and = cr
 
+        # Per-slot specs for the PACKED bitmap mask (enabled_bits_vec):
+        # the same slot order as params, but with each slot's
+        # (state-indexed) not-noop table pre-packed into host-constant
+        # bit words, so the traced mask is pure shift-mask ALU — no
+        # per-slot table gathers, no dense [F, K] bool (PERF.md
+        # §ordered: the compiled-codegen mask tax).
+        mask_slots: list = []
+        for (i, k, nxt, noop, ndl, tan, tor, hcl, sch, scd) in (
+            self.tbl_deliver
+        ):
+            mask_slots.append(("deliver", i, k, pack_bits_host(~noop)))
+        for k in self.drop_slots:
+            mask_slots.append(("drop", k))
+        for (i, j, nxt, noop, ndl, tan, tor, hcl, sch, scd) in (
+            self.tbl_timeout
+        ):
+            mask_slots.append(("timeout", i, j, pack_bits_host(~noop)))
+        for i in self.crash_slots:
+            mask_slots.append(("crash", i))
+        self._mask_slots = mask_slots
+
     @property
     def trivial_boundary(self) -> bool:
         """Lets the sparse engine skip the per-pair boundary pass and
@@ -1137,85 +1172,113 @@ class CompiledActorEncoding(EncodedModelBase):
             + len(self.crash_slots),
         )
 
-    def enabled_mask_vec(self, vec):
-        """bool[A]: present/armed AND the precomputed no-op tables —
-        the dense ``step_vec`` validity EXCEPT the count-bound poison,
-        which ``step_slot_vec`` reports as its truncation flag (the
-        engine excludes those pairs and raises when in-boundary)."""
+    def enabled_bits_vec(self, vec):
+        """``uint32[ceil(A/32)]``: the enabled mask as a PACKED bitmap
+        (ops/bitmask.py word layout), built entirely from shift-mask
+        field extracts on the state lanes — no per-slot table gathers,
+        no dense bool[A] materialization. This is the op shape the
+        hand encodings use and the sparse engines consume directly
+        (PERF.md §ordered traced ~1.6s/run of 1-D mask gathers to the
+        old table-gather form at abd-ordered shapes).
+
+        Semantics are the dense ``step_vec`` validity EXCEPT the
+        count-bound poison, which ``step_slot_vec`` reports as its
+        truncation flag (the engine excludes those pairs and raises
+        when in-boundary)."""
         import jax.numpy as jnp
 
-        p = self._sp_params
-        kind = jnp.asarray(p[:, 0])
-        # Per-actor values, tabulated statically then gathered by the
-        # (host-constant) per-slot actor index.
-        s_idx = jnp.stack(
-            [self._get_actor_idx(vec, i, jnp) for i in range(self.n)]
-        )
-        crashed = jnp.stack(
-            [
-                self._get_field(vec, self.f_crashed[i], jnp) != 0
-                for i in range(self.n)
-            ]
-        )
-        n_crashed = jnp.sum(crashed.astype(jnp.uint32))
-        ai = jnp.asarray(p[:, 1])
-        a_sidx = s_idx[ai]
-        a_crashed = crashed[ai]
-        # Net count / timer bit per slot: static per-lane select.
-        net_val = jnp.uint32(0)
-        tmr_val = jnp.uint32(0)
-        for j in range(self.width):
-            net_val = jnp.where(
-                jnp.asarray(p[:, 6]) == j, vec[j], net_val
-            )
-            tmr_val = jnp.where(
-                jnp.asarray(p[:, 9]) == j, vec[j], tmr_val
-            )
-        if self.ordered:
-            # Deliverable iff the slot's message is the channel HEAD
-            # (queue's least-significant digit).
-            qv = (net_val >> jnp.asarray(p[:, 7])) & jnp.asarray(
-                p[:, 8]
-            )
-            base = jnp.maximum(jnp.asarray(p[:, 11]), jnp.uint32(1))
-            present = (qv % base) == jnp.asarray(p[:, 12])
-        else:
-            present = (
-                (net_val >> jnp.asarray(p[:, 7]))
-                & jnp.asarray(p[:, 8])
-            ) > 0
-        armed = (
-            (tmr_val >> jnp.asarray(p[:, 10])) & jnp.uint32(1)
-        ) != 0
-        noop = jnp.asarray(self._sp_flat[:, 1])[
-            jnp.minimum(
-                jnp.asarray(p[:, 2]) + a_sidx,
-                jnp.uint32(self._sp_flat.shape[0] - 1),
-            )
-        ] != 0
-        en_deliver = present & ~a_crashed & ~noop
-        en_drop = present
-        en_timeout = armed & ~noop
-        en_crash = ~a_crashed & (
-            n_crashed < jnp.uint32(self.max_crashes)
-        )
-        return (
-            ((kind == self._SK_DELIVER) & en_deliver)
-            | ((kind == self._SK_DROP) & en_drop)
-            | ((kind == self._SK_TIMEOUT) & en_timeout)
-            | ((kind == self._SK_CRASH) & en_crash)
+        from ..ops.bitmask import bit_select, mask_words
+
+        u32 = jnp.uint32
+        L = mask_words(self.max_actions)
+        s_idx = [self._get_actor_idx(vec, i, jnp) for i in range(self.n)]
+        crashed = [
+            self._get_field(vec, self.f_crashed[i], jnp) != 0
+            for i in range(self.n)
+        ]
+        if self.crash_slots:
+            n_crashed = crashed[0].astype(u32)
+            for c in crashed[1:]:
+                n_crashed = n_crashed + c.astype(u32)
+            can_crash = n_crashed < u32(self.max_crashes)
+
+        def fx(f, width_mask):
+            return (vec[f.lane] >> u32(f.shift)) & u32(width_mask)
+
+        out = jnp.zeros(L, u32)
+        for w0 in range(L):
+            acc = u32(0)
+            for pos, spec in enumerate(
+                self._mask_slots[w0 * 32 : w0 * 32 + 32]
+            ):
+                kind = spec[0]
+                if kind == "deliver":
+                    _, i, k, nn = spec
+                    if self.ordered:
+                        env = self.E[k]
+                        ch = (env.src, env.dst)
+                        f = self.f_ch[self.chidx[ch]]
+                        qv = fx(f, (1 << f.bits) - 1)
+                        # HEAD of the channel queue: least-significant
+                        # base digit.
+                        present = (qv % u32(self.ch_base[ch])) == u32(
+                            self.ch_code[ch][env.msg]
+                        )
+                    else:
+                        f = self.f_net[k]
+                        present = fx(f, (1 << f.bits) - 1) != 0
+                    b = (
+                        present
+                        & ~crashed[i]
+                        & (bit_select(jnp, nn, s_idx[i]) != 0)
+                    )
+                elif kind == "drop":
+                    f = self.f_net[spec[1]]
+                    b = fx(f, (1 << f.bits) - 1) != 0
+                elif kind == "timeout":
+                    _, i, j, nn = spec
+                    b = (fx(self.f_timer[i][j], 1) != 0) & (
+                        bit_select(jnp, nn, s_idx[i]) != 0
+                    )
+                else:  # crash
+                    b = ~crashed[spec[1]] & can_crash
+                acc = acc | (b.astype(u32) << u32(pos))
+            out = out.at[w0].set(acc)
+        return out
+
+    def enabled_mask_vec(self, vec):
+        """bool[A]: the dense view of :meth:`enabled_bits_vec` (the
+        SparseEncodedModel contract and its differential tests); the
+        engines consume the packed words directly."""
+        import jax.numpy as jnp
+
+        from ..ops.bitmask import words_to_mask
+
+        return words_to_mask(
+            jnp, self.enabled_bits_vec(vec), self.max_actions
         )
 
     def step_slot_vec(self, vec, slot):
         """(successor, trunc, hard_trunc) for one enabled (state,
         slot) pair — trunc is boundary-gated by the engines (count
         poison), hard_trunc is raised unconditionally (un-harvested
-        history transition; see ``step_vec``'s hmiss notes)."""
+        history transition; see ``step_vec``'s hmiss notes).
+
+        Codegen shape contract (pinned by tests/test_codegen_shapes):
+        four row-table gathers (params, flat transition, packed
+        history, crash mask — the intended sparse idiom), then pure
+        1-D LANE OPS: every per-row chain (integer-queue shift/select,
+        field extracts, guard predicates) runs on flat ``[N]`` scalars
+        under vmap, and the successor is assembled with static-lane
+        selects — no stack-of-scalars concats, whose ``[N, 1]``
+        operands pay the full 128-lane tile-padding tax on TPU
+        (PERF.md §ordered: ~470ms/run at abd-ordered shapes)."""
         import jax.numpy as jnp
 
         xp = jnp
         W = self.width
-        slot = slot.astype(xp.uint32)
+        u32 = xp.uint32
+        slot = slot.astype(u32)
         prow = xp.asarray(self._sp_params)[slot]
         kind = prow[0]
         is_deliver = kind == self._SK_DELIVER
@@ -1223,24 +1286,28 @@ class CompiledActorEncoding(EncodedModelBase):
         is_timeout = kind == self._SK_TIMEOUT
         is_crash = kind == self._SK_CRASH
 
-        def lane_sel(arr, lane_idx):
-            v = arr[0]
+        lanes = [vec[j] for j in range(W)]
+
+        def lane_sel(vals, lane_idx):
+            v = vals[0]
             for j in range(1, W):
-                v = xp.where(lane_idx == j, arr[j], v)
+                v = xp.where(lane_idx == j, vals[j], v)
             return v
 
         # Actor-state index -> flat transition row.
-        s_idx = (lane_sel(vec, prow[3]) >> prow[4]) & prow[5]
+        s_idx = (lane_sel(lanes, prow[3]) >> prow[4]) & prow[5]
         frow_i = xp.minimum(
-            prow[2] + s_idx, xp.uint32(self._sp_flat.shape[0] - 1)
+            prow[2] + s_idx, u32(self._sp_flat.shape[0] - 1)
         )
         frow = xp.asarray(self._sp_flat)[frow_i]
         nxt, hcl = frow[0], frow[2]
-        ndl = frow[3 : 3 + W]
-        tan = frow[3 + W : 3 + 2 * W]
-        tor = frow[3 + 2 * W : 3 + 3 * W]
-        snd_ch = frow[3 + 3 * W : 3 + 3 * W + self._smax]
-        snd_cd = frow[3 + 3 * W + self._smax : 3 + 3 * W + 2 * self._smax]
+        ndl = [frow[3 + j] for j in range(W)]
+        tan = [frow[3 + W + j] for j in range(W)]
+        tor = [frow[3 + 2 * W + j] for j in range(W)]
+        snd_ch = [frow[3 + 3 * W + j] for j in range(self._smax)]
+        snd_cd = [
+            frow[3 + 3 * W + self._smax + j] for j in range(self._smax)
+        ]
 
         h_idx = self._get_field(vec, self.f_history, xp)
         # One packed gather: history index in bits 0-30, the
@@ -1248,133 +1315,164 @@ class CompiledActorEncoding(EncodedModelBase):
         # unrepresentable — reported through the hard-truncation
         # element, ADVICE r4, matching dense step_vec's hmiss).
         hg = xp.asarray(self._sp_hist_flat)[
-            h_idx * xp.uint32(self.n_cls) + hcl
+            h_idx * u32(self.n_cls) + hcl
         ]
-        h2 = hg & xp.uint32(0x7FFFFFFF)
+        h2 = hg & u32(0x7FFFFFFF)
         h_missing = (hg >> 31) != 0
 
-        # deliver/timeout: the table-driven transition, composed as
-        # pure [W]-vector ops (delta add/or, timer and/or, field sets
-        # via static-lane selects).
-        apply = vec
-        amask = xp.uint32(prow[5]) << prow[4]
+        # deliver/timeout: the table-driven transition, lane by lane —
+        # actor-state field set (dynamic lane via a per-lane select on
+        # the host-constant lane id), net delta add/or, timer and/or,
+        # history field set (static lane).
+        amask = prow[5] << prow[4]
         aval = (nxt & prow[5]) << prow[4]
-        asel = xp.arange(W, dtype=xp.uint32) == prow[3]
-        apply = xp.where(asel, (apply & ~amask) | aval, apply)
-        if self.dup:
-            apply = apply | ndl
-        else:
-            apply = apply + ndl
-        apply = (apply & tan) | tor
         hf = self.f_history
-        hmask = xp.uint32(hf.mask)
-        hval = (h2 & xp.uint32((1 << hf.bits) - 1)) << xp.uint32(hf.shift)
-        hsel = xp.arange(W, dtype=xp.uint32) == xp.uint32(hf.lane)
-        apply = xp.where(hsel, (apply & ~hmask) | hval, apply)
+        app = []
+        for j in range(W):
+            v = lanes[j]
+            v = xp.where(prow[3] == j, (v & ~amask) | aval, v)
+            if self.dup:
+                v = v | ndl[j]
+            else:
+                v = v + ndl[j]
+            v = (v & tan[j]) | tor[j]
+            if j == hf.lane:
+                v = (v & ~u32(hf.mask)) | (
+                    (h2 & u32((1 << hf.bits) - 1)) << u32(hf.shift)
+                )
+            app.append(v)
 
         ord_over = xp.bool_(False)
         if self.ordered:
             # Pop the delivered channel's head (divide by base), then
             # append the transition's send sequence to its queues in
             # emission order. Composed as PURE PER-LANE ARITHMETIC —
-            # static-index lane reads, per-lane delta stacks, no masked
-            # vector writes: the masked read-modify-write form
-            # miscompiled under vmap on TPU (sibling queue lanes were
-            # zeroed; same hazard family as the dynamic-index scatter
-            # drop documented in PERF.md).
-            base = xp.maximum(prow[11], xp.uint32(1))
-            qv = (lane_sel(apply, prow[6]) >> prow[7]) & prow[8]
+            # static-index lane reads, per-static-lane scalar delta
+            # accumulators, no masked vector writes: the masked
+            # read-modify-write form miscompiled under vmap on TPU
+            # (sibling queue lanes were zeroed; same hazard family as
+            # the dynamic-index scatter drop documented in PERF.md).
+            base = xp.maximum(prow[11], u32(1))
+            qv = (lane_sel(app, prow[6]) >> prow[7]) & prow[8]
             pop_amt = (qv - qv // base) << prow[7]
-            pop_vec = xp.stack(
-                [
-                    xp.where(
-                        is_deliver & (prow[6] == L), pop_amt, xp.uint32(0)
-                    )
-                    for L in range(W)
-                ]
-            )
-            s_net = apply - pop_vec
+            s_net = [
+                app[j]
+                - xp.where(is_deliver & (prow[6] == j), pop_amt, u32(0))
+                for j in range(W)
+            ]
             for j in range(self._smax):
                 chj = snd_ch[j]
                 cdj = snd_cd[j]
                 do = cdj > 0
-                adds = [xp.uint32(0)] * W
+                adds: dict = {}
                 for cc in range(len(self.channels)):
                     cch = self.channels[cc]
                     cbase = self.ch_base[cch]
                     Q = self.ch_q[cch]
                     f = self.f_ch[cc]
-                    fmask = xp.uint32((1 << f.bits) - 1)
-                    q = (s_net[f.lane] >> xp.uint32(f.shift)) & fmask
+                    fmask = u32((1 << f.bits) - 1)
+                    q = (s_net[f.lane] >> u32(f.shift)) & fmask
                     ln = sum(
-                        (q >= xp.uint32(cbase**p)).astype(xp.uint32)
+                        (q >= u32(cbase**p)).astype(u32)
                         for p in range(Q)
                     )
-                    powv = xp.uint32(0)
+                    powv = u32(0)
                     for pp in range(Q):
                         powv = xp.where(
-                            ln == pp, xp.uint32(cbase**pp), powv
+                            ln == pp, u32(cbase**pp), powv
                         )
                     sel = do & (chj == cc)
                     full = ln >= Q
-                    adds[f.lane] = adds[f.lane] + (
-                        xp.where(sel & ~full, cdj * powv, xp.uint32(0))
-                        << xp.uint32(f.shift)
+                    adds[f.lane] = adds.get(f.lane, u32(0)) + (
+                        xp.where(sel & ~full, cdj * powv, u32(0))
+                        << u32(f.shift)
                     )
                     ord_over = ord_over | (sel & full)
-                s_net = s_net + xp.stack(adds)
+                for lj, add in adds.items():
+                    s_net[lj] = s_net[lj] + add
             s_deliver = s_net
-            s_drop = vec  # lossy ordered rejected at compile
+            s_drop = lanes  # lossy ordered rejected at compile
             s_timeout = s_net
         else:
             # deliver additionally consumes the envelope (nondup). The
             # count must be read POST-delta (a handler may re-send the
             # envelope it consumed, exactly as the dense dec_net reads
             # the updated state).
-            nsel = xp.arange(W, dtype=xp.uint32) == prow[6]
             if self.dup:
-                s_deliver = apply  # redeliverable (network.rs:204-206)
-                s_drop = xp.where(
-                    nsel, vec & ~(prow[8] << prow[7]), vec
-                )
+                s_deliver = app  # redeliverable (network.rs:204-206)
+                s_drop = [
+                    xp.where(
+                        prow[6] == j,
+                        lanes[j] & ~(prow[8] << prow[7]),
+                        lanes[j],
+                    )
+                    for j in range(W)
+                ]
             else:
                 nmask = prow[8] << prow[7]
-                ac = (lane_sel(apply, prow[6]) >> prow[7]) & prow[8]
-                s_deliver = xp.where(
-                    nsel,
-                    (apply & ~nmask) | (((ac - 1) & prow[8]) << prow[7]),
-                    apply,
-                )
-                vc = (lane_sel(vec, prow[6]) >> prow[7]) & prow[8]
-                s_drop = xp.where(
-                    nsel,
-                    (vec & ~nmask) | (((vc - 1) & prow[8]) << prow[7]),
-                    vec,
-                )
+                ac = (lane_sel(app, prow[6]) >> prow[7]) & prow[8]
+                s_deliver = [
+                    xp.where(
+                        prow[6] == j,
+                        (app[j] & ~nmask)
+                        | (((ac - 1) & prow[8]) << prow[7]),
+                        app[j],
+                    )
+                    for j in range(W)
+                ]
+                vc = (lane_sel(lanes, prow[6]) >> prow[7]) & prow[8]
+                s_drop = [
+                    xp.where(
+                        prow[6] == j,
+                        (lanes[j] & ~nmask)
+                        | (((vc - 1) & prow[8]) << prow[7]),
+                        lanes[j],
+                    )
+                    for j in range(W)
+                ]
 
-            s_timeout = apply  # fired-timer clear already folded into tan
+            s_timeout = app  # fired-timer clear already folded into tan
 
-        csel = xp.arange(W, dtype=xp.uint32) == prow[9]
-        s_crash = xp.where(csel, vec | (xp.uint32(1) << prow[10]), vec)
-        ai = xp.minimum(prow[1], xp.uint32(max(0, self.n - 1)))
-        s_crash = s_crash & xp.asarray(self._sp_crash_and)[ai]
-
-        succ = xp.where(
-            is_deliver, s_deliver,
+        ai = xp.minimum(prow[1], u32(max(0, self.n - 1)))
+        crow = xp.asarray(self._sp_crash_and)[ai]
+        s_crash = [
             xp.where(
-                is_drop, s_drop,
-                xp.where(is_timeout, s_timeout,
-                         xp.where(is_crash, s_crash, vec)),
-            ),
-        )
+                prow[9] == j, lanes[j] | (u32(1) << prow[10]), lanes[j]
+            )
+            & crow[j]
+            for j in range(W)
+        ]
+
+        # Compose the output with static-lane selects (the hand
+        # encodings' idiom — see models/paxos_tpu.py step_slot_vec's
+        # lowering-hazard notes), never a stack of per-lane scalars.
+        succ_lanes = [
+            xp.where(
+                is_deliver, s_deliver[j],
+                xp.where(
+                    is_drop, s_drop[j],
+                    xp.where(
+                        is_timeout, s_timeout[j],
+                        xp.where(is_crash, s_crash[j], lanes[j]),
+                    ),
+                ),
+            )
+            for j in range(W)
+        ]
+        succ = vec
+        for j in range(W):
+            succ = succ.at[j].set(succ_lanes[j])
         if self.ordered:
             trunc = (is_deliver | is_timeout) & ord_over
         elif self.dup:
             trunc = xp.bool_(False)
         else:
-            trunc = (is_deliver | is_timeout) & xp.any(
-                (succ & xp.asarray(self._net_top_mask)) != 0
-            )
+            top = xp.bool_(False)
+            for j in range(W):
+                m = int(self._net_top_mask[j])
+                if m:
+                    top = top | ((succ_lanes[j] & u32(m)) != 0)
+            trunc = (is_deliver | is_timeout) & top
         # Third element = HARD truncation: un-harvested (h, class)
         # transition, raised by the engines regardless of the boundary
         # (the successor's history field is garbage, so the boundary

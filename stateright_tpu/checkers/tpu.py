@@ -44,7 +44,7 @@ from typing import Any, Optional
 import numpy as np
 
 from ..checker import Checker, CheckerBuilder
-from ..encoding import EncodedModel
+from ..encoding import EncodedModel, has_trivial_boundary
 from ..model import Expectation
 from ..ops.fingerprint import fingerprint_u32v
 from ..ops.hashset import DeviceHashSet, insert
@@ -112,7 +112,9 @@ def frontier_props(enc, props, evt_idx, frontier, fval, ebits):
     """The step-free half of a wave: frontier fingerprints, the
     property bitmap, and eventually-bit clearing (shared between the
     dense expansion below and the sparse-dispatch path, which computes
-    successors per enabled (row, slot) pair instead of per slot).
+    successors per enabled (row, slot) pair instead of per slot —
+    extracting the pairs from the encoding's packed enabled-mask
+    bitmap, ops/bitmask.py).
 
     Returns ``(cond[F, P], ebits[F], f_lo[F], f_hi[F])``."""
     import jax
@@ -175,8 +177,15 @@ def expand_frontier(enc, props, evt_idx, frontier, fval, ebits, expand,
     succs, valid, trunc = step_with_trunc(enc, frontier, jnp)
     trunc = trunc & fval & expand
     valid = valid & fval[:, None] & expand
-    bound = jax.vmap(lambda row: jax.vmap(enc.within_boundary_vec)(row))(succs)
-    valid = valid & bound
+    # Trivial boundaries (no spec at all — EncodedModelBase's default,
+    # or a compiled encoding's trivial_boundary flag) skip the [F, K]
+    # predicate map entirely, mirroring the sparse wave's
+    # sparse_boundary gate (tpu_sortmerge.py).
+    if not has_trivial_boundary(enc):
+        bound = jax.vmap(
+            lambda row: jax.vmap(enc.within_boundary_vec)(row)
+        )(succs)
+        valid = valid & bound
 
     # Terminal rows: no successors at all → surviving eventually-bits
     # are counterexamples (bfs.rs:317-324). Depth-cut waves

@@ -62,8 +62,8 @@ from __future__ import annotations
 import numpy as np
 
 from ..encoding import (
-    EncodedModelBase,
     SparseEncodedModel,
+    has_trivial_boundary,
     normalize_step_slot_result,
 )
 from ..model import Expectation
@@ -88,8 +88,13 @@ def payload_pack(jnp, state, key_lo, key_hi, ebits, par_lo=None,
                  par_hi=None):
     """THE single-chip packed-payload lane layout:
     ``[state 0:W | key_lo W | key_hi W+1 | ebits W+2 | par_lo W+3 |
-    par_hi W+4]`` — every pack site and fetch unpack goes through this
-    pair so the six call sites can't drift (round-5 review finding)."""
+    par_hi W+4]`` — every SINGLE-CHIP pack site and fetch unpack goes
+    through this pair so those call sites can't drift (round-5 review
+    finding). The sharded engine's routed destination tiles use a
+    DIFFERENT lane order and their own named helper
+    (parallel/engine_sortmerge.py ``dest_tile_pack``) — the two
+    layouts never meet: this one is unpacked by :func:`payload_unpack`
+    at the merge fetch, that one by the post-shuffle merge."""
     parts = [state, key_lo[:, None], key_hi[:, None], ebits[:, None]]
     if par_lo is not None:
         parts += [par_lo[:, None], par_hi[:, None]]
@@ -138,6 +143,12 @@ def sparse_pair_candidates(enc, frontier_f, fval_f, expand, *, EV, B_p,
     row → tiled 1-lane packed-append compaction into a [Ba] buffer of
     pair indices.
 
+    Encodings that build the packed words directly
+    (``enabled_bits_vec`` — the compiled actor codegen) skip the dense
+    ``bool[K]`` mask entirely: the engine consumes ``uint32[L]`` rows
+    and counts by popcount, so no [tile, K] bool tensor exists even
+    per tile (PERF.md §ordered: the compiled mask tax).
+
     Returns ``(pidx[Ba], live[Ba], pslot[Ba], cnt[F_f], n_pairs,
     pair_ovf, tile_max)`` — ``pair_ovf`` is True when a row enabled
     more than EV slots or the wave enabled more than B_p pairs.
@@ -146,33 +157,35 @@ def sparse_pair_candidates(enc, frontier_f, fval_f, expand, *, EV, B_p,
     import jax.numpy as jnp
     from jax import lax
 
+    from ..ops.bitmask import mask_to_words, popcount_words
+
     F_f = frontier_f.shape[0]
     W = frontier_f.shape[1]
     K = enc.max_actions
     L = (K + 31) // 32
     NPg = F_f * EV
     compaction = NPg > B_p
+    bits_fn = getattr(enc, "enabled_bits_vec", None)
 
     def pv(x):
         """Inside shard_map, fori_loop carries seeded from constants
         are 'unvarying' while the body outputs vary per shard — mark
-        the seeds as shard-varying to keep carry types equal."""
-        if axis_name is None:
+        the seeds as shard-varying to keep carry types equal. (Older
+        jax has no pvary and no unvarying carry typing: identity.)"""
+        if axis_name is None or not hasattr(lax, "pvary"):
             return x
         return lax.pvary(x, axis_name)
 
     def mask_bits(tf, tfv):
+        if bits_fn is not None:
+            tb = jax.vmap(bits_fn)(tf)
+            tb = jnp.where(expand, tb, jnp.uint32(0))
+            tb = jnp.where(tfv[:, None], tb, jnp.uint32(0))
+            return tb, popcount_words(jnp, tb)
         m = jax.vmap(enc.enabled_mask_vec)(tf)
         m = m & tfv[:, None] & expand
         tc = jnp.sum(m, axis=1, dtype=jnp.uint32)
-        mp = jnp.pad(m, ((0, 0), (0, L * 32 - K)))
-        tb = jnp.sum(
-            mp.reshape(-1, L, 32).astype(jnp.uint32)
-            * (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)),
-            axis=2,
-            dtype=jnp.uint32,
-        )
-        return tb, tc
+        return mask_to_words(jnp, m), tc
 
     if F_f * K > mask_budget_cells:
         NTm = _divisor_at_least(F_f, -(-F_f * K // mask_budget_cells))
@@ -353,7 +366,12 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             saved = self._load_budget()
             if saved is not None:
                 self.cand_capacity = saved["cand_capacity"]
-                if self._use_sparse() and saved.get("pair_width"):
+                # A persisted pair_width only fills the default: an
+                # EXPLICIT constructor pair_width wins over the store
+                # (cand_capacity="auto" silently widening a passed
+                # pair_width was ADVICE r5).
+                if (self._use_sparse() and saved.get("pair_width")
+                        and self.pair_width is None):
                     self.pair_width = saved["pair_width"]
             else:
                 # Growth heuristic: a wave rarely multiplies the
@@ -403,24 +421,40 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         import os
 
         path = self._budget_store()
-        data = {}
-        try:
-            with open(path) as fh:
-                data = json.load(fh)
-        except (OSError, ValueError):
-            pass
-        data[self._budget_key()] = {
-            "cand_capacity": self.cand_capacity,
-            "pair_width": (
-                self._pair_width() if self._use_sparse() else None
-            ),
-            "observed_peak": self.metrics.get("max_wave_candidates"),
-        }
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(data, fh, indent=1, sort_keys=True)
-        os.replace(tmp, path)
+        # Concurrent checkers (pytest workers, multi-model drivers)
+        # write different keys into one store: an unlocked
+        # read-modify-write dropped the loser's entry (ADVICE r5).
+        # Serialize the whole cycle on a lock file so the re-read
+        # immediately before the atomic replace sees every earlier
+        # writer's keys.
+        with open(path + ".lock", "w") as lock_fh:
+            try:
+                import fcntl
+
+                fcntl.flock(lock_fh, fcntl.LOCK_EX)
+            except (ImportError, OSError):
+                # Non-POSIX, or a filesystem without flock support
+                # (NFS/overlay): fall back to the unlocked-but-atomic
+                # replace rather than failing a finished check run.
+                pass
+            data = {}
+            try:
+                with open(path) as fh:
+                    data = json.load(fh)
+            except (OSError, ValueError):
+                pass
+            data[self._budget_key()] = {
+                "cand_capacity": self.cand_capacity,
+                "pair_width": (
+                    self._pair_width() if self._use_sparse() else None
+                ),
+                "observed_peak": self.metrics.get("max_wave_candidates"),
+            }
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(data, fh, indent=1, sort_keys=True)
+            os.replace(tmp, path)
 
     def _run(self, reporter=None) -> None:
         if not self.auto_budget:
@@ -1118,11 +1152,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         #   4. runs the table-driven per-pair transition, fingerprints,
         #      and the shared merge on ≤B real candidates only.
         # Every O(F·K) stage that remains is a pure elementwise pass.
-        wb = getattr(type(enc), "within_boundary_vec", None)
-        sparse_boundary = (
-            wb is not EncodedModelBase.within_boundary_vec
-            and not getattr(enc, "trivial_boundary", False)
-        )
+        sparse_boundary = not has_trivial_boundary(enc)
 
         import jax as _jax
 
@@ -1181,14 +1211,17 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             # Fetch mode (PERF.md §gathers): keep the [Ba, W+3] packed
             # candidate payload (successor lanes + key limbs + parent
             # row) alive through the merge when its PADDED residency —
-            # ~512 B/row on TPU regardless of lane count — fits the
-            # flat budget, so the winners' fetch is ONE multi-lane
-            # gather + one frontier-meta gather. Otherwise fetch
-            # recomputes winners' successors from a packed 4-lane
-            # (key_lo, key_hi, pair, slot) meta gather (the chunked
-            # path never materializes [Ba, W] at all).
+            # 512 B per 128-lane group on TPU, so ceil(EP/128)*512
+            # B/row (a hardcoded 512 undercounted packed payloads
+            # wider than 128 lanes by the full multiple, ADVICE r5) —
+            # fits the flat budget, so the winners' fetch is ONE
+            # multi-lane gather + one frontier-meta gather. Otherwise
+            # fetch recomputes winners' successors from a packed
+            # 4-lane (key_lo, key_hi, pair, slot) meta gather (the
+            # chunked path never materializes [Ba, W] at all).
+            pay_row_pad = -(-payload_width(W, track_paths) // 128) * 512
             pay_fetch = (not chunked) and (
-                Ba * 512 <= self.flat_budget_bytes
+                Ba * pay_row_pad <= self.flat_budget_bytes
             )
 
             def wave(c):

@@ -99,6 +99,20 @@ class SparseEncodedModel(Protocol):
       to successors itself).
     * ``step_slot_vec(vec, k)`` must equal ``step_vec(vec)[0][k]``
       whenever slot ``k`` is enabled.
+
+    Optional extension — PACKED mask words: an encoding MAY also
+    provide ``enabled_bits_vec(vec) -> uint32[ceil(max_actions/32)]``,
+    the same mask as bitmap words in the ops/bitmask.py layout (slot
+    ``k`` at bit ``k % 32`` of word ``k // 32``, zero tail). When
+    present, the engines consume the words directly — the dense
+    ``bool[K]`` mask and its packing pass never materialize, and the
+    per-row enabled counts come from popcount. It must satisfy
+    ``words_to_mask(enabled_bits_vec(vec)) == enabled_mask_vec(vec)``
+    (the compiled actor codegen derives the dense view from the words,
+    so the two cannot drift; tests/test_codegen_shapes.py pins the
+    words path gather-free). Absence is fine: hand encodings that only
+    build the dense mask are packed by the engine via
+    ``ops.bitmask.mask_to_words``.
     """
 
     def enabled_mask_vec(self, vec: Any) -> Any:
@@ -146,3 +160,17 @@ class EncodedModelBase:
 
     def decode(self, vec) -> Any:
         raise NotImplementedError
+
+
+def has_trivial_boundary(enc) -> bool:
+    """True when ``enc`` has no real boundary predicate — the
+    inherited :class:`EncodedModelBase` default, or an encoding-level
+    ``trivial_boundary`` flag (e.g. a compiled actor encoding with no
+    boundary spec). The single definition every engine's
+    skip-the-boundary-pass gate goes through, so the dense and sparse
+    paths can't disagree on whether the pass runs."""
+    wb = getattr(type(enc), "within_boundary_vec", None)
+    return (
+        wb is EncodedModelBase.within_boundary_vec
+        or bool(getattr(enc, "trivial_boundary", False))
+    )

@@ -12,6 +12,14 @@ compaction.
 from .u64 import U64, u64_add, u64_mul, u64_shr, u64_xor
 from .fingerprint import fingerprint_u32v, splitmix64
 from .hashset import DeviceHashSet
+from .bitmask import (
+    bit_select,
+    mask_to_words,
+    mask_words,
+    pack_bits_host,
+    popcount_words,
+    words_to_mask,
+)
 
 __all__ = [
     "U64",
@@ -22,4 +30,10 @@ __all__ = [
     "fingerprint_u32v",
     "splitmix64",
     "DeviceHashSet",
+    "bit_select",
+    "mask_to_words",
+    "mask_words",
+    "pack_bits_host",
+    "popcount_words",
+    "words_to_mask",
 ]

@@ -1,0 +1,104 @@
+"""Packed enabled-mask bitmaps: the mask lane of the sparse dispatch.
+
+The sparse action-dispatch pipeline (checkers/tpu_sortmerge.py
+``sparse_pair_candidates``) consumes the per-state enabled mask as
+``ceil(K/32)`` uint32 words per row, GPUexplore-style (guards compiled
+to bitwise ops over packed words, arXiv:1801.05857) — the peel loop
+and the pair compaction never touch a dense ``[F, K]`` bool tensor.
+This module is the single home of the word layout so the three
+producers/consumers can't drift:
+
+* encodings that only provide a dense ``bool[K]`` mask
+  (``enabled_mask_vec``) are packed by the ENGINE with
+  :func:`mask_to_words`;
+* encodings that build the packed words directly from shift-mask field
+  extracts (``enabled_bits_vec`` — the compiled actor codegen, PERF.md
+  §ordered) hand the engine ``uint32[L]`` rows and skip the dense mask
+  entirely; :func:`words_to_mask` recovers the bool view for the
+  ``SparseEncodedModel`` contract (and its differential tests) without
+  a gather;
+* :func:`popcount_words` supplies the per-row enabled counts that size
+  the pair buffers.
+
+Word layout (everywhere): slot ``k`` lives in word ``k // 32`` at bit
+``k % 32``; tail bits of the last word are zero.
+"""
+
+from __future__ import annotations
+
+
+def mask_words(k: int) -> int:
+    """Words per row for a K-slot mask."""
+    return (int(k) + 31) // 32
+
+
+def pack_bits_host(flags) -> tuple:
+    """Host-side packing of a bool sequence into this module's word
+    layout (bit ``i`` of word ``i // 32``), the format
+    :func:`bit_select` reads. Always at least one word."""
+    words = [0] * max(1, mask_words(len(flags)))
+    for i, f in enumerate(flags):
+        if f:
+            words[i // 32] |= 1 << (i % 32)
+    return tuple(words)
+
+
+def mask_to_words(jnp, mask):
+    """``bool[..., K] -> uint32[..., ceil(K/32)]`` — pack a dense
+    enabled mask into bitmap words (pad, reshape, weighted sum; pure
+    elementwise + reduce, no gather)."""
+    k = mask.shape[-1]
+    L = mask_words(k)
+    pad = [(0, 0)] * (mask.ndim - 1) + [(0, L * 32 - k)]
+    mp = jnp.pad(mask, pad)
+    return jnp.sum(
+        mp.reshape(mask.shape[:-1] + (L, 32)).astype(jnp.uint32)
+        * (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)),
+        axis=-1,
+        dtype=jnp.uint32,
+    )
+
+
+def words_to_mask(jnp, words, k: int):
+    """``uint32[..., L] -> bool[..., K]`` — unpack bitmap words to the
+    dense mask. Broadcast shifts + one static slice, no gather (the
+    codegen-shape tests trace through this)."""
+    from jax import lax
+
+    bits = (
+        words[..., :, None] >> jnp.arange(32, dtype=jnp.uint32)
+    ) & jnp.uint32(1)
+    flat = bits.reshape(words.shape[:-1] + (words.shape[-1] * 32,))
+    return lax.slice_in_dim(flat, 0, k, axis=-1) != 0
+
+
+def popcount_words(jnp, words):
+    """``uint32[..., L] -> uint32[...]`` — set bits per row (the
+    per-row enabled-slot count)."""
+    from jax import lax
+
+    return jnp.sum(
+        lax.population_count(words), axis=-1, dtype=jnp.uint32
+    )
+
+
+def bit_select(jnp, words, idx):
+    """Gather-free bit lookup in a HOST-CONSTANT packed bit table.
+
+    ``words`` is a python sequence of uint32 ints (bit ``i`` of word
+    ``i // 32`` holds entry ``i``); ``idx`` is a traced uint32 scalar.
+    The word is picked by a static where-chain and the bit by a shift —
+    shift-mask ops only, so a vmapped caller stays 1-D ``[N]``-shaped
+    (no gather, no ``[N, 1]`` temps). Cost is ``len(words)`` selects:
+    callers tabulate per-slot, per-actor-state bits whose domains are
+    component closures (tens of entries), not state spaces.
+    """
+    idx = idx.astype(jnp.uint32)
+    w = jnp.uint32(words[0] if words else 0)
+    for wi in range(1, len(words)):
+        w = jnp.where(
+            (idx >> jnp.uint32(5)) == jnp.uint32(wi),
+            jnp.uint32(words[wi]),
+            w,
+        )
+    return (w >> (idx & jnp.uint32(31))) & jnp.uint32(1)

@@ -174,7 +174,10 @@ def _match_vma(x, vma):
     import jax
     from jax import lax
 
-    cur = getattr(jax.typeof(x), "vma", frozenset())
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:  # older jax: no vma typing, nothing to promote
+        return x
+    cur = getattr(typeof(x), "vma", frozenset())
     need = tuple(sorted(set(vma) - set(cur)))
     return lax.pcast(x, need, to="varying") if need else x
 
@@ -182,9 +185,12 @@ def _match_vma(x, vma):
 def _inputs_vma(*arrays) -> frozenset:
     import jax
 
+    typeof = getattr(jax, "typeof", None)
     vma: frozenset = frozenset()
+    if typeof is None:  # older jax: no vma typing
+        return vma
     for a in arrays:
-        vma = vma | getattr(jax.typeof(a), "vma", frozenset())
+        vma = vma | getattr(typeof(a), "vma", frozenset())
     return vma
 
 

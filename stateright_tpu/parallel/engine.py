@@ -459,10 +459,19 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             e_overflow=P(),
             done=P(),
         )
+        # Older jax (no lax.pvary) has no replication rule for
+        # while_loop inside shard_map: disable the rep checker there
+        # (its named workaround; newer jax type-checks varying-ness,
+        # which the vma promotions in ops/hashset.py satisfy).
+        from jax import lax as _lax
+
+        sm_kw = {} if hasattr(_lax, "pvary") else {"check_rep": False}
         seed_sm = shard_map(
-            seed_local, mesh=mesh, in_specs=P(), out_specs=specs
+            seed_local, mesh=mesh, in_specs=P(), out_specs=specs,
+            **sm_kw,
         )
         chunk_sm = shard_map(
-            chunk, mesh=mesh, in_specs=(specs,), out_specs=(specs, P())
+            chunk, mesh=mesh, in_specs=(specs,), out_specs=(specs, P()),
+            **sm_kw,
         )
         return jax.jit(seed_sm), jax.jit(chunk_sm, donate_argnums=0)

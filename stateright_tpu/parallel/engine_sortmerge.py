@@ -72,6 +72,35 @@ from ..checkers.tpu_sortmerge import SortMergeTpuBfsChecker
 _SENT = 0xFFFFFFFF
 
 
+def dest_tile_width(w: int, track_paths: bool) -> int:
+    """Lanes of a routed destination tile (see dest_tile_pack)."""
+    return (w + 3 if track_paths else w + 1) + 2
+
+
+def dest_tile_pack(jnp, state, par_lo, par_hi, ebits, key_lo, key_hi):
+    """THE sharded routed-tile lane layout: ``[state 0:W | par_lo W |
+    par_hi W+1 (paths only) | ebits E-1 | key_lo E | key_hi E+1]``
+    with ``E = W+3`` (paths) or ``W+1`` — every ``dest_block`` variant
+    packs through this helper, and ``make_merge`` unpacks by the same
+    offsets (``recv[:, E]``/``recv[:, EB]``), so the tile layout can't
+    drift between the three pack sites and the post-shuffle merge.
+    NOT the single-chip payload layout: ``payload_pack``
+    (checkers/tpu_sortmerge.py) orders key limbs before parent meta
+    and is unpacked by ``payload_unpack`` at the merge fetch.
+
+    Columns accept 1-D ``[B]`` or already-sliced 2-D ``[B, 1]``
+    arrays; ``par_lo``/``par_hi`` are None when paths are off."""
+
+    def col(x):
+        return x if x.ndim == 2 else x[:, None]
+
+    parts = [state]
+    if par_lo is not None:
+        parts += [col(par_lo), col(par_hi)]
+    parts += [col(ebits), col(key_lo), col(key_hi)]
+    return jnp.concatenate(parts, axis=1)
+
+
 class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
     """``CheckerBuilder.spawn_tpu_sharded_sortmerge()`` — the sort-merge
     wave engine over a ``jax.sharding.Mesh``. Inherits the result /
@@ -176,7 +205,7 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             _ladder,
             sparse_pair_candidates,
         )
-        from ..encoding import EncodedModelBase, normalize_step_slot_result
+        from ..encoding import has_trivial_boundary, normalize_step_slot_result
 
         enc = self.encoded
         props = list(self.model.properties())
@@ -210,11 +239,7 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                 ),
                 tuple,
             )
-            wb = getattr(type(enc), "within_boundary_vec", None)
-            sparse_boundary = (
-                wb is not EncodedModelBase.within_boundary_vec
-                and not getattr(enc, "trivial_boundary", False)
-            )
+            sparse_boundary = not has_trivial_boundary(enc)
         if n0 > C:
             raise ValueError(
                 f"per-shard capacity {C} < {n0} init states"
@@ -266,7 +291,9 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
         # Payload lanes: state + (parent fp) + ebits + own fp (owners
         # don't re-hash after the shuffle). All-zero fp lanes mark
         # unused bucket slots (fingerprints are never 0).
-        E = W + 3 if track_paths else W + 1
+        # Routed-tile lane offsets, tied to dest_tile_pack's layout:
+        # key limbs at [E, E+1], ebits at EB.
+        E = dest_tile_width(W, track_paths) - 2
         EB = E - 1
         mesh = self.mesh
 
@@ -638,6 +665,10 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                             return kl, kh, pok, nc, eov | ev, rok
 
                         def pv(x):
+                            # Older jax: no pvary, no unvarying carry
+                            # typing — identity.
+                            if not hasattr(lax, "pvary"):
+                                return x
                             return lax.pvary(x, "shard")
 
                         kl, kh, pok, nc_acc, eov_acc, row_ok = (
@@ -803,25 +834,19 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                             st, _, _ = step_pairs(
                                 frontier_c[par], pslot[srow]
                             )
-                        parts = [st]
-                        if track_paths:
-                            parts += [
-                                ex["f_lo"][par][:, None],
-                                ex["f_hi"][par][:, None],
-                            ]
-                        parts += [
-                            ex["ebits"][par][:, None],
-                            s_lo[idx][:, None],
-                            s_hi[idx][:, None],
-                        ]
-                        return jnp.concatenate(parts, axis=1)
+                        return dest_tile_pack(
+                            jnp, st,
+                            ex["f_lo"][par] if track_paths else None,
+                            ex["f_hi"][par] if track_paths else None,
+                            ex["ebits"][par], s_lo[idx], s_hi[idx],
+                        )
                 elif cand_state is not None:
-                    parts = [cand_state]
-                    if track_paths:
-                        parts += [pmeta[:, 1:2], pmeta[:, 2:3]]
-                    parts += [pmeta[:, 0:1], k_lo[:, None],
-                              k_hi[:, None]]
-                    cpay = jnp.concatenate(parts, axis=1)
+                    cpay = dest_tile_pack(
+                        jnp, cand_state,
+                        pmeta[:, 1:2] if track_paths else None,
+                        pmeta[:, 2:3] if track_paths else None,
+                        pmeta[:, 0:1], k_lo, k_hi,
+                    )
                     spay = jnp.pad(
                         cpay[s_row], ((0, Bd_c), (0, 0))
                     )
@@ -857,11 +882,12 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                         succ_t, _, _ = step_pairs(
                             frontier_c[par], m[:, 1]
                         )
-                        parts = [succ_t]
-                        if track_paths:
-                            parts += [m[:, 3:4], m[:, 4:5]]
-                        parts += [m[:, 2:3], kk[:, 0:1], kk[:, 1:2]]
-                        return jnp.concatenate(parts, axis=1)
+                        return dest_tile_pack(
+                            jnp, succ_t,
+                            m[:, 3:4] if track_paths else None,
+                            m[:, 4:5] if track_paths else None,
+                            m[:, 2:3], kk[:, 0:1], kk[:, 1:2],
+                        )
 
                 def dest_tile(d):
                     start = starts[d]
@@ -991,11 +1017,18 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             e_overflow=P(),
             done=P(),
         )
+        # Older jax (no lax.pvary) has no replication rule for
+        # while_loop inside shard_map: disable the rep checker there
+        # (its named workaround). Newer jax type-checks varying-ness
+        # instead, which the pvary/pcast promotions satisfy.
+        sm_kw = {} if hasattr(lax, "pvary") else {"check_rep": False}
         seed_sm = shard_map(
-            seed_local, mesh=mesh, in_specs=P(), out_specs=specs
+            seed_local, mesh=mesh, in_specs=P(), out_specs=specs,
+            **sm_kw,
         )
         chunk_sm = shard_map(
-            chunk, mesh=mesh, in_specs=(specs,), out_specs=(specs, P())
+            chunk, mesh=mesh, in_specs=(specs,), out_specs=(specs, P()),
+            **sm_kw,
         )
         return jax.jit(seed_sm), jax.jit(chunk_sm, donate_argnums=0)
 

@@ -700,7 +700,10 @@ def test_compiled_ordered_abd_3s_depth_differential():
     overapprox-compiled FIFO encoding matches host BFS state-for-state
     at a bounded depth, pinning the encoding semantics the full
     1,212,979-state device run (bench.py; reproduced across runs on
-    real TPU, round 5) builds on."""
+    real TPU, round 5) builds on. Depth 10 (1,066 states; was 7/171,
+    ADVICE r5): encoding bugs that first manifest past the shallow
+    prefix — queue-depth interleavings, second-round timestamps —
+    fail here instead of moving the bench expectation."""
     from stateright_tpu.models.linearizable_register import (
         AbdModelCfg,
         abd_model,
@@ -712,11 +715,12 @@ def test_compiled_ordered_abd_3s_depth_differential():
             Network.new_ordered(),
         )
 
-    host = mk().checker().target_max_depth(7).spawn_bfs().join()
+    host = mk().checker().target_max_depth(10).spawn_bfs().join()
+    assert host.unique_state_count() == 1066
     m = mk()
     tpu = (
         m.checker()
-        .target_max_depth(7)
+        .target_max_depth(10)
         .spawn_tpu_sortmerge(
             encoded=m.to_encoded(),
             capacity=1 << 13,

@@ -1,0 +1,252 @@
+"""Codegen-shape tests: the compiled-actor op shapes that PERF.md
+§ordered priced — pinned at the jaxpr level so the 8x
+compiled-codegen tax can't silently regress on CPU-only CI.
+
+The round-5 device trace attributed the compiled path's per-state cost
+to two codegen artifacts:
+
+* ~1.6s/run of 1-D gathers inside the generated enabled mask (per-slot
+  table gathers where hand encodings use shift-mask field extracts) —
+  so the MASK path must contain NO gather primitives at all, never
+  materialize the dense ``[N, K]`` bool mask, and emit no ``[N, 1]``
+  elementwise ALU ops;
+* ~470ms/run of ``[N, 1]``-shaped elementwise ops (stack-of-scalars
+  concats whose operands pay the full 128-lane tile-padding tax, and
+  which XLA cannot fuse through a concatenate) — so the STEP path must
+  emit no ``[N, 1]`` ALU ops and no wide concatenates of ``[N, 1]``
+  lanes.
+
+Calibration: the allowed residue matches what the HAND paxos encoding
+(models/paxos_tpu.py, the 2M st/s reference point) emits under the
+same audit — table-row gathers by traced slot (the intended sparse
+idiom), ``[N, 1]`` slices from consuming multi-lane gather rows, and
+2-operand ``[N, 1]`` concats that build gather index pairs. Those
+fuse; ``[N, 1]`` COMPUTE and mask-path gathers do not.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from stateright_tpu.actor import Network  # noqa: E402
+from stateright_tpu.actor.compile import compile_actor_model  # noqa: E402
+from stateright_tpu.models.ping_pong import (  # noqa: E402
+    PingPongCfg,
+    ping_pong_model,
+)
+from stateright_tpu.ops.bitmask import (  # noqa: E402
+    bit_select,
+    mask_to_words,
+    mask_words,
+    pack_bits_host,
+    popcount_words,
+    words_to_mask,
+)
+from test_actor_compile import ping_pong_specs  # noqa: E402
+
+N = 64  # batch rows in every traced vmap
+
+#: elementwise/ALU primitives — a [N, 1] output from any of these is
+#: real compute at 128x lane padding, the PERF.md §ordered tax.
+_ALU = {
+    "add", "sub", "mul", "div", "rem", "and", "or", "xor",
+    "shift_left", "shift_right_logical", "shift_right_arithmetic",
+    "select_n", "eq", "ne", "lt", "le", "gt", "ge", "min", "max",
+    "population_count", "convert_element_type", "neg", "not",
+}
+
+
+def _audit(jaxpr):
+    """Walk every eqn (including sub-jaxprs): gather count, [N, 1] ALU
+    ops, [N, K]-or-wider bool outputs, and concatenates of ≥3 [N, 1]
+    operands (the stack-of-lane-scalars pattern)."""
+    stats = dict(gathers=0, alu_n1=[], wide_concat_n1=0, bool_nk=[])
+
+    def walk(jx, K):
+        for eq in jx.eqns:
+            name = eq.primitive.name
+            if "gather" in name:
+                stats["gathers"] += 1
+            if name == "concatenate":
+                n1_ops = sum(
+                    1 for v in eq.invars
+                    if getattr(v.aval, "shape", None) == (N, 1)
+                )
+                if n1_ops >= 3:
+                    stats["wide_concat_n1"] += 1
+            for v in eq.outvars:
+                sh = getattr(v.aval, "shape", None)
+                if sh == (N, 1) and name in _ALU:
+                    stats["alu_n1"].append(name)
+                if (
+                    sh == (N, K)
+                    and getattr(v.aval, "dtype", None) == np.bool_
+                ):
+                    stats["bool_nk"].append(name)
+            for p in eq.params.values():
+                if hasattr(p, "jaxpr"):
+                    walk(p.jaxpr, K)
+                if isinstance(p, (list, tuple)):
+                    for q in p:
+                        if hasattr(q, "jaxpr"):
+                            walk(q.jaxpr, K)
+
+    return stats, walk
+
+
+def _audit_enc(enc):
+    vecs = jnp.zeros((N, enc.width), jnp.uint32)
+    slots = jnp.zeros((N,), jnp.uint32)
+    out = {}
+    for label, jx in (
+        ("bits", jax.make_jaxpr(jax.vmap(enc.enabled_bits_vec))(vecs)),
+        ("mask", jax.make_jaxpr(jax.vmap(enc.enabled_mask_vec))(vecs)),
+        (
+            "step",
+            jax.make_jaxpr(jax.vmap(enc.step_slot_vec))(vecs, slots),
+        ),
+    ):
+        stats, walk = _audit(jx)
+        walk(jx.jaxpr, enc.max_actions)
+        out[label] = stats
+    return out
+
+
+def _assert_shapes(enc):
+    a = _audit_enc(enc)
+    # Mask path: pure shift-mask field extracts. No gathers anywhere
+    # (the packed-words path and the derived dense view alike), no
+    # [N, 1] ALU, and the packed path never materializes bool [N, K].
+    assert a["bits"]["gathers"] == 0, "enabled_bits_vec has gathers"
+    assert a["mask"]["gathers"] == 0, "enabled_mask_vec has gathers"
+    assert a["bits"]["alu_n1"] == [], a["bits"]["alu_n1"]
+    assert a["bits"]["bool_nk"] == [], (
+        "enabled_bits_vec materializes the dense [N, K] bool mask"
+    )
+    assert a["bits"]["wide_concat_n1"] == 0
+    # Step path: the four row-table gathers (params, flat transition,
+    # packed history, crash mask) are the intended sparse idiom —
+    # everything else is 1-D lane ALU. No [N, 1] compute, no
+    # stack-of-scalars concats.
+    assert a["step"]["gathers"] <= 4, (
+        f"step_slot_vec grew table gathers: {a['step']['gathers']}"
+    )
+    assert a["step"]["alu_n1"] == [], a["step"]["alu_n1"]
+    assert a["step"]["wide_concat_n1"] == 0, (
+        "step_slot_vec stacks per-lane scalars through [N, 1] concats"
+    )
+    return a
+
+
+def _ping_pong(network=None, **cfg_kw):
+    cfg = PingPongCfg(**cfg_kw)
+    model = ping_pong_model(cfg)
+    if network is not None:
+        model = model.init_network(network)
+    return model, ping_pong_specs(cfg)
+
+
+def test_codegen_shapes_unordered_nondup():
+    model, specs = _ping_pong(
+        Network.new_unordered_nonduplicating(), max_nat=3
+    )
+    enc = compile_actor_model(model, **specs)
+    _assert_shapes(enc)
+
+
+def test_codegen_shapes_unordered_dup_lossy():
+    model, specs = _ping_pong(max_nat=2)
+    enc = compile_actor_model(model.set_lossy_network(True), **specs)
+    _assert_shapes(enc)
+
+
+def test_codegen_shapes_ordered_integer_queues():
+    """The FIFO lane (abd-ordered's shape family): integer-queue pop,
+    head-match presence, and send-append chains must all trace to 1-D
+    lane ops."""
+    model, specs = _ping_pong(Network.new_ordered(), max_nat=3)
+    enc = compile_actor_model(model, **specs, closure="reachable")
+    _assert_shapes(enc)
+
+
+def test_codegen_shapes_timers_and_crashes():
+    from stateright_tpu.actor import Actor, ActorModel
+
+    class Ticker(Actor):
+        def on_start(self, id, out):
+            out.set_timer("tick", (1.0, 2.0))
+            return 0
+
+        def on_msg(self, id, state, src, msg, out):
+            pass
+
+        def on_timeout(self, id, state, timer, out):
+            if state.value < 2:
+                state.set(state.value + 1)
+                out.set_timer("tick", (1.0, 2.0))
+
+    model = (
+        ActorModel(cfg=None).actor(Ticker()).actor(Ticker())
+        .set_max_crashes(1)
+    )
+    enc = compile_actor_model(model, properties={})
+    _assert_shapes(enc)
+
+
+def test_bits_agree_with_dense_mask_and_validity():
+    """The packed words ARE the mask: words_to_mask(enabled_bits_vec)
+    equals enabled_mask_vec equals step_vec validity, over every
+    reachable state of the nondup ping-pong."""
+    from collections import deque
+
+    model, specs = _ping_pong(
+        Network.new_unordered_nonduplicating(), max_nat=3
+    )
+    enc = compile_actor_model(model, **specs)
+    seen = set()
+    q = deque(model.init_states())
+    for s in list(q):
+        seen.add(tuple(enc.encode(s).tolist()))
+    while q:
+        s = q.popleft()
+        for n2 in model.next_states(s):
+            if not model.within_boundary(n2):
+                continue
+            k = tuple(enc.encode(n2).tolist())
+            if k not in seen:
+                assert len(seen) < 5000
+                seen.add(k)
+                q.append(n2)
+    vecs = jnp.asarray(np.array(sorted(seen), dtype=np.uint32))
+    bits = np.asarray(jax.jit(jax.vmap(enc.enabled_bits_vec))(vecs))
+    mask = np.asarray(jax.jit(jax.vmap(enc.enabled_mask_vec))(vecs))
+    unpacked = np.asarray(
+        words_to_mask(jnp, jnp.asarray(bits), enc.max_actions)
+    )
+    assert (unpacked == mask).all()
+    _, valid, _ = jax.jit(jax.vmap(enc.step_vec))(vecs)
+    assert (mask == np.asarray(valid)).all()
+    counts = np.asarray(popcount_words(jnp, jnp.asarray(bits)))
+    assert (counts == mask.sum(axis=1)).all()
+
+
+def test_bitmask_helpers_roundtrip():
+    rng = np.random.default_rng(7)
+    for k in (1, 31, 32, 33, 110, 257):
+        m = rng.random((5, k)) < 0.4
+        words = np.asarray(mask_to_words(jnp, jnp.asarray(m)))
+        assert words.shape == (5, mask_words(k))
+        back = np.asarray(words_to_mask(jnp, jnp.asarray(words), k))
+        assert (back == m).all()
+        cnt = np.asarray(popcount_words(jnp, jnp.asarray(words)))
+        assert (cnt == m.sum(axis=1)).all()
+    # bit_select against direct indexing, across word boundaries.
+    flags = (rng.random(77) < 0.5).tolist()
+    words = pack_bits_host(flags)
+    idx = jnp.arange(77, dtype=jnp.uint32)
+    got = np.asarray(
+        jax.vmap(lambda i: bit_select(jnp, words, i))(idx)
+    )
+    assert (got == np.array(flags)).all()

@@ -232,3 +232,111 @@ def test_auto_budget_resizes_from_measured_peak(tmp_path, monkeypatch):
     # A fresh checker starts from the persisted converged budget.
     c2 = spawn()
     assert c2.cand_capacity == c.cand_capacity
+
+
+def test_auto_budget_explicit_pair_width_wins(tmp_path, monkeypatch):
+    """cand_capacity="auto" fills pair_width from the store only as a
+    DEFAULT: an explicitly passed pair_width must survive (ADVICE r5 —
+    the store used to silently overwrite it)."""
+    import json
+
+    from stateright_tpu.actor import Network
+    from stateright_tpu.actor.compile import compile_actor_model
+    from stateright_tpu.checkers import tpu_sortmerge as sm
+    from stateright_tpu.models.ping_pong import (
+        PingPongCfg,
+        ping_pong_model,
+    )
+    from test_actor_compile import ping_pong_specs
+
+    store = tmp_path / "budgets.json"
+    monkeypatch.setattr(
+        sm.SortMergeTpuBfsChecker,
+        "_budget_store",
+        lambda self: str(store),
+    )
+    cfg = PingPongCfg(max_nat=3)
+    model = ping_pong_model(cfg).init_network(
+        Network.new_unordered_nonduplicating()
+    )
+    enc = compile_actor_model(model, **ping_pong_specs(cfg))
+
+    def spawn(**kw):
+        return model.checker().spawn_tpu_sortmerge(
+            encoded=enc,
+            capacity=1 << 10,
+            frontier_capacity=1 << 7,
+            cand_capacity="auto",
+            track_paths=False,
+            **kw,
+        )
+
+    c0 = spawn()
+    assert c0._use_sparse()
+    store.write_text(json.dumps({
+        c0._budget_key(): {"cand_capacity": 4096, "pair_width": 7},
+    }))
+    # No explicit pair_width: the persisted value fills the default.
+    assert spawn()._pair_width() == 7
+    # Explicit pair_width: the constructor argument wins.
+    c = spawn(pair_width=3)
+    assert c.pair_width == 3
+    assert c._pair_width() == 3
+    assert c.cand_capacity == 4096  # cand budget still adopted
+
+
+def test_save_budget_concurrent_writers_keep_all_keys(
+    tmp_path, monkeypatch
+):
+    """The budget store is shared by concurrent checker processes
+    writing DIFFERENT keys; the save cycle is serialized on a lock
+    file with a re-read before the atomic replace, so no writer drops
+    another's entry (ADVICE r5: the unlocked read-modify-write lost
+    the race loser's key)."""
+    import copy
+    import json
+    import threading
+    import time
+
+    from stateright_tpu.checkers import tpu_sortmerge as sm
+
+    store = tmp_path / "budgets.json"
+    monkeypatch.setattr(
+        sm.SortMergeTpuBfsChecker,
+        "_budget_store",
+        lambda self: str(store),
+    )
+    base = (
+        TwoPhaseSys(rm_count=3)
+        .checker()
+        .spawn_tpu_sortmerge(
+            capacity=512,
+            frontier_capacity=128,
+            cand_capacity="auto",
+            track_paths=False,
+        )
+    )
+    # One checker per simulated process, each saving its own key;
+    # widen the read->replace window so an unlocked implementation
+    # reliably loses keys.
+    real_dump = json.dump
+
+    def slow_dump(*a, **kw):
+        time.sleep(0.01)
+        return real_dump(*a, **kw)
+
+    monkeypatch.setattr(json, "dump", slow_dump)
+    writers = []
+    for i in range(8):
+        c = copy.copy(base)
+        c._budget_key = lambda i=i: f"key-{i}"
+        writers.append(c)
+    threads = [
+        threading.Thread(target=c._save_budget) for c in writers
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    data = json.loads(store.read_text())
+    assert set(data) == {f"key-{i}" for i in range(8)}

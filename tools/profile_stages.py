@@ -177,17 +177,25 @@ def stage_profile(kind, n, caps, target):
     mb = c.mask_budget_cells
 
     def mask_only(fr):
+        # Mirror the engine (sparse_pair_candidates): packed bitmap
+        # words straight from the encoding when it provides them, the
+        # dense-mask packing fallback otherwise.
+        bits_fn = getattr(enc, "enabled_bits_vec", None)
+
         def mask_bits(tf, tfv):
+            from stateright_tpu.ops.bitmask import (
+                mask_to_words,
+                popcount_words,
+            )
+
+            if bits_fn is not None:
+                tb = jax.vmap(bits_fn)(tf)
+                tb = jnp.where(tfv[:, None], tb, jnp.uint32(0))
+                return tb, popcount_words(jnp, tb)
             m = jax.vmap(enc.enabled_mask_vec)(tf)
             m = m & tfv[:, None]
             tc = jnp.sum(m, axis=1, dtype=jnp.uint32)
-            mp = jnp.pad(m, ((0, 0), (0, L * 32 - K)))
-            tb = jnp.sum(
-                mp.reshape(-1, L, 32).astype(jnp.uint32)
-                * (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)),
-                axis=2, dtype=jnp.uint32,
-            )
-            return tb, tc
+            return mask_to_words(jnp, m), tc
 
         if F_f * K > mb:
             NTm = _divisor_at_least(F_f, -(-F_f * K // mb))

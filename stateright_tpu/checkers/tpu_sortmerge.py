@@ -322,7 +322,12 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         f_min: int = 1 << 15,
         v_min: int = 1 << 19,
         ladder_step: int = 2,
-        v_ladder_step: int = 4,
+        # Round 6: the visited ladder default tightened 4 -> 2 (the
+        # wave-wall profile showed class-quantization waste as a
+        # leading out-of-stage term, and every hand-tuned big-lane
+        # config had already overridden to 2; the persistent XLA cache
+        # absorbs the extra merge variants' compile time).
+        v_ladder_step: int = 2,
         flat_budget_bytes: int = 1 << 30,
         sparse: bool | None = None,
         pair_width: int | None = None,
@@ -763,6 +768,18 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     ).astype(jnp.int32)
                 f_overflow = c["f_overflow"] | (new_count > F)
 
+                # Class-local carries (round 6, PERF.md §wave-wall):
+                # each fetch-class branch updates the CARRIED buffers
+                # in place with dynamic_update_slice blocks of its OWN
+                # class size NF_c — frontier rows, ebits, the visited
+                # key append, and the parent log all touch NF_c rows
+                # instead of reconstructing peak-shape tensors (the
+                # old branches padded every output to full F with
+                # concats, so a 2-row tail wave paid the same carry
+                # copies as the 686k-row peak wave). Rows past NF_c
+                # keep stale values; fval masks them everywhere (the
+                # same invariant the sentinel tails of the visited
+                # append already relied on).
                 def make_fetch(NF_c):
                     def br(_):
                         pos = nf_pos[:NF_c]
@@ -772,80 +789,83 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                         )
                         (state_rows, par_lo, par_hi, row_ebits,
                          key_lo, key_hi) = fetch(nf_row)
-
-                        def pad(x, fill):
-                            if NF_c == F:
-                                return x
-                            ps = (F - NF_c,) + x.shape[1:]
-                            return jnp.concatenate(
-                                [x, jnp.full(ps, fill, x.dtype)]
-                            )
-
-                        return (
-                            pad(jnp.where(valid[:, None], state_rows,
-                                          jnp.uint32(0)), 0),
-                            pad(jnp.where(valid, row_ebits, 0), 0),
-                            pad(jnp.where(valid, key_lo,
-                                          jnp.uint32(_SENT)), _SENT),
-                            pad(jnp.where(valid, key_hi,
-                                          jnp.uint32(_SENT)), _SENT),
-                            pad(jnp.where(valid, par_lo, 0), 0)
-                            if track_paths else jnp.zeros(0, jnp.uint32),
-                            pad(jnp.where(valid, par_hi, 0), 0)
-                            if track_paths else jnp.zeros(0, jnp.uint32),
+                        z = jnp.uint32(0)
+                        frontier2 = lax.dynamic_update_slice(
+                            c["frontier"],
+                            jnp.where(valid[:, None], state_rows,
+                                      jnp.uint32(0)),
+                            (z, z),
                         )
+                        ebits2 = lax.dynamic_update_slice(
+                            c["ebits"],
+                            jnp.where(valid, row_ebits, 0),
+                            (z,),
+                        )
+                        # Visited append: the winners' keys as one
+                        # contiguous sentinel-padded block at the
+                        # running unique-count offset (no sort, no
+                        # scatter; keys came packed with the payload
+                        # gather).
+                        v_lo2 = lax.dynamic_update_slice(
+                            c["v_lo"],
+                            jnp.where(valid, key_lo,
+                                      jnp.uint32(_SENT)),
+                            (c["new"],),
+                        )
+                        v_hi2 = lax.dynamic_update_slice(
+                            c["v_hi"],
+                            jnp.where(valid, key_hi,
+                                      jnp.uint32(_SENT)),
+                            (c["new"],),
+                        )
+                        # Parent-log append: contiguous block write at
+                        # the running offset (no scatter); rows past
+                        # new_count are garbage the next block
+                        # overwrites.
+                        if track_paths:
+                            off = (c["pl_n"],)
+                            pc_lo = lax.dynamic_update_slice(
+                                c["pl_child_lo"],
+                                jnp.where(valid, key_lo, 0), off,
+                            )
+                            pc_hi = lax.dynamic_update_slice(
+                                c["pl_child_hi"],
+                                jnp.where(valid, key_hi, 0), off,
+                            )
+                            pp_lo = lax.dynamic_update_slice(
+                                c["pl_par_lo"],
+                                jnp.where(valid, par_lo, 0), off,
+                            )
+                            pp_hi = lax.dynamic_update_slice(
+                                c["pl_par_hi"],
+                                jnp.where(valid, par_hi, 0), off,
+                            )
+                        else:
+                            pc_lo = c["pl_child_lo"]
+                            pc_hi = c["pl_child_hi"]
+                            pp_lo = c["pl_par_lo"]
+                            pp_hi = c["pl_par_hi"]
+                        return (frontier2, ebits2, v_lo2, v_hi2,
+                                pc_lo, pc_hi, pp_lo, pp_hi)
                     return br
 
-                (next_frontier, next_ebits, app_lo, app_hi,
-                 np_lo, np_hi) = lax.switch(
+                (next_frontier, next_ebits, v_lo_new, v_hi_new,
+                 pl_child_lo, pl_child_hi, pl_par_lo,
+                 pl_par_hi) = lax.switch(
                     nf_class,
                     [make_fetch(n) for n in nf_ladder],
                     0,
                 )
                 nf_valid_f = jnp.arange(F) < new_count
-
-                # Visited append: the winners' keys as one contiguous
-                # sentinel-padded block at the running unique-count
-                # offset (no sort, no scatter; keys came packed with
-                # the payload gather).
-                v_lo_new = lax.dynamic_update_slice(
-                    c["v_lo"], app_lo, (c["new"],)
-                )
-                v_hi_new = lax.dynamic_update_slice(
-                    c["v_hi"], app_hi, (c["new"],)
-                )
-
-                # Parent-log append: contiguous block write at the
-                # running offset (no scatter); rows past new_count are
-                # garbage that the next wave's block overwrites.
                 if track_paths:
-                    nc_lo = jnp.where(nf_valid_f, app_lo, 0)
-                    nc_hi = jnp.where(nf_valid_f, app_hi, 0)
-                    off = (c["pl_n"],)
-                    pl_child_lo = lax.dynamic_update_slice(
-                        c["pl_child_lo"], nc_lo, off
-                    )
-                    pl_child_hi = lax.dynamic_update_slice(
-                        c["pl_child_hi"], nc_hi, off
-                    )
-                    pl_par_lo = lax.dynamic_update_slice(
-                        c["pl_par_lo"], np_lo, off
-                    )
-                    pl_par_hi = lax.dynamic_update_slice(
-                        c["pl_par_hi"], np_hi, off
-                    )
-                    # Clamp to the F rows the block write actually
-                    # wrote: on an f_overflow wave new_count can exceed
-                    # F, and _run raises before reconstruction — but
+                    # Clamp to the NF rows the largest block write can
+                    # hold: on an f_overflow wave new_count can exceed
+                    # it, and _run raises before reconstruction — but
                     # the live-count invariant should hold regardless.
                     pl_n = c["pl_n"] + jnp.minimum(
-                        new_count.astype(jnp.uint32), jnp.uint32(F)
+                        new_count.astype(jnp.uint32), jnp.uint32(NF)
                     )
                 else:
-                    pl_child_lo = c["pl_child_lo"]
-                    pl_child_hi = c["pl_child_hi"]
-                    pl_par_lo = c["pl_par_lo"]
-                    pl_par_hi = c["pl_par_hi"]
                     pl_n = c["pl_n"]
 
                 g = u64_add(
@@ -880,9 +900,14 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     frontier=next_frontier,
                     fval=nf_valid_f & cont,
                     ebits=next_ebits,
-                    n_frontier=jnp.where(
-                        cont, new_count.astype(jnp.uint32), jnp.uint32(0)
-                    ),
+                    # The true row count even when the run stops (the
+                    # wave loop gates on done/fval, so this is safe) —
+                    # frontier rows past the class-local block are
+                    # STALE now, so tooling that reruns stages on a
+                    # captured carry (tools/profile_stages.py) reads
+                    # the live-row count here instead of scanning for
+                    # zero rows.
+                    n_frontier=new_count.astype(jnp.uint32),
                     depth=jnp.where(cont, c["depth"] + 1, c["depth"]),
                     wchunk=c["wchunk"] + 1,
                     waves=c["waves"] + 1,
@@ -1468,6 +1493,12 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
 
         def cond(c):
             return ~c["done"] & (c["wchunk"] < waves_per_sync)
+
+        # Tooling hook (stateright_tpu/wavewall.py): the un-jitted wave
+        # body, re-traceable on a captured carry, so the wave-wall
+        # profiler can time/lower ONE wave in isolation (the chunk
+        # program hides per-wave structure inside the while_loop).
+        self._wave_body = body
 
         def chunk(carry):
             c = dict(carry, wchunk=jnp.int32(0))

@@ -82,7 +82,7 @@ def _2pc(sub: str, args: list[str]) -> None:
             sys_model.checker().spawn_tpu_sortmerge(
                 capacity=capacity,
                 frontier_capacity=max(256, capacity // 4),
-                cand_capacity=max(1024, capacity),
+                cand_capacity="auto",
             )
         )
     elif sub == "explore":
@@ -110,21 +110,25 @@ def _paxos(sub: str, args: list[str]) -> None:
             f"Model checking Single Decree Paxos with {client_count} "
             "clients on the TPU wave engine."
         )
-        from .models.paxos_tpu import TUNED_ENGINE_CAPS as caps
+        # STRUCTURAL sizes from the one shared table; per-wave budgets
+        # auto-size from measured peaks (cand_capacity="auto") — the
+        # round-5 TUNED_ENGINE_CAPS budget table is retired (VERDICT
+        # r5 item 6).
+        from .models.paxos_tpu import STRUCTURAL_SIZES as sizes
 
-        if client_count not in caps:
+        if client_count not in sizes:
             raise SystemExit(
                 f"paxos check-tpu supports 1-5 clients (got "
                 f"{client_count}): the TPU encoding's client-lane "
                 "packing caps at 5 (models/paxos_tpu.py)"
             )
-        kw = dict(caps[client_count])
         _report(
             paxos_model(cfg)
             .checker()
             .spawn_tpu_sortmerge(
                 track_paths=client_count <= 2,
-                **kw,
+                cand_capacity="auto",
+                **sizes[client_count],
             )
         )
     elif sub == "explore":

@@ -1061,55 +1061,134 @@ class PaxosEncoded(EncodedModelBase):
         )
         return self._sp
 
+    def _bits_word_tables(self) -> dict:
+        """Host-constant guard-CLASS masks for the word-native enabled
+        predicate (ops/bitmask.py builders): each slot's handler guard
+        depends on host constants (kind, dst, ballot) and a SMALL
+        state-dependent selector of its destination actor — so slots
+        group into classes sharing one enabling condition, and the
+        packed mask is an OR of condition-gated class masks instead of
+        a per-slot evaluation."""
+        if hasattr(self, "_bw"):
+            return self._bw
+        from ..ops.bitmask import slot_mask_host
+
+        K, S, NB = self.K, self.S, self.NB
+        get_s = {d: [] for d in range(S)}
+        put_s = {d: [] for d in range(S)}
+        dec_s = {d: [] for d in range(S)}
+        bal_s = {d: [[] for _ in range(NB + 1)] for d in range(S)}
+        putok_c = {j: [] for j in range(self.C)}
+        getok_c = {j: [] for j in range(self.C)}
+        for k, e in enumerate(self.universe):
+            if e.kind == "put":
+                put_s[e.dst].append(k)
+            elif e.kind == "get":
+                get_s[e.dst].append(k)
+            elif e.kind == "putok":
+                putok_c[self.clients.index(e.dst)].append(k)
+            elif e.kind == "getok":
+                getok_c[self.clients.index(e.dst)].append(k)
+            elif e.kind == "decided":
+                dec_s[e.dst].append(k)
+            else:
+                # Ballot-relation kinds, all guarded by ~decided[dst]:
+                # tabulate, per destination server and per possible
+                # adopted-ballot value v, the slots whose relation
+                # holds — the runtime then SELECTS one [L]-word row by
+                # the server's ballot field.
+                bt = e.ballot
+                for v in range(NB + 1):
+                    if (
+                        (e.kind == "prepare" and v < bt)
+                        or (e.kind == "prepared" and v == bt)
+                        or (e.kind == "accept" and v <= bt)
+                        or (e.kind == "accepted" and v == bt)
+                    ):
+                        bal_s[e.dst][v].append(k)
+        self._bw = dict(
+            # decided-kind slots merge into every ballot row: both are
+            # gated by ~decided[dst], so one select covers them.
+            nd={
+                d: tuple(
+                    slot_mask_host(K, bal_s[d][v] + dec_s[d])
+                    for v in range(NB + 1)
+                )
+                for d in range(S)
+            },
+            get={d: slot_mask_host(K, get_s[d]) for d in range(S)},
+            put={d: slot_mask_host(K, put_s[d]) for d in range(S)},
+            putok={j: slot_mask_host(K, putok_c[j])
+                   for j in range(self.C)},
+            getok={j: slot_mask_host(K, getok_c[j])
+                   for j in range(self.C)},
+        )
+        return self._bw
+
+    def enabled_bits_vec(self, vec):
+        """``uint32[ceil(K/32)]`` packed enabled mask, built
+        WORD-NATIVE (round 6): the net lanes already hold the envelope
+        presence bitmap in the ops/bitmask.py layout (slot k at bit
+        k%32 of word k//32 — the same layout ``orkey`` packs), and the
+        handler guard assembles from O(S·NB + C) condition-gated
+        host-constant class masks. No gather, no dense ``bool[K]``
+        anywhere — a vmapped caller stays ``[N, L]``-shaped, so the
+        engine's [F, K] predicate pass (the largest in-stage term at
+        paxos-4 shapes, PERF.md §wave-wall) collapses to [F, L] word
+        lanes."""
+        import jax.numpy as jnp
+
+        from ..ops.bitmask import (
+            const_words,
+            or_class_words,
+            select_words_host,
+        )
+
+        t = self._bits_word_tables()
+        net = vec[self.n_state_lanes:]
+        handled = None
+        for d in range(self.S):
+            lane = vec[d]
+            dec = ((lane >> jnp.uint32(self.B_DEC)) & jnp.uint32(1)) != 0
+            bal = (lane >> jnp.uint32(self.B_BALLOT)) & jnp.uint32(
+                (1 << self.W_BALLOT) - 1
+            )
+            # Undecided guards: the ballot-relation row selected by
+            # this server's adopted ballot (decided-kind bits ride the
+            # same rows), plus its put slots when no proposal is open.
+            w = select_words_host(jnp, t["nd"][d], bal)
+            if any(t["put"][d]):
+                prp = (lane >> jnp.uint32(self.B_PROP)) & jnp.uint32(
+                    (1 << self.W_PROP) - 1
+                )
+                w = w | jnp.where(
+                    prp == 0,
+                    const_words(jnp, t["put"][d]),
+                    jnp.uint32(0),
+                )
+            w = jnp.where(dec, const_words(jnp, t["get"][d]), w)
+            handled = w if handled is None else handled | w
+        cls = []
+        for j in range(self.C):
+            ph = (
+                vec[self._clane_index(j)] >> jnp.uint32(self._coff(j))
+            ) & jnp.uint32(3)
+            cls += [(ph == 0, t["putok"][j]), (ph == 1, t["getok"][j])]
+        handled = handled | or_class_words(jnp, cls, self.net_lanes)
+        return net & handled
+
     def enabled_mask_vec(self, vec):
-        """bool[K]: presence bit AND the dense handler's guard — must
+        """bool[K]: the dense view of :meth:`enabled_bits_vec` (the
+        words are the source of truth, so the two cannot drift) — must
         match ``step_vec``'s validity exactly (pinned by an exhaustive
         differential test over the 2-client space)."""
         import jax.numpy as jnp
 
-        t = self._sparse_tables()
-        net = vec[self.n_state_lanes:]
-        present = (
-            (net[jnp.asarray(t["k_lane"])] >> jnp.asarray(t["k_shift"]))
-            & jnp.uint32(1)
-        ) != 0
-        srv = vec[: self.S]
-        dec = ((srv >> jnp.uint32(self.B_DEC)) & jnp.uint32(1)) != 0
-        bal = (srv >> jnp.uint32(self.B_BALLOT)) & jnp.uint32(
-            (1 << self.W_BALLOT) - 1
+        from ..ops.bitmask import words_to_mask
+
+        return words_to_mask(
+            jnp, self.enabled_bits_vec(vec), self.max_actions
         )
-        prp = (srv >> jnp.uint32(self.B_PROP)) & jnp.uint32(
-            (1 << self.W_PROP) - 1
-        )
-        ph = jnp.stack(
-            [
-                (
-                    vec[self._clane_index(j)]
-                    >> jnp.uint32(self._coff(j))
-                )
-                & jnp.uint32(3)
-                for j in range(self.C)
-            ]
-        )
-        ds = jnp.asarray(t["dst_srv"])
-        d = dec[ds]
-        b = bal[ds]
-        p = prp[ds]
-        cph = ph[jnp.asarray(t["dst_cli"])]
-        k = jnp.asarray(t["kind"])
-        bt = jnp.asarray(t["ballot"])
-        handled = (
-            ((k == 0) & ~d & (p == 0))
-            | ((k == 1) & d)
-            | ((k == 2) & (cph == 0))
-            | ((k == 3) & (cph == 1))
-            | ((k == 4) & ~d & (b < bt))
-            | ((k == 5) & ~d & (b == bt))
-            | ((k == 6) & ~d & (b <= bt))
-            | ((k == 7) & ~d & (b == bt))
-            | ((k == 8) & ~d)
-        )
-        return present & handled
 
     def step_slot_vec(self, vec, slot):
         """Successor for one enabled (state, slot) pair; every dense
@@ -1356,43 +1435,31 @@ class PaxosEncoded(EncodedModelBase):
         return jnp.stack([linearizable, chosen])
 
 
-#: Measured engine budgets per client count, shared by the CLI and
-#: bench.py so a retune lands in exactly one place. Spaces: 1c=265,
-#: 2c=16,668, 3c=1,194,428, 4c=2,372,188, 5c=4,711,569. Candidate
-#: budgets track the measured enabled-pair peaks (3c 343,235; 4c
-#: 686,045; 5c 1,371,240) with ~15% headroom; max enabled slots per
-#: row is 8 at every client count, so pair_width 12-16 keeps margin
-#: and overflow is detected loudly. 5c additionally needs the
-#: padded-HBM sizing rule, coarser ladders, and the chunked sparse
-#: mode (PERF.md).
-TUNED_ENGINE_CAPS = {
-    1: dict(capacity=1 << 10, frontier_capacity=1 << 8,
-            cand_capacity=1 << 10, pair_width=16, tile_rows=1 << 18),
-    2: dict(capacity=1 << 15, frontier_capacity=1 << 12,
-            cand_capacity=1 << 14, pair_width=16, tile_rows=1 << 18),
-    3: dict(capacity=5 << 18, frontier_capacity=1 << 18,
-            cand_capacity=3 << 17, pair_width=10, tile_rows=1 << 18,
-            v_min=1 << 17, v_ladder_step=2),
+# Round 6: the TUNED_ENGINE_CAPS budget table is retired (VERDICT r5
+# item 6) — per-wave budgets auto-size from measured peaks
+# (``cand_capacity="auto"``, checkers/tpu_sortmerge.py) and the
+# pair-width default comes from ``pair_width_hint`` above. The
+# round-5 measured reference points the table carried — enabled-pair
+# peaks 3c 343,235 / 4c 686,045 / 5c 1,371,240, max 8-9 enabled slots
+# per row at every client count — now live in the auto-budget store
+# after one run, and in PERF.md for the record.
+
+#: STRUCTURAL engine sizes per client count — NOT tuning: capacity
+#: holds the pinned unique-state counts (265 / 16,668 / 1,194,428 /
+#: 2,372,188 / 4,711,569), frontier the measured wave peaks, and the
+#: 4c/5c memory knobs the padded-HBM sizing rules (PERF.md). Shared
+#: by bench.py, cli.py, and tools/profile_stages.py so a resize lands
+#: in exactly one place (the retune-drift property the retired budget
+#: table also served).
+STRUCTURAL_SIZES = {
+    1: dict(capacity=1 << 10, frontier_capacity=1 << 8),
+    2: dict(capacity=1 << 15, frontier_capacity=1 << 12),
+    3: dict(capacity=5 << 18, frontier_capacity=1 << 18),
     4: dict(capacity=5 << 19, frontier_capacity=1 << 19,
-            cand_capacity=11 << 16, pair_width=10, tile_rows=1 << 17,
-            v_min=1 << 18, v_ladder_step=2,
-            # pair_width 10: 9 overflowed (a >depth-7 row enables 9+
-            # slots — detected loudly, round 5); 10 runs clean and
-            # shrinks every F_f×EV grid 17% vs 12. tiles=64 halves the
-            # packed-append headroom; cand 11<<16 = 720,896 keeps 5%
-            # over the measured 686,045-pair peak (overflow loud).
-            # Measured 2.03M st/s (round 5; 1.11M round 4).
-            tiles=64),
+            tile_rows=1 << 17),
     5: dict(capacity=3 << 21, frontier_capacity=3 << 19,
-            cand_capacity=1500000, pair_width=10, tile_rows=1 << 17,
-            # Round-5 retune after the gather packing + NF-class fetch:
-            # fine f-ladder (the coarse round-4 ladder quantized
-            # mid-size waves up to 1.57M-row classes: 843k -> 1.34M
-            # st/s), payload-resident fetch (the [Ba, W+3] padded
-            # payload is ~900MB — fits), pair_width 10 as at 4c.
-            f_min=1 << 16, ladder_step=2, v_min=1 << 20,
-            v_ladder_step=2, flat_budget_bytes=2 << 30,
-            mask_budget_cells=1 << 26),
+            tile_rows=1 << 17, f_min=1 << 16,
+            flat_budget_bytes=2 << 30, mask_budget_cells=1 << 26),
 }
 
 

@@ -22,6 +22,15 @@ Actions (``max_actions = 2 + 5*N``), mirroring 2pc.rs actions():
   0: tm_commit        1: tm_abort
   per rm: tm_rcv_prepared, rm_prepare, rm_choose_abort,
           rm_rcv_commit, rm_rcv_abort
+
+Sparse action dispatch (round 6): the encoding also implements
+``SparseEncodedModel`` with a WORD-NATIVE ``enabled_bits_vec`` — every
+slot guard is a small function of one 2-bit RM field or a TM/message
+bit, so the packed ``uint32[ceil(K/32)]`` mask assembles from
+``4 + 2N`` condition-gated host-constant class masks (ops/bitmask.py
+builders) and no dense ``bool[K]`` row ever materializes. The engine's
+enabled-predicate pass therefore runs on K/32 word lanes for 2pc the
+same way it does for paxos and the compiled actor encodings.
 """
 
 from __future__ import annotations
@@ -48,6 +57,14 @@ class TwoPhaseSysEncoded(EncodedModelBase):
         self._prep_shift = 2
         self._msgs_shift = 2 + rm_count
         self.host_model = TwoPhaseSys(rm_count=rm_count)
+        #: exact per-row enabled-slot peak: after tm_abort a working RM
+        #: enables prepare + choose_abort + rcv_abort (3 each, 3N);
+        #: under TM Init a row caps at 2 TM slots + 2 per RM (working
+        #: and prepared-msg slots are exclusive per RM). The engine
+        #: detects overflow loudly if this reasoning ever breaks.
+        self.pair_width_hint = min(
+            max(3 * rm_count, 2 * rm_count + 2), self.max_actions
+        )
 
     def cache_key(self):
         """Compiled-wave sharing identity (see checkers/tpu.py)."""
@@ -172,6 +189,139 @@ class TwoPhaseSysEncoded(EncodedModelBase):
             valids.append((lane1 & abort_bit) != 0)
 
         return jnp.stack(succs), jnp.stack(valids)
+
+    # -- sparse action dispatch (SparseEncodedModel, round 6) -------------
+
+    def _bits_word_tables(self) -> dict:
+        """Host-constant guard-class masks (see the module docstring):
+        slots sharing one enabling condition share one packed mask."""
+        if hasattr(self, "_bw"):
+            return self._bw
+        from ..ops.bitmask import slot_mask_host
+
+        n, K = self.rm_count, self.max_actions
+        self._bw = dict(
+            tm_commit=slot_mask_host(K, [0]),
+            tm_abort=slot_mask_host(K, [1]),
+            rcv_commit=slot_mask_host(
+                K, [5 + 5 * rm for rm in range(n)]
+            ),
+            rcv_abort=slot_mask_host(
+                K, [6 + 5 * rm for rm in range(n)]
+            ),
+            working={
+                rm: slot_mask_host(K, [3 + 5 * rm, 4 + 5 * rm])
+                for rm in range(n)
+            },
+            rcv_prep={
+                rm: slot_mask_host(K, [2 + 5 * rm]) for rm in range(n)
+            },
+        )
+        return self._bw
+
+    def enabled_bits_vec(self, vec):
+        """``uint32[ceil(K/32)]`` packed enabled mask, word-native: an
+        OR of ``4 + 2N`` condition-gated host class masks — pure
+        scalar field extracts plus [L]-word selects, no gather, no
+        dense ``bool[K]``."""
+        import jax.numpy as jnp
+
+        from ..ops.bitmask import mask_words, or_class_words
+
+        t = self._bits_word_tables()
+        n = self.rm_count
+        ps, ms = self._prep_shift, self._msgs_shift
+        lane0, lane1 = vec[0], vec[1]
+        tm_init = (lane1 & jnp.uint32(3)) == 0
+        prep = (lane1 >> jnp.uint32(ps)) & jnp.uint32((1 << n) - 1)
+        classes = [
+            (tm_init & (prep == jnp.uint32((1 << n) - 1)),
+             t["tm_commit"]),
+            (tm_init, t["tm_abort"]),
+            ((lane1 & jnp.uint32(1 << ms)) != 0, t["rcv_commit"]),
+            ((lane1 & jnp.uint32(1 << (ms + 1))) != 0, t["rcv_abort"]),
+        ]
+        for rm in range(n):
+            working = (
+                (lane0 >> jnp.uint32(2 * rm)) & jnp.uint32(3)
+            ) == 0
+            prepared_msg = (
+                lane1 & jnp.uint32(1 << (ms + 2 + rm))
+            ) != 0
+            classes.append((working, t["working"][rm]))
+            classes.append((tm_init & prepared_msg, t["rcv_prep"][rm]))
+        return or_class_words(
+            jnp, classes, mask_words(self.max_actions)
+        )
+
+    def enabled_mask_vec(self, vec):
+        """bool[K]: the dense view of :meth:`enabled_bits_vec` (the
+        words are the source of truth, so the two cannot drift) —
+        equals ``step_vec``'s validity, pinned exhaustively by
+        tests/test_sortmerge.py over the rm=3 space."""
+        import jax.numpy as jnp
+
+        from ..ops.bitmask import words_to_mask
+
+        return words_to_mask(
+            jnp, self.enabled_bits_vec(vec), self.max_actions
+        )
+
+    def step_slot_vec(self, vec, slot):
+        """Successor for one enabled (state, slot) pair — branchless
+        selects over the slot arithmetic (``rm = (slot-2) // 5``,
+        action kind ``(slot-2) % 5``), 1-D lane ops only, zero
+        gathers (the per-slot constants are arithmetic in the slot
+        index, so no table is needed at all)."""
+        import jax.numpy as jnp
+
+        ps, ms = self._prep_shift, self._msgs_shift
+        lane0, lane1 = vec[0], vec[1]
+        slot = slot.astype(jnp.uint32)
+        rmslot = jnp.where(slot >= 2, slot - jnp.uint32(2),
+                           jnp.uint32(0))
+        rm = rmslot // jnp.uint32(5)
+        j = rmslot % jnp.uint32(5)
+        sh2 = jnp.uint32(2) * rm
+
+        # TM verdicts (slots 0/1).
+        tm_clear = lane1 & ~jnp.uint32(3)
+        l1_commit = tm_clear | jnp.uint32(_TM_COMMITTED) | jnp.uint32(
+            1 << ms
+        )
+        l1_abort = tm_clear | jnp.uint32(_TM_ABORTED) | jnp.uint32(
+            1 << (ms + 1)
+        )
+        # Per-RM lane updates (slots 2+5rm+j), shift amounts traced.
+        prepared_bit = jnp.uint32(1) << (jnp.uint32(ms + 2) + rm)
+        l1_rcv_prep = lane1 | (jnp.uint32(1) << (jnp.uint32(ps) + rm))
+        rm_clear = lane0 & ~(jnp.uint32(3) << sh2)
+        l0_prepared = rm_clear | (jnp.uint32(_PREPARED) << sh2)
+        l0_committed = rm_clear | (jnp.uint32(_COMMITTED) << sh2)
+        l0_aborted = rm_clear | (jnp.uint32(_ABORTED) << sh2)
+
+        l0_rm = jnp.where(
+            j == 1,
+            l0_prepared,
+            jnp.where(
+                j == 3,
+                l0_committed,
+                jnp.where((j == 2) | (j == 4), l0_aborted, lane0),
+            ),
+        )
+        l1_rm = jnp.where(
+            j == 0,
+            l1_rcv_prep,
+            jnp.where(j == 1, lane1 | prepared_bit, lane1),
+        )
+        tm_slot = slot < 2
+        l0 = jnp.where(tm_slot, lane0, l0_rm)
+        l1 = jnp.where(
+            slot == 0,
+            l1_commit,
+            jnp.where(slot == 1, l1_abort, l1_rm),
+        )
+        return jnp.stack([l0, l1])
 
     def property_conditions_vec(self, vec):
         """[sometimes abort agreement, sometimes commit agreement,

@@ -22,6 +22,20 @@ producers/consumers can't drift:
 
 Word layout (everywhere): slot ``k`` lives in word ``k // 32`` at bit
 ``k % 32``; tail bits of the last word are zero.
+
+**Word-level guard builders (round 6).** A hand encoding's enabled
+predicate factors as "host-constant slot class × small state-dependent
+selector" (a paxos slot is enabled iff its envelope bit is present AND
+its destination's guard holds; a 2pc slot iff its RM/TM condition
+holds). The builders below assemble the packed words directly from
+that factorization — :func:`slot_mask_host` precomputes the class
+masks, :func:`or_class_words` ORs them under traced scalar conditions,
+:func:`select_words_host` picks a mask row by a traced field value
+(the word-level analog of :func:`bit_select`) — so the predicate costs
+O(L × classes) uint32 lane ops per state instead of O(K) slot
+evaluations, and no dense ``bool[K]`` row ever exists (PERF.md
+§wave-wall: the [F, K] mask pass was the largest in-stage term at
+paxos-4 shapes, 199M cells per wave for 686k real pairs).
 """
 
 from __future__ import annotations
@@ -80,6 +94,76 @@ def popcount_words(jnp, words):
     return jnp.sum(
         lax.population_count(words), axis=-1, dtype=jnp.uint32
     )
+
+
+def slot_mask_host(k: int, slots) -> tuple:
+    """Host constant: the packed-word mask with exactly the given slot
+    indices set (a guard CLASS — the slots sharing one enabling
+    condition). Always ``mask_words(k)`` words."""
+    words = [0] * mask_words(k)
+    for s in slots:
+        if not 0 <= s < k:
+            raise ValueError(f"slot {s} outside 0..{k - 1}")
+        words[s // 32] |= 1 << (s % 32)
+    return tuple(words)
+
+
+def const_words(jnp, words):
+    """Host word tuple -> device constant: ``uint32[L]``, except a
+    single-word mask becomes a SCALAR so vmapped guard math stays
+    ``[N]``-shaped (a ``[N, 1]`` elementwise op pays the full 128-lane
+    tile-padding tax on TPU — the PERF.md §ordered artifact; the
+    callers below reshape to ``[1]`` only at the very end)."""
+    import numpy as np
+
+    if len(words) == 1:
+        return jnp.uint32(words[0])
+    return jnp.asarray(np.array(words, dtype=np.uint32))
+
+
+def or_class_words(jnp, classes, L: int):
+    """OR of condition-gated host class masks: ``classes`` is a
+    sequence of ``(cond, words)`` with ``cond`` a traced scalar bool
+    and ``words`` either a host tuple (from :func:`slot_mask_host`) or
+    an already-built ``uint32[L]`` array (e.g. a
+    :func:`select_words_host` result). Pure where/or lane ops — a
+    vmapped caller stays ``[N, L]``-shaped, no ``[N, K]`` bool, no
+    gather. All-zero host masks are dropped for free."""
+    acc = None
+    for cond, words in classes:
+        if isinstance(words, tuple):
+            if not any(words):
+                continue
+            words = const_words(jnp, words)
+        term = jnp.where(cond, words, jnp.uint32(0))
+        acc = term if acc is None else acc | term
+    if acc is None:
+        return jnp.zeros(L, jnp.uint32)
+    # Single-word masks compute as scalars (see const_words); restore
+    # the [L] row contract with one broadcast at the end.
+    if acc.ndim == 0:
+        acc = acc[None]
+    return acc
+
+
+def select_words_host(jnp, rows, idx):
+    """Pick row ``idx`` (traced uint32 scalar) from a HOST-CONSTANT
+    table of packed-word rows (``rows[v]`` = word tuple for field
+    value ``v``). A static where-chain over the rows — the word-level
+    analog of :func:`bit_select`: ``len(rows)`` selects of ``[L]``
+    vectors (scalars when L=1, per const_words — AND the result into
+    the presence words or an or_class_words accumulator, which
+    restores the row shape), no gather. Callers tabulate
+    per-field-value guard masks whose domains are small enums (ballot
+    codes, phases), not state spaces. Out-of-range ``idx`` returns
+    ``rows[0]``."""
+    idx = idx.astype(jnp.uint32)
+    acc = const_words(jnp, rows[0])
+    for v in range(1, len(rows)):
+        acc = jnp.where(
+            idx == jnp.uint32(v), const_words(jnp, rows[v]), acc
+        )
+    return acc
 
 
 def bit_select(jnp, words, idx):

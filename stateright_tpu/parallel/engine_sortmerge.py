@@ -46,8 +46,11 @@ per-destination tiles, the ``all_to_all`` itself, and the merge all
 scale with the running wave. Encodings implementing
 ``SparseEncodedModel`` get sparse action dispatch here too: pairs are
 extracted and stepped shard-locally (the shared pipeline in
-checkers/tpu_sortmerge.py), and only real candidates enter the
-routing sort and the shuffle.
+checkers/tpu_sortmerge.py — including the round-6 WORD-NATIVE enabled
+predicate: encodings providing ``enabled_bits_vec`` never materialize
+a dense ``[F, K]`` bool on any shard, hand paxos/2pc and the compiled
+actor encodings alike), and only real candidates enter the routing
+sort and the shuffle.
 
 On one device the shuffle degenerates to the identity and results are
 state-identical to the single-chip engines; tests pin identical

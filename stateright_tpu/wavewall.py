@@ -1,0 +1,316 @@
+"""Wave-wall profiler: attribute the OUT-OF-STAGE share of wave time.
+
+The round-5 stage profile (PERF.md) showed per-stage compute at
+paxos-4 shapes summing to only ~0.4-0.8s of the 2.14s end-to-end wall
+— the majority of wave time sat BETWEEN the stages: ``lax.switch``
+ladder carry movement, class-quantization waste, and XLA layout
+copies. Nothing in the repo measured that term directly; this module
+does, three ways, all runnable on CPU:
+
+* **wall vs stages** — re-time ONE full wave body (the engine exposes
+  it as ``checker._wave_body``) on a captured mid-run carry,
+  REPS-amortized inside a single jitted ``fori_loop`` with EVERY wave
+  input (frontier, fval, the visited array and its unique count,
+  ebits, parent-log offset) reset per repetition so each rep repeats
+  the captured wave exactly (rep 1 appends its winners to the visited
+  set, so an un-reset loop would dedup rep 2's candidates to nothing
+  and time REPS-1 degenerate waves);
+* **switch-ladder carry baseline** — the same class-ladder
+  ``lax.switch`` dispatch with IDENTITY branches over the same carry:
+  pure carry movement through the conditional, the term the class-
+  local-carry rework (round 6, checkers/tpu_sortmerge.py make_fetch)
+  attacks;
+* **HLO category breakdown** — lower-and-compile the one-wave program
+  and classify every optimized-HLO instruction with
+  :func:`hlo_category` (the same category vocabulary the round-5
+  device-trace analysis used: data formatting, carry/slice movement,
+  quantization padding, sort, gather, fusion), summing op counts and
+  output bytes per category. Bytes of copy/pad/slice traffic are the
+  static fingerprint of the wave wall — they move with the carry
+  rework even when wall-clock on CPU is noisy.
+
+Used by ``tools/profile_stages.py --wave-wall`` (prints the report
+next to the per-stage sums) and pinned on CPU by
+tests/test_wavewall.py.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+
+import numpy as np
+
+#: dtype byte widths for HLO shape strings.
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_SHAPE_RE = re.compile(r"\b([a-z]+\d*)\[([0-9,]*)\]")
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.*?)\s+"
+    r"([a-z][a-z0-9\-]*)\("
+)
+
+
+def hlo_category(opcode: str) -> str:
+    """Map an HLO opcode to the trace-category vocabulary PERF.md's
+    round-5 analysis used. Copies/transposes/converts are XLA's
+    between-stage data formatting; pad is class-quantization padding;
+    slice/concat/dynamic-(update-)slice are carry and block movement;
+    fusion is the actual stage compute."""
+    if opcode in ("copy", "copy-start", "copy-done", "bitcast",
+                  "bitcast-convert", "transpose", "reshape", "convert"):
+        return "data formatting"
+    if opcode == "pad":
+        return "quantization padding"
+    if opcode in ("dynamic-update-slice",):
+        return "dynamic-update-slice"
+    if opcode in ("dynamic-slice", "slice", "concatenate"):
+        return "carry/slice movement"
+    if opcode == "sort":
+        return "sort"
+    if opcode in ("gather", "scatter"):
+        return opcode
+    if opcode == "fusion":
+        return "fusion"
+    if opcode in ("while", "conditional", "call", "tuple",
+                  "get-tuple-element", "parameter", "constant",
+                  "iota", "broadcast", "after-all", "partition-id",
+                  "replica-id"):
+        return "control"
+    if opcode in ("add", "subtract", "multiply", "divide", "remainder",
+                  "and", "or", "xor", "not", "negate", "compare",
+                  "select", "shift-left", "shift-right-logical",
+                  "shift-right-arithmetic", "popcnt", "clz",
+                  "maximum", "minimum", "abs", "sign", "clamp",
+                  "reduce", "reduce-window", "map", "exponential",
+                  "log", "power"):
+        # XLA:CPU leaves elementwise ALU unfused where the TPU trace
+        # shows loop fusions — same stage-compute category.
+        return "elementwise compute"
+    return "other"
+
+
+def _type_bytes(type_str: str) -> int:
+    """Output bytes of an HLO instruction's (possibly tuple) type."""
+    total = 0
+    for dt, dims in _SHAPE_RE.findall(type_str):
+        width = _DTYPE_BYTES.get(dt)
+        if width is None:
+            continue
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n * width
+    return total
+
+
+def parse_hlo_categories(hlo_text: str) -> dict:
+    """Per-category ``{"ops": count, "bytes": output_bytes}`` over
+    every instruction of an optimized-HLO dump (sub-computations —
+    fusion bodies, while bodies, branch computations — included; their
+    instructions are what the categories exist to attribute)."""
+    out: dict = {}
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.match(line)
+        if m is None:
+            continue
+        type_str, opcode = m.groups()
+        cat = hlo_category(opcode)
+        slot = out.setdefault(cat, {"ops": 0, "bytes": 0})
+        slot["ops"] += 1
+        slot["bytes"] += _type_bytes(type_str)
+    return out
+
+
+def _timed_loop(jit_fn, args) -> float:
+    """Best-of-3 seconds for one jitted call (which internally loops
+    its reps); the caller divides by the rep count."""
+    import jax
+
+    out = jit_fn(*args)
+    jax.block_until_ready(out)  # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.monotonic()
+        jax.block_until_ready(jit_fn(*args))
+        best = min(best, time.monotonic() - t0)
+    return best
+
+
+def _ladder_classes(checker):
+    from .checkers.tpu_sortmerge import _ladder
+
+    f_ladder = _ladder(
+        checker.f_min, checker.frontier_capacity, checker.ladder_step
+    )
+    v_ladder = _ladder(
+        checker.v_min, checker.capacity, checker.v_ladder_step
+    )
+    return f_ladder, v_ladder
+
+
+def wave_wall_report(checker, reps: int = 8) -> dict:
+    """Measure one wave's wall vs its carry-movement baseline on the
+    checker's captured final carry, and statically attribute the
+    compiled one-wave program's ops/bytes per HLO category.
+
+    The checker must have run with ``keep_final_carry = True`` (the
+    tools/profile_stages.py capture protocol: set a
+    ``target_state_count`` so the final carry is a genuine mid-growth
+    wave). Returns a dict with ``wave_ms``, ``switch_carry_ms``,
+    ``loop_floor_ms``, ``n_rows``, and ``categories``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    carry = getattr(checker, "_final_carry", None)
+    if carry is None:
+        raise ValueError(
+            "run the checker with keep_final_carry=True before "
+            "profiling (spawn, set the attribute, join)"
+        )
+    if not hasattr(checker, "_wave_body"):
+        # Programs came from the chunk cache: rebuild (cheap — builds
+        # python closures; tracing happens only at jit time below;
+        # the wave body itself is independent of the init count).
+        checker._build_programs(1)
+    body = checker._wave_body
+
+    n_rows = int(np.asarray(carry["n_frontier"]))
+    F = checker.frontier_capacity
+    fval0 = jnp.arange(F) < jnp.uint32(max(n_rows, 1))
+    base = dict(
+        carry,
+        fval=fval0,
+        n_frontier=jnp.uint32(max(n_rows, 1)),
+        done=jnp.bool_(False),
+        wchunk=jnp.int32(0),
+    )
+
+    def checksum(c):
+        # Consume element [0] of EVERY carry leaf — returning a lone
+        # counter lets XLA dead-code-eliminate the entire wave (the
+        # round-5 profiler bug, see tools/profile_stages._timed_raw);
+        # the dynamic-offset block writes and the rep-to-rep carry
+        # chain keep the full stages live through these folds.
+        return sum(
+            jnp.sum(jnp.ravel(v)[:1].astype(jnp.uint32))
+            for v in c.values()
+        )
+
+    def run_waves(c):
+        def rep(i, c2):
+            # Reset EVERY wave input from the loop-invariant captured
+            # carry `c` — frontier/fval, the visited array and its
+            # unique count (rep 1 appends its winners; an un-reset
+            # chain would dedup all of rep 2's candidates to nothing
+            # and bump the visited ladder class, so reps 2..N would
+            # time non-representative waves), ebits, and the
+            # parent-log offset. Counters (waves/depth/gen) chain
+            # through c2; the perturbed frontier cell makes each rep's
+            # inputs distinct.
+            fr = c["frontier"].at[0, 0].set(
+                c["frontier"][0, 0] ^ i.astype(jnp.uint32)
+            )
+            return body(
+                dict(
+                    c2,
+                    frontier=fr,
+                    fval=fval0,
+                    ebits=c["ebits"],
+                    n_frontier=base["n_frontier"],
+                    v_lo=c["v_lo"],
+                    v_hi=c["v_hi"],
+                    new=c["new"],
+                    pl_n=c["pl_n"],
+                    done=jnp.bool_(False),
+                )
+            )
+
+        return checksum(lax.fori_loop(0, reps, rep, c))
+
+    f_ladder, _ = _ladder_classes(checker)
+
+    def run_switch_identity(c):
+        def rep(i, c2):
+            # Same class selection as the engine's body; each branch
+            # only bumps the wave counter (keeps the loop sequential),
+            # so the measured time is the switch's carry movement.
+            f_class = jnp.int32(0)
+            for F_i in f_ladder[:-1]:
+                f_class = f_class + (
+                    c2["n_frontier"] > jnp.uint32(F_i)
+                ).astype(jnp.int32)
+            return lax.switch(
+                f_class,
+                [
+                    (lambda x, _fc=fc: dict(
+                        x, waves=x["waves"] + jnp.uint32(1)
+                    ))
+                    for fc in range(len(f_ladder))
+                ],
+                c2,
+            )
+
+        return checksum(lax.fori_loop(0, reps, rep, c))
+
+    def run_empty(c):
+        return checksum(lax.fori_loop(0, reps, lambda i, c2: c2, c))
+
+    wave_s = _timed_loop(jax.jit(run_waves), (base,))
+    sw_s = _timed_loop(jax.jit(run_switch_identity), (base,))
+    empty_s = _timed_loop(jax.jit(run_empty), (base,))
+
+    hlo = (
+        jax.jit(body)
+        .lower(base)
+        .compile()
+        .as_text()
+    )
+    categories = parse_hlo_categories(hlo)
+
+    return dict(
+        n_rows=n_rows,
+        reps=reps,
+        wave_ms=wave_s / reps * 1000.0,
+        switch_carry_ms=(sw_s - empty_s) / reps * 1000.0,
+        loop_floor_ms=empty_s / reps * 1000.0,
+        categories=categories,
+    )
+
+
+def format_report(rep: dict, stage_sum_ms: float | None = None) -> str:
+    """Human-readable wave-wall report (the tools/ CLI prints this)."""
+    lines = [
+        f"wave wall: {rep['wave_ms']:.2f} ms/wave over "
+        f"{rep['n_rows']} frontier rows "
+        f"(loop floor {rep['loop_floor_ms']:.2f} ms, "
+        f"identity-switch carry movement "
+        f"{rep['switch_carry_ms']:.2f} ms)",
+    ]
+    if stage_sum_ms is not None:
+        lines.append(
+            f"  stage compute sum {stage_sum_ms:.2f} ms -> "
+            f"out-of-stage wall "
+            f"{max(rep['wave_ms'] - stage_sum_ms, 0.0):.2f} ms"
+        )
+    lines.append(
+        f"  {'hlo category':26s} {'ops':>6s} {'MB(out)':>9s}"
+    )
+    cats = sorted(
+        rep["categories"].items(),
+        key=lambda kv: -kv[1]["bytes"],
+    )
+    for name, s in cats:
+        lines.append(
+            f"  {name:26s} {s['ops']:6d} {s['bytes'] / 1e6:9.2f}"
+        )
+    return "\n".join(lines)

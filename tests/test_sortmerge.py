@@ -340,3 +340,117 @@ def test_save_budget_concurrent_writers_keep_all_keys(
         t.join()
     data = json.loads(store.read_text())
     assert set(data) == {f"key-{i}" for i in range(8)}
+
+
+# -- 2pc sparse dispatch (round 6) ----------------------------------------
+
+
+def test_twopc_sparse_contract_exhaustive():
+    """The SparseEncodedModel contract for the 2pc encoding, pinned
+    exhaustively over the rm=3 (288) and rm=4 (1,568) spaces:
+    ``enabled_bits_vec`` unpacks to ``enabled_mask_vec`` equals
+    ``step_vec`` validity on every slot, ``step_slot_vec`` reproduces
+    ``step_vec``'s successor on every enabled pair, popcounts agree,
+    and ``pair_width_hint`` bounds the true per-row enabled peak."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from stateright_tpu.models.two_phase_commit_tpu import (
+        TwoPhaseSysEncoded,
+    )
+    from stateright_tpu.ops.bitmask import popcount_words, words_to_mask
+
+    for rm, expected in ((3, 288), (4, 1568)):
+        enc = TwoPhaseSysEncoded(rm)
+        host = TwoPhaseSys(rm_count=rm).checker().spawn_bfs().join()
+        vecs = {}
+        from collections import deque
+
+        m = enc.host_model
+        q = deque(m.init_states())
+        for s in list(q):
+            vecs[tuple(enc.encode(s).tolist())] = s
+        while q:
+            s = q.popleft()
+            for a in m.actions(s):
+                t = m.next_state(s, a)
+                if t is None:
+                    continue
+                k = tuple(enc.encode(t).tolist())
+                if k not in vecs:
+                    vecs[k] = t
+                    q.append(t)
+        assert len(vecs) == expected == host.unique_state_count()
+        arr = jnp.asarray(
+            __import__("numpy").array(sorted(vecs), dtype="uint32")
+        )
+        succs, valid = (
+            np.asarray(a)
+            for a in jax.jit(jax.vmap(enc.step_vec))(arr)
+        )
+        mask = np.asarray(
+            jax.jit(jax.vmap(enc.enabled_mask_vec))(arr)
+        )
+        assert (mask == valid).all(), f"rm={rm} mask != step validity"
+        bits = jnp.asarray(
+            np.asarray(jax.jit(jax.vmap(enc.enabled_bits_vec))(arr))
+        )
+        assert (
+            np.asarray(words_to_mask(jnp, bits, enc.max_actions))
+            == mask
+        ).all()
+        assert (
+            np.asarray(popcount_words(jnp, bits))
+            == mask.sum(axis=1)
+        ).all()
+        rows, slots = np.nonzero(valid)
+        sp = np.asarray(
+            jax.jit(jax.vmap(enc.step_slot_vec))(
+                arr[jnp.asarray(rows)],
+                jnp.asarray(slots.astype(np.uint32)),
+            )
+        )
+        assert (sp == succs[rows, slots]).all(), (
+            f"rm={rm} step_slot_vec diverges from step_vec"
+        )
+        peak = int(valid.sum(axis=1).max())
+        assert peak <= enc.pair_width_hint, (peak, enc.pair_width_hint)
+
+
+def test_twopc_sparse_engine_matches_dense():
+    """2pc through SPARSE dispatch (the round-6 default — the encoding
+    now implements SparseEncodedModel) produces the identical count,
+    discoveries, and replayable paths as the dense wave."""
+    dense = (
+        TwoPhaseSys(rm_count=4)
+        .checker()
+        .spawn_tpu_sortmerge(
+            sparse=False,
+            capacity=1 << 12,
+            frontier_capacity=512,
+            cand_capacity=4096,
+        )
+        .join()
+    )
+    sp = (
+        TwoPhaseSys(rm_count=4)
+        .checker()
+        .spawn_tpu_sortmerge(
+            capacity=1 << 12,
+            frontier_capacity=512,
+            cand_capacity=4096,
+        )
+        .join()
+    )
+    assert sp._use_sparse() and not dense._use_sparse()
+    assert (
+        sp.unique_state_count()
+        == dense.unique_state_count()
+        == 1568
+    )
+    assert sorted(sp.discoveries()) == sorted(dense.discoveries())
+    sp.assert_properties()
+    for name, path in sp.discoveries().items():
+        prop = sp.model.property_by_name(name)
+        assert prop.condition(sp.model, path.last_state())

@@ -16,6 +16,9 @@ Usage:
   python tools/profile_stages.py --paxos 5
   python tools/profile_stages.py --twopc 8
   python tools/profile_stages.py --paxos 4 --wave-profile   # per-wave ms
+  python tools/profile_stages.py --paxos 4 --wave-wall      # out-of-stage
+                                  # wall + per-HLO-category attribution
+                                  # (stateright_tpu/wavewall.py)
 """
 
 import argparse
@@ -110,8 +113,10 @@ def stage_profile(kind, n, caps, target):
     carry = c._final_carry
     enc = c.encoded
     frontier = carry["frontier"]
-    nonzero = np.asarray(jnp.any(frontier != 0, axis=1))
-    n_rows = int(nonzero.sum())
+    # Frontier rows past the last wave's class-local block are STALE
+    # (round 6 carry rework) — the carried n_frontier is the live-row
+    # count (the live rows are always a dense prefix).
+    n_rows = int(np.asarray(carry["n_frontier"]))
     V_cnt = int(np.asarray(carry["new"]))
     print(f"captured frontier rows={n_rows}  visited={V_cnt}  "
           f"depth={int(np.asarray(carry['depth']))}")
@@ -137,17 +142,20 @@ def stage_profile(kind, n, caps, target):
     NT = _divisor_at_least(F_f, want_tiles) if compaction else 1
     T = F_f // NT
     Ba = (B_p + T * EV) if compaction else NPg
-    chunked = compaction and (Ba * W * 4 > c.flat_budget_bytes)
+    # Chunk gate mirrors the engine: PADDED row cost (~512 B per
+    # 128-lane group on TPU), not unpadded W*4.
+    row_pad = -(-W // 128) * 512
+    chunked = compaction and (Ba * row_pad > c.flat_budget_bytes)
     NC = Bc = 0
     if chunked:
-        NC = -(-(Ba * W * 4) // c.flat_budget_bytes)
+        NC = -(-(Ba * row_pad) // c.flat_budget_bytes)
         Bc = -(-Ba // NC)
         Ba = NC * Bc
     print(f"class: F_f={F_f} V_v={V_v} K={K} W={W} EV={EV} "
           f"B_p={B_p} NT={NT} Ba={Ba} chunked={chunked}")
 
     frontier_f = frontier[:F_f]
-    fval_f = jnp.asarray(nonzero)[:F_f]
+    fval_f = jnp.arange(F_f) < n_rows
     ebits_f = carry["ebits"][:F_f]
     props = list(c.model.properties())
     from stateright_tpu.model import Expectation
@@ -427,6 +435,21 @@ def stage_profile(kind, n, caps, target):
         print(f"  {k:40s} {v:9.2f}")
         total += v
     print(f"  {'SUM (stage compute)':40s} {total:9.2f}")
+    return c, total
+
+
+def wave_wall(kind, n, caps, target):
+    """--wave-wall: the out-of-stage attribution (VERDICT r5 items
+    1-2). Runs the stage profile for the in-stage sum, then re-times
+    ONE full wave body on the same captured carry and attributes the
+    compiled one-wave program per HLO category
+    (stateright_tpu/wavewall.py)."""
+    from stateright_tpu.wavewall import format_report, wave_wall_report
+
+    c, stage_sum = stage_profile(kind, n, caps, target)
+    print(f"\n## wave-wall profile: {kind} {n}")
+    rep = wave_wall_report(c)
+    print(format_report(rep, stage_sum_ms=stage_sum))
 
 
 def wave_profile(kind, n, caps):
@@ -472,16 +495,22 @@ def main():
     ap.add_argument("--twopc", type=int)
     ap.add_argument("--target", type=int)
     ap.add_argument("--wave-profile", action="store_true")
+    ap.add_argument("--wave-wall", action="store_true")
     args = ap.parse_args()
 
     import jax
 
     print(f"backend: {jax.devices()}")
 
+    # Structural sizes from the one shared table (capacity from the
+    # pinned state counts, frontier from measured wave peaks);
+    # per-wave BUDGETS are auto-sized — TUNED_ENGINE_CAPS and the
+    # per-lane caps tables are gone (VERDICT r5 item 6).
     if args.paxos:
-        from stateright_tpu.models.paxos_tpu import TUNED_ENGINE_CAPS
+        from stateright_tpu.models.paxos_tpu import STRUCTURAL_SIZES
 
-        caps = dict(TUNED_ENGINE_CAPS[args.paxos])
+        caps = dict(STRUCTURAL_SIZES[args.paxos])
+        caps["cand_capacity"] = "auto"
         kind, n = "paxos", args.paxos
         default_target = {3: 600_000, 4: 1_200_000, 5: 2_400_000}.get(
             args.paxos, 1_000_000
@@ -490,9 +519,9 @@ def main():
         kind, n = "twopc", args.twopc
         caps = {
             8: dict(capacity=1 << 21, frontier_capacity=1 << 19,
-                    cand_capacity=3 << 20),
+                    cand_capacity="auto"),
             9: dict(capacity=11 << 20, frontier_capacity=3 << 19,
-                    cand_capacity=17 << 20, tile_rows=1 << 20),
+                    cand_capacity="auto", tile_rows=1 << 20),
         }[n]
         default_target = {8: 900_000, 9: 5_000_000}[n]
     else:
@@ -500,6 +529,8 @@ def main():
 
     if args.wave_profile:
         wave_profile(kind, n, caps)
+    elif args.wave_wall:
+        wave_wall(kind, n, caps, args.target or default_target)
     else:
         stage_profile(kind, n, caps, args.target or default_target)
 

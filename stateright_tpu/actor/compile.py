@@ -1179,7 +1179,10 @@ class CompiledActorEncoding(EncodedModelBase):
         no dense bool[A] materialization. This is the op shape the
         hand encodings use and the sparse engines consume directly
         (PERF.md §ordered traced ~1.6s/run of 1-D mask gathers to the
-        old table-gather form at abd-ordered shapes).
+        old table-gather form at abd-ordered shapes). The no-gather /
+        no-dense-mask / no-[N, 1]-ALU contract is pinned statically by
+        the kernel lint (stateright_tpu/analysis/, ``pytest -m
+        lint``) for the registered compiled encodings.
 
         Semantics are the dense ``step_vec`` validity EXCEPT the
         count-bound poison, which ``step_slot_vec`` reports as its

@@ -1,0 +1,62 @@
+"""Kernel-lint: static analysis of the sparse-engine codegen contract.
+
+The invariants two rounds of perf work bought — no dense ``[F, K]``
+bool on the sparse path, gather-free mask construction, table-row-only
+step gathers, no ``[N, 1]`` lane-padded ALU, class-local switch-branch
+carries — are checkable on the TRACED program, on CPU, before any
+chip run. This package is their single home:
+
+* :mod:`.tables` — the shared primitive/HLO classification tables
+  (also consumed by tests/test_codegen_shapes.py and
+  stateright_tpu/wavewall.py, so the three audits cannot drift);
+* :mod:`.walker` — jaxpr traversal with sub-jaxpr descent and
+  source attribution;
+* :mod:`.rules` — the declarative rule registry;
+* :mod:`.registry` — every encoding the sparse engines are pinned
+  for, with calibrated allowances;
+* :mod:`.lint` — the driver (``tools/lint_kernels.py``,
+  ``pytest -m lint``).
+"""
+
+from .tables import (  # noqa: F401
+    ALU_PRIMS,
+    CARRY_MOVE_PRIMS,
+    DTYPE_BYTES,
+    HLO_CATEGORY,
+    HLO_WALL_CATEGORIES,
+    hlo_category,
+    hlo_type_bytes,
+    is_gather,
+    output_bytes,
+    parse_hlo_categories,
+)
+from .walker import (  # noqa: F401
+    EqnSite,
+    audit_jaxpr,
+    eqn_alu_n1,
+    eqn_dense_bool_k,
+    eqn_wide_concat_n1,
+    iter_eqns,
+    source_of,
+)
+from .rules import (  # noqa: F401
+    Finding,
+    RULES,
+    Rule,
+    TraceCtx,
+    run_rules,
+    run_rules_with_stats,
+)
+from .registry import ENCODINGS, EncodingSpec, get_encoding_spec  # noqa: F401
+from .lint import (  # noqa: F401
+    LINT_N,
+    engine_pair_width,
+    engine_pipe_params,
+    format_report,
+    lint_encoding,
+    lint_wave_body,
+    run_lint,
+    trace_encoding_paths,
+    trace_engine_pipeline,
+    trace_wave_body_fixture,
+)

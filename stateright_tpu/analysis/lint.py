@@ -1,0 +1,396 @@
+"""The kernel-lint driver: trace every contract path, run the rules.
+
+For each registered encoding (:mod:`.registry`) the driver traces
+
+* ``bits`` — ``vmap(enabled_bits_vec)``: the word-native mask path
+  the sparse engines consume,
+* ``mask`` — ``vmap(enabled_mask_vec)``: the dense contract view
+  (bool[K] IS its return type, so the dense-mask rule is off; the
+  gather rule still applies),
+* ``step`` — ``vmap(step_slot_vec)``: the per-pair transition path,
+* ``engine:single`` — the shared sparse pair pipeline
+  (checkers/tpu_sortmerge.py ``sparse_pair_candidates``) exactly as
+  the single-chip engine invokes it,
+* ``engine:sharded`` — the same pipeline under ``shard_map`` with
+  ``axis_name="shard"``, exactly as the sharded engine
+  (parallel/engine_sortmerge.py) invokes it,
+
+and runs the full rule registry (:mod:`.rules`) over each. A separate
+wave-body fixture traces the single-chip engine's ENTIRE per-wave
+program (class-ladder switch included) on a small 2pc model so the
+branch-shape rule and the carry-copy-bytes estimator see the real
+switch structure — the thing the per-path traces can't show.
+
+Everything here runs on CPU: jaxprs are backend-independent, which is
+what lets a CPU-only CI run refuse an encoding or engine change that
+re-introduces a priced codegen artifact before it ever reaches a
+chip.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .registry import ENCODINGS, EncodingSpec
+from .rules import (
+    RULES,
+    Finding,
+    TraceCtx,
+    run_rules,  # noqa: F401 — re-exported for single-path callers
+    run_rules_with_stats,
+)
+
+#: batch rows in every traced vmap / engine trace — any fixed N works
+#: (the banned shapes are N-relative); 64 matches the codegen-shape
+#: tests' calibration.
+LINT_N = 64
+
+
+def trace_encoding_paths(enc, n: int = LINT_N) -> dict:
+    """``{label: ClosedJaxpr}`` for the three per-encoding contract
+    paths, traced at ``n`` batch rows."""
+    import jax
+    import jax.numpy as jnp
+
+    vecs = jnp.zeros((n, enc.width), jnp.uint32)
+    slots = jnp.zeros((n,), jnp.uint32)
+    return {
+        "bits": jax.make_jaxpr(jax.vmap(enc.enabled_bits_vec))(vecs),
+        "mask": jax.make_jaxpr(jax.vmap(enc.enabled_mask_vec))(vecs),
+        "step": jax.make_jaxpr(jax.vmap(enc.step_slot_vec))(
+            vecs, slots
+        ),
+    }
+
+
+def engine_pair_width(enc) -> int:
+    K = enc.max_actions
+    return min(getattr(enc, "pair_width_hint", None) or K, K)
+
+
+def engine_pipe_params(enc, n: int = LINT_N,
+                       compact: bool = False) -> dict:
+    """The ``sparse_pair_candidates`` kwargs of the traced engine
+    invocation — ONE recipe shared by the jaxpr traces below and the
+    tool's ``--hlo`` compile pass, so the two always price the same
+    program.
+
+    ``compact=False`` is the small-wave shape (``B_p == F*EV``, no
+    compaction, whole-wave mask); ``compact=True`` forces the
+    PRODUCTION branches the big bench lanes run — ``B_p < F*EV``
+    (tiled packed-append compaction sorts) and a mask-cell budget
+    below ``F*K`` (the tiled ``mtile`` mask loop) — which would
+    otherwise never be audited."""
+    EV = engine_pair_width(enc)
+    K = enc.max_actions
+    if compact:
+        NT = 2
+        T = n // NT
+        B_p = max((n * EV) // 2, 1)
+        return dict(
+            EV=EV, B_p=B_p, NT=NT, T=T,
+            mask_budget_cells=max(K, (n * K) // 4),
+            Ba=B_p + T * EV,
+        )
+    return dict(
+        EV=EV, B_p=n * EV, NT=1, T=n,
+        mask_budget_cells=1 << 30, Ba=n * EV,
+    )
+
+
+def trace_engine_pipeline(enc, engine: str = "single",
+                          n: int = LINT_N, compact: bool = False):
+    """Trace ``sparse_pair_candidates`` at ``n`` frontier rows, in the
+    exact invocation style of each engine: ``single`` is the
+    single-chip call; ``sharded`` wraps the call in ``shard_map`` with
+    ``axis_name="shard"`` over a 1-device mesh (the axis plumbing —
+    ``lax.pvary`` carries etc. — is what differs, and is what this
+    trace pins). ``compact`` selects the production
+    compaction/tiled-mask branches (see :func:`engine_pipe_params`)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..checkers.tpu_sortmerge import sparse_pair_candidates
+
+    params = engine_pipe_params(enc, n, compact)
+
+    def pipe(frontier, fval, axis_name=None):
+        return sparse_pair_candidates(
+            enc, frontier, fval, jnp.bool_(True),
+            axis_name=axis_name, **params,
+        )
+
+    frontier = jnp.zeros((n, enc.width), jnp.uint32)
+    fval = jnp.zeros((n,), bool)
+    if engine == "single":
+        return jax.make_jaxpr(pipe)(frontier, fval)
+    if engine != "sharded":
+        raise ValueError(f"unknown engine {engine!r}")
+
+    import inspect
+
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    # The replication checker must be off (the pipeline's fori_loop
+    # carries are shard-varying, same as the engine's own usage), but
+    # the kwarg was renamed check_rep -> check_vma across jax
+    # versions — feature-detect rather than assume.
+    kw = {}
+    try:
+        sm_params = inspect.signature(shard_map).parameters
+        if "check_rep" in sm_params:
+            kw["check_rep"] = False
+        elif "check_vma" in sm_params:
+            kw["check_vma"] = False
+    except (TypeError, ValueError):
+        kw["check_rep"] = False
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("shard",))
+    sm = shard_map(
+        lambda fr, fv: pipe(fr, fv, axis_name="shard"),
+        mesh=mesh,
+        in_specs=(P(), P()),
+        out_specs=P(),
+        **kw,
+    )
+    return jax.make_jaxpr(sm)(frontier, fval)
+
+
+def trace_wave_body_fixture(track_paths: bool = True):
+    """``(name, ClosedJaxpr)`` of the single-chip sort-merge engine's
+    full wave body — class ladders, merge switches, fetch-class
+    branches — built (never run) on a small 2pc model with short
+    ladders so the switch structure is multi-class. Abstract-traced
+    via ``eval_shape`` on the seed program, so no device buffers are
+    allocated."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.two_phase_commit import TwoPhaseSys
+
+    checker = TwoPhaseSys(rm_count=3).checker().spawn_tpu_sortmerge(
+        capacity=1 << 11,
+        frontier_capacity=1 << 9,
+        cand_capacity=1 << 11,
+        f_min=64,
+        v_min=256,
+        track_paths=track_paths,
+        waves_per_sync=4,
+    )
+    init = jnp.asarray(checker.encoded.init_vecs())
+    seed_fn, _chunk_fn = checker._build_programs(init.shape[0])
+    carry_shapes = jax.eval_shape(seed_fn, init)
+    return (
+        "engine-fixture(2pc-rm3)",
+        jax.make_jaxpr(checker._wave_body)(carry_shapes),
+    )
+
+
+def _ctx_for_path(spec: EncodingSpec, enc, label: str,
+                  n: int = LINT_N) -> TraceCtx:
+    K = enc.max_actions
+    if label == "bits":
+        return TraceCtx(path=label, encoding=spec.name, n=n, k=K,
+                        sparse=True, allow_gathers=0,
+                        check_lane_alu=True)
+    if label == "mask":
+        # bool[K] is this path's CONTRACT (the dense view); only the
+        # gather rule applies.
+        return TraceCtx(path=label, encoding=spec.name, n=n, k=K,
+                        sparse=False, allow_gathers=0,
+                        check_lane_alu=False)
+    if label == "step":
+        return TraceCtx(path=label, encoding=spec.name, n=n, k=K,
+                        sparse=False,
+                        allow_gathers=spec.max_step_gathers,
+                        check_lane_alu=True, table_path=True)
+    # engine pipelines: word ops are [N, L]-shaped by design (L == 1
+    # collapses them to [N, 1] for small-K encodings), so the lane-ALU
+    # rule stays off here; the dense-mask and gather bans are the
+    # engine contract. The peel's [N, EV] pair-validity grid is by
+    # design — when EV == K (tiny action sets) it is shape-identical
+    # to the dense mask, so the dense-mask rule needs a real sparse
+    # pair width (the same precondition the codegen-shape tests
+    # calibrated).
+    return TraceCtx(path=label, encoding=spec.name, n=n, k=K,
+                    sparse=engine_pair_width(enc) < K,
+                    allow_gathers=0, check_lane_alu=False)
+
+
+def lint_encoding(spec: EncodingSpec,
+                  engines: tuple = ("single", "sharded"),
+                  n: int = LINT_N) -> tuple:
+    """Run the rule registry over one encoding's contract paths.
+    Returns ``(findings, path_stats)``."""
+    enc = spec.factory()
+    findings: list = []
+    stats: list = []
+    traced = trace_encoding_paths(enc, n)
+    for engine in engines:
+        # both the small-wave shape and the production
+        # compaction/tiled-mask shape (the branch the big bench
+        # lanes actually run) — see engine_pipe_params.
+        traced[f"engine:{engine}"] = trace_engine_pipeline(
+            enc, engine, n
+        )
+        traced[f"engine:{engine}+compact"] = trace_engine_pipeline(
+            enc, engine, n, compact=True
+        )
+    for label, closed in traced.items():
+        ctx = _ctx_for_path(spec, enc, label, n)
+        fs, n_eqns = run_rules_with_stats(ctx, closed)
+        if label.startswith("engine:") and not ctx.sparse:
+            # EV == K: the peel's [N, EV] pair-validity grid is
+            # shape-identical to the dense mask, so the dense-mask
+            # rule cannot run on this path — record the skip loudly
+            # instead of reporting an indistinguishable "0 errors"
+            # (the coverage claim must stay honest).
+            fs.append(Finding(
+                rule="no-dense-mask",
+                severity="info",
+                encoding=spec.name,
+                path=label,
+                message=(
+                    f"rule SKIPPED on this path: pair width EV == K "
+                    f"= {enc.max_actions}, so the by-design [N, EV] "
+                    "pair-validity grid is shape-identical to the "
+                    "dense mask (the rule needs a real sparse pair "
+                    "width; the bits-path audit still covers this "
+                    "encoding's mask construction)"
+                ),
+            ))
+        findings.extend(fs)
+        stats.append(
+            dict(
+                encoding=spec.name,
+                path=label,
+                eqns=n_eqns,
+                errors=sum(1 for f in fs if f.severity == "error"),
+            )
+        )
+    return findings, stats
+
+
+def lint_wave_body() -> tuple:
+    """Run the branch-shape rule and the carry-copy-bytes estimator
+    over the engine wave-body fixture."""
+    name, closed = trace_wave_body_fixture()
+    ctx = TraceCtx(
+        path="wave-body",
+        encoding=name,
+        n=LINT_N,
+        k=0,
+        sparse=False,
+        allow_gathers=None,  # winner-fetch gathers are the idiom
+        check_lane_alu=False,
+        check_branches=True,
+    )
+    findings, n_eqns = run_rules_with_stats(ctx, closed)
+    stats = [
+        dict(
+            encoding=name,
+            path="wave-body",
+            eqns=n_eqns,
+            errors=sum(1 for f in findings if f.severity == "error"),
+        )
+    ]
+    return findings, stats
+
+
+def run_lint(encodings: Optional[tuple] = None,
+             engines: tuple = ("single", "sharded"),
+             wave_body: bool = True,
+             n: int = LINT_N) -> dict:
+    """The whole gate: every registered encoding × the requested
+    engine pipelines, plus the wave-body fixture. Returns a report
+    dict (the ``--json`` artifact's content):
+
+    ``clean``
+        True iff no error-severity finding anywhere.
+    ``findings``
+        every finding (errors AND the informational carry-copy-bytes
+        estimates), source-attributed.
+    ``paths``
+        per-(encoding, path) equation counts and error counts — the
+        audit's coverage record.
+    """
+    specs = encodings if encodings is not None else ENCODINGS
+    all_findings: list = []
+    all_stats: list = []
+    for spec in specs:
+        fs, st = lint_encoding(spec, engines, n)
+        all_findings.extend(fs)
+        all_stats.extend(st)
+    if wave_body:
+        fs, st = lint_wave_body()
+        all_findings.extend(fs)
+        all_stats.extend(st)
+    errors = [f for f in all_findings if f.severity == "error"]
+    return dict(
+        clean=not errors,
+        n=n,
+        engines=list(engines),
+        rules=[
+            dict(name=r.name, description=r.description)
+            for r in RULES
+        ],
+        paths=all_stats,
+        findings=[
+            dict(
+                rule=f.rule,
+                severity=f.severity,
+                encoding=f.encoding,
+                path=f.path,
+                message=f.message,
+                primitive=f.primitive,
+                source=f.source,
+                **({"data": f.data} if f.data else {}),
+            )
+            for f in all_findings
+        ],
+    )
+
+
+def format_report(report: dict) -> str:
+    """Human-readable lint report (tools/lint_kernels.py prints
+    this)."""
+    lines = []
+    lines.append(
+        f"kernel-lint: {len(report['rules'])} rules x "
+        f"{len(report['paths'])} traced paths "
+        f"(N={report['n']}, engines={'+'.join(report['engines'])})"
+    )
+    lines.append(f"  {'encoding':28s} {'path':24s} {'eqns':>6s} "
+                 f"{'errors':>7s}")
+    for p in report["paths"]:
+        lines.append(
+            f"  {p['encoding']:28s} {p['path']:24s} "
+            f"{p['eqns']:6d} {p['errors']:7d}"
+        )
+    errors = [f for f in report["findings"]
+              if f["severity"] == "error"]
+    infos = [f for f in report["findings"] if f["severity"] == "info"]
+    for f in errors:
+        loc = f" @ {f['source']}" if f.get("source") else ""
+        lines.append(
+            f"ERROR [{f['rule']}] {f['encoding']} / {f['path']}: "
+            f"{f['message']}{loc}"
+        )
+    for f in infos:
+        lines.append(
+            f"info  [{f['rule']}] {f['encoding']} / {f['path']}: "
+            f"{f['message']}"
+        )
+    lines.append(
+        "CLEAN — the sparse-engine codegen contract holds"
+        if report["clean"]
+        else f"{len(errors)} contract violation(s)"
+    )
+    return "\n".join(lines)

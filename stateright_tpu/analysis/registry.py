@@ -1,0 +1,121 @@
+"""The lint's encoding registry: every encoding the sparse engines
+run, with its calibrated audit allowances.
+
+The codegen contract is per-encoding-CLASS: hand encodings
+(models/paxos_tpu.py, models/two_phase_commit_tpu.py) factor their
+guards into host-constant word masks; compiled encodings
+(actor/compile.py) generate the same word-native paths from harvested
+tables. The lint runs the SAME rules over all of them × both sparse
+engine pipelines, with only the declared table-gather allowance
+varying:
+
+* hand 2pc gathers NOTHING on the step path (its per-slot constants
+  are arithmetic in the slot index),
+* hand paxos fetches its two packed table rows (≤ 4 gathers under
+  vmap),
+* compiled encodings fetch at most the four intended table rows
+  (params, flat transition, packed history, crash mask).
+
+Adding an encoding to the engines means adding a spec here — the
+``pytest -m lint`` gate then pins its codegen automatically.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+
+@dataclass(frozen=True)
+class EncodingSpec:
+    """One registered encoding and its calibrated allowances."""
+
+    name: str
+    #: "hand" | "compiled"
+    kind: str
+    #: () -> SparseEncodedModel (deferred: building a compiled
+    #: encoding runs the component closure)
+    factory: Callable
+    #: gathers allowed on the step path — the table-row fetch
+    #: allowance the tests calibrated
+    max_step_gathers: int = 4
+
+
+def _hand_paxos():
+    from ..models.paxos import PaxosModelCfg
+    from ..models.paxos_tpu import PaxosEncoded
+
+    return PaxosEncoded(PaxosModelCfg(client_count=2, server_count=3))
+
+
+def _hand_2pc():
+    from ..models.two_phase_commit_tpu import TwoPhaseSysEncoded
+
+    return TwoPhaseSysEncoded(4)
+
+
+def _compiled_abd_ordered():
+    from ..actor import Network
+    from ..models.linearizable_register import AbdModelCfg, abd_model
+
+    model = abd_model(
+        AbdModelCfg(client_count=2, server_count=2),
+        Network.new_ordered(),
+    )
+    return model.to_encoded()
+
+
+def _compiled_ping_pong():
+    from ..actor import Network
+    from ..actor.compile import compile_actor_model
+    from ..models.ping_pong import (
+        PingPongCfg,
+        ping_pong_device_specs,
+        ping_pong_model,
+    )
+
+    cfg = PingPongCfg(max_nat=3)
+    model = ping_pong_model(cfg).init_network(
+        Network.new_unordered_nonduplicating()
+    )
+    return compile_actor_model(model, **ping_pong_device_specs(cfg))
+
+
+#: every encoding the sparse engines are pinned for. Order is the
+#: report order (hand encodings — the calibration sources — first).
+ENCODINGS: tuple = (
+    EncodingSpec(
+        name="hand-paxos-2c3s",
+        kind="hand",
+        factory=_hand_paxos,
+        max_step_gathers=4,
+    ),
+    EncodingSpec(
+        name="hand-2pc-rm4",
+        kind="hand",
+        factory=_hand_2pc,
+        max_step_gathers=0,
+    ),
+    EncodingSpec(
+        name="compiled-abd-ordered-2c2s",
+        kind="compiled",
+        factory=_compiled_abd_ordered,
+        max_step_gathers=4,
+    ),
+    EncodingSpec(
+        name="compiled-ping-pong-nondup",
+        kind="compiled",
+        factory=_compiled_ping_pong,
+        max_step_gathers=4,
+    ),
+)
+
+
+def get_encoding_spec(name: str) -> EncodingSpec:
+    for spec in ENCODINGS:
+        if spec.name == name:
+            return spec
+    raise KeyError(
+        f"unknown encoding {name!r}; registered: "
+        f"{[s.name for s in ENCODINGS]}"
+    )

@@ -1,0 +1,488 @@
+"""The kernel-lint rule registry: the sparse-engine codegen contract
+as declarative, source-attributed checks over traced jaxprs.
+
+Two rounds of perf work (PERF.md §ordered, §wave-wall) priced exactly
+these artifacts; each rule pins one of them:
+
+* ``no-dense-mask`` — no ``[N, K]``/``[F, K]`` bool materialization on
+  a sparse path (the 82x predicate-pass ablation: the engine consumes
+  packed ``uint32[L]`` words, never the dense mask);
+* ``no-mask-gather`` — the enabled-mask paths trace gather-free
+  (shift-mask field extracts and word selects only; the 8x
+  compiled-codegen tax was per-slot table gathers here);
+* ``allowed-table-gather`` — step/fetch paths may gather only the
+  intended table rows (params, flat transition, packed history, crash
+  mask — at most the encoding's declared allowance);
+* ``no-lane-padded-alu`` — no ``[N, 1]``-shaped ALU/compute outputs
+  and no stack-of-scalars concats (≥3 ``[N, 1]`` operands): a
+  ``[N, 1]`` elementwise op pays the full 128-lane tile-padding tax
+  and XLA cannot fuse through the concatenate. The allowed residue is
+  the hand-paxos calibration: ``[N, 1]`` SLICES from consuming
+  multi-lane gather rows and 2-operand index-pair concats, which fuse;
+* ``no-branch-pad-concat`` — ``cond``/``switch`` branches must update
+  carried buffers with class-local ``dynamic_update_slice`` blocks,
+  never rebuild a full-capacity tensor by padding/concatenating a
+  small class result up to peak shape (the pre-round-6 carry pattern:
+  a 2-row tail wave paying the 686k-row peak wave's copies);
+* ``carry-copy-bytes`` — an informational estimator that prices the
+  switch-carry movement ROADMAP names as the next lever: bytes every
+  ``cond``/``switch`` must materialize for its carry, and the
+  carry-movement bytes inside each branch.
+
+A rule sees the shared walk (:mod:`.walker`) plus a :class:`TraceCtx`
+describing the traced path, and yields :class:`Finding`\\ s. Rules
+never import each other's state; adding a rule is appending to
+``RULES``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Optional
+
+from .tables import (
+    BRANCH_PAD_CONCAT_GROWTH,
+    BRANCH_PAD_CONCAT_MIN_BYTES,
+    CARRY_MOVE_PRIMS,
+    is_gather,
+    output_bytes,
+)
+from .walker import (
+    EqnSite,
+    eqn_alu_n1,
+    eqn_dense_bool_k,
+    eqn_wide_concat_n1,
+    iter_eqns,
+    source_of,
+)
+
+
+@dataclass(frozen=True)
+class TraceCtx:
+    """What the lint driver knows about one traced path."""
+
+    #: path label ("bits", "mask", "step", "engine:single",
+    #: "engine:sharded", "wave-body")
+    path: str
+    #: encoding (or engine fixture) the path was traced from
+    encoding: str
+    #: batch rows of the trace (N frontier rows / vmap batch)
+    n: int
+    #: the encoding's action count K (dense-mask last dim)
+    k: int
+    #: dense [n, k] bool is banned on this path (packed-words paths
+    #: and the engine pipeline; enabled_mask_vec's dense view is the
+    #: CONTRACT on the "mask" path, so it sets False)
+    sparse: bool = True
+    #: gathers allowed (0 on mask paths; the table-row allowance on
+    #: step paths; None = gathers unaudited, e.g. the wave body whose
+    #: winner-fetch gathers are the intended idiom)
+    allow_gathers: Optional[int] = 0
+    #: True on table-fetch paths (step): gather findings report under
+    #: allowed-table-gather with the table-row diagnosis, even at
+    #: allowance 0 — a mask-path message for a step-path defect sends
+    #: the maintainer to the wrong contract
+    table_path: bool = False
+    #: audit [n, 1] ALU / stack-of-scalars concats on this path
+    check_lane_alu: bool = True
+    #: audit cond/switch branch shapes + price carry movement
+    check_branches: bool = False
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule hit, attributed to the source equation."""
+
+    rule: str
+    severity: str  # "error" | "info"
+    encoding: str
+    path: str
+    message: str
+    primitive: Optional[str] = None
+    source: Optional[str] = None
+    data: dict = field(default_factory=dict)
+
+    def format(self) -> str:
+        loc = f" @ {self.source}" if self.source else ""
+        return (
+            f"[{self.rule}] {self.encoding} / {self.path}: "
+            f"{self.message}{loc}"
+        )
+
+
+@dataclass(frozen=True)
+class Rule:
+    name: str
+    description: str
+    run: Callable[[TraceCtx, list], Iterable[Finding]]
+
+
+def _out_shapes(eqn):
+    for v in eqn.outvars:
+        sh = getattr(v.aval, "shape", None)
+        if sh is not None:
+            yield v.aval, sh
+
+
+# -- no-dense-mask ---------------------------------------------------------
+
+def _no_dense_mask(ctx: TraceCtx, sites: list) -> Iterable[Finding]:
+    if not ctx.sparse:
+        return
+    for site in sites:
+        if not eqn_dense_bool_k(site.eqn, ctx.k):
+            continue
+        shapes = [
+            sh for _, sh in _out_shapes(site.eqn)
+            if len(sh) == 2 and sh[1] == ctx.k
+        ]
+        yield Finding(
+            rule="no-dense-mask",
+            severity="error",
+            encoding=ctx.encoding,
+            path=ctx.path,
+            message=(
+                f"dense bool[{shapes[0][0]}, K={ctx.k}] mask "
+                f"materialized by `{site.primitive}` on a "
+                "sparse path (the engine consumes packed "
+                "uint32 words; PERF.md §wave-wall priced this "
+                "pass 82x)"
+            ),
+            primitive=site.primitive,
+            source=source_of(site.eqn),
+        )
+
+
+# -- no-mask-gather / allowed-table-gather ---------------------------------
+
+def _no_mask_gather(ctx: TraceCtx, sites: list) -> Iterable[Finding]:
+    # mask-class paths only: a step-path gather is a table-fetch
+    # defect and reports under allowed-table-gather below.
+    if ctx.allow_gathers != 0 or ctx.table_path:
+        return
+    engine = ctx.path.startswith("engine:")
+    for site in sites:
+        if is_gather(site.primitive):
+            yield Finding(
+                rule="no-mask-gather",
+                severity="error",
+                encoding=ctx.encoding,
+                path=ctx.path,
+                message=(
+                    f"`{site.primitive}` on a gather-free path — "
+                    + (
+                        "the engine's pair pipeline (bitmap "
+                        "predicate, peel, packed-append compaction) "
+                        "is elementwise + sort only; one Ba-row "
+                        "gather costs a whole extra sort (PERF.md "
+                        "§gathers)"
+                        if engine
+                        else "mask paths must be shift-mask field "
+                        "extracts and word selects only (the 8x "
+                        "compiled-codegen tax, PERF.md §ordered)"
+                    )
+                ),
+                primitive=site.primitive,
+                source=source_of(site.eqn),
+            )
+
+
+def _allowed_table_gather(ctx: TraceCtx, sites: list) -> Iterable[Finding]:
+    # table-fetch (step) paths only, at ANY allowance including 0 —
+    # hand 2pc's step is pure slot arithmetic, so its allowance IS 0
+    # and a gather there must still get the table-row diagnosis.
+    if not ctx.table_path or ctx.allow_gathers is None:
+        return
+    gathers = [s for s in sites if is_gather(s.primitive)]
+    if len(gathers) > ctx.allow_gathers:
+        srcs = ", ".join(source_of(s.eqn) for s in gathers)
+        yield Finding(
+            rule="allowed-table-gather",
+            severity="error",
+            encoding=ctx.encoding,
+            path=ctx.path,
+            message=(
+                f"{len(gathers)} gathers on a table-fetch path whose "
+                f"allowance is {ctx.allow_gathers} (the intended "
+                "idiom is one multi-lane gather per table row — "
+                "params, flat transition, packed history, crash "
+                f"mask); gather sites: {srcs}"
+            ),
+            primitive=gathers[0].primitive,
+            source=source_of(gathers[0].eqn),
+            data={"gathers": len(gathers),
+                  "allowance": ctx.allow_gathers},
+        )
+
+
+# -- no-lane-padded-alu ----------------------------------------------------
+
+def _no_lane_padded_alu(ctx: TraceCtx, sites: list) -> Iterable[Finding]:
+    if not ctx.check_lane_alu:
+        return
+    n = ctx.n
+    for site in sites:
+        eqn = site.eqn
+        name = site.primitive
+        if eqn_alu_n1(eqn, n):
+            yield Finding(
+                rule="no-lane-padded-alu",
+                severity="error",
+                encoding=ctx.encoding,
+                path=ctx.path,
+                message=(
+                    f"[{n}, 1]-shaped `{name}` — real compute "
+                    "at 128x lane padding (PERF.md §ordered); "
+                    "keep lane math 1-D [N]-shaped and "
+                    "reshape only at the very end"
+                ),
+                primitive=name,
+                source=source_of(eqn),
+            )
+        n1_ops = eqn_wide_concat_n1(eqn, n)
+        if n1_ops:
+            yield Finding(
+                rule="no-lane-padded-alu",
+                severity="error",
+                encoding=ctx.encoding,
+                path=ctx.path,
+                message=(
+                    f"stack-of-scalars concatenate of {n1_ops} "
+                    f"[{n}, 1] lanes — XLA cannot fuse through a "
+                    "wide concatenate (the ~470ms/run artifact, "
+                    "PERF.md §ordered); 2-operand index-pair "
+                    "concats are the calibrated residue"
+                ),
+                primitive=name,
+                source=source_of(eqn),
+                data={"n1_operands": n1_ops},
+            )
+
+
+# -- no-branch-pad-concat --------------------------------------------------
+
+def _axis0(sh) -> int:
+    return int(sh[0]) if sh else 1
+
+
+def _zeroish_rows(site: EqnSite, eqn) -> tuple:
+    """Split a concatenate's axis-0 operand rows into (filler, real):
+    filler operands are literals, jaxpr constants, or values a
+    ``broadcast_in_dim`` of a scalar produced inside the same
+    sub-jaxpr — the static signature of a ``zeros(...)`` pad block."""
+    producers = {}
+    if site.jaxpr is not None:
+        for e in site.jaxpr.eqns:
+            if e.primitive.name == "broadcast_in_dim" and not getattr(
+                e.invars[0].aval, "shape", ()
+            ):
+                for v in e.outvars:
+                    producers[id(v)] = "scalar-broadcast"
+        consts = set(map(id, site.jaxpr.constvars))
+    else:
+        consts = set()
+    filler = real = 0
+    for v in eqn.invars:
+        sh = getattr(v.aval, "shape", None)
+        rows = _axis0(sh) if sh else 1
+        if (
+            not hasattr(v, "count")  # Literal
+            or id(v) in consts
+            or id(v) in producers
+        ):
+            filler += rows
+        else:
+            real += rows
+    return filler, real
+
+
+def _no_branch_pad_concat(ctx: TraceCtx, sites: list) -> Iterable[Finding]:
+    if not ctx.check_branches:
+        return
+    for site in sites:
+        # Only a pad/concat RETURNED as part of a branch's carry
+        # (directly or through convert/reshape passthroughs) is the
+        # priced pattern (rebuilding a carried buffer at peak shape);
+        # internal temporaries — merge sort lanes, index plumbing —
+        # are the engine's legitimate concats.
+        if not site.in_branch():
+            continue
+        eqn = site.eqn
+        name = site.primitive
+        if name not in ("pad", "concatenate"):
+            continue
+        if not site.reaches_output():
+            continue
+        outs = list(_out_shapes(eqn))
+        if not outs:
+            continue
+        out_aval, out_sh = outs[0]
+        nbytes = output_bytes(out_aval)
+        if nbytes < BRANCH_PAD_CONCAT_MIN_BYTES or not out_sh:
+            continue
+        if name == "concatenate" and eqn.params.get("dimension") != 0:
+            continue
+        in0 = max(
+            (_axis0(getattr(v.aval, "shape", ()))
+             for v in eqn.invars
+             if getattr(v.aval, "shape", None)),
+            default=1,
+        )
+        grown = _axis0(out_sh) >= BRANCH_PAD_CONCAT_GROWTH * max(in0, 1)
+        padded = False
+        if name == "pad":
+            cfg = eqn.params.get("padding_config") or ()
+            if cfg:
+                lo, hi, _ = cfg[0]
+                padded = lo + hi >= max(in0, 1)
+        else:
+            filler, real = _zeroish_rows(site, eqn)
+            padded = filler >= max(real, 1)
+        if not (grown or padded):
+            continue
+        yield Finding(
+            rule="no-branch-pad-concat",
+            severity="error",
+            encoding=ctx.encoding,
+            path=ctx.path,
+            message=(
+                f"branch carry built by `{name}` inside "
+                f"{site.branch_path()}: axis 0 {in0} -> "
+                f"{_axis0(out_sh)} rows ({nbytes / 1e6:.2f} MB out)"
+                " — switch branches must write class-local "
+                "dynamic_update_slice blocks into the carried "
+                "buffer, not pad a class result to peak shape (the "
+                "round-6 carry rework, PERF.md §wave-wall)"
+            ),
+            primitive=name,
+            source=source_of(eqn),
+            data={"in_rows": in0, "out_rows": _axis0(out_sh),
+                  "out_bytes": nbytes},
+        )
+
+
+# -- carry-copy-bytes (estimator) ------------------------------------------
+
+def _carry_copy_bytes(ctx: TraceCtx, sites: list) -> Iterable[Finding]:
+    """Price the carry each ``cond``/``switch`` materializes: the
+    bytes of every branch's returned carry (the movement XLA still
+    performs between classes — ROADMAP's named next lever) plus the
+    carry-movement primitive bytes inside branches. Informational:
+    the number exists so a future carry rework can show the delta
+    statically, the way the round-6 rework showed up in the wave-wall
+    HLO categories."""
+    if not ctx.check_branches:
+        return
+    switch_bytes = 0
+    n_switches = 0
+    move_bytes = 0
+    top = None  # fattest switch
+    for site in sites:
+        eqn = site.eqn
+        if site.primitive == "cond":
+            n_switches += 1
+            b = sum(output_bytes(v.aval) for v in eqn.outvars)
+            switch_bytes += b
+            if top is None or b > top[0]:
+                top = (b, len(eqn.params.get("branches", ())),
+                       source_of(eqn))
+        elif site.in_branch() and site.primitive in CARRY_MOVE_PRIMS:
+            move_bytes += sum(
+                output_bytes(v.aval) for v in eqn.outvars
+            )
+    if n_switches == 0:
+        return
+    top_b, top_nb, top_src = top
+    yield Finding(
+        rule="carry-copy-bytes",
+        severity="info",
+        encoding=ctx.encoding,
+        path=ctx.path,
+        message=(
+            f"{n_switches} cond/switch eqns carry "
+            f"{switch_bytes / 1e6:.2f} MB of outputs (fattest: "
+            f"{top_b / 1e6:.2f} MB x {top_nb} branches @ {top_src}); "
+            f"{move_bytes / 1e6:.2f} MB of pad/slice/concat/"
+            "dynamic_update_slice outputs inside branches"
+        ),
+        primitive="cond",
+        source=top_src,
+        data={
+            "switches": n_switches,
+            "switch_carry_bytes": switch_bytes,
+            "fattest_switch_bytes": top_b,
+            "branch_move_bytes": move_bytes,
+        },
+    )
+
+
+#: the registry — ``tools/lint_kernels.py`` and ``pytest -m lint``
+#: both run exactly this list.
+RULES: tuple = (
+    Rule(
+        name="no-dense-mask",
+        description=(
+            "no [N, K]/[F, K] bool materialization on the sparse "
+            "path (packed uint32 words are the mask)"
+        ),
+        run=_no_dense_mask,
+    ),
+    Rule(
+        name="no-mask-gather",
+        description=(
+            "enabled-mask paths trace gather-free (shift-mask field "
+            "extracts + word selects only)"
+        ),
+        run=_no_mask_gather,
+    ),
+    Rule(
+        name="allowed-table-gather",
+        description=(
+            "step paths gather at most the encoding's declared "
+            "table-row allowance (the four intended fetches)"
+        ),
+        run=_allowed_table_gather,
+    ),
+    Rule(
+        name="no-lane-padded-alu",
+        description=(
+            "no [N, 1]-shaped ALU outputs, no >=3-operand [N, 1] "
+            "concats (hand-paxos fuse-through residue allowed)"
+        ),
+        run=_no_lane_padded_alu,
+    ),
+    Rule(
+        name="no-branch-pad-concat",
+        description=(
+            "switch branches update carries with class-local "
+            "dynamic_update_slice, never full-capacity pad+concat"
+        ),
+        run=_no_branch_pad_concat,
+    ),
+    Rule(
+        name="carry-copy-bytes",
+        description=(
+            "informational: price the carry bytes each switch "
+            "materializes (ROADMAP's switch-carry-movement lever)"
+        ),
+        run=_carry_copy_bytes,
+    ),
+)
+
+
+def run_rules(ctx: TraceCtx, closed) -> list:
+    """Run every registered rule over one traced path. ``closed`` is
+    a ``ClosedJaxpr`` (``jax.make_jaxpr`` output)."""
+    return run_rules_with_stats(ctx, closed)[0]
+
+
+def run_rules_with_stats(ctx: TraceCtx, closed) -> tuple:
+    """``(findings, n_eqns)`` — one walk serves both the rules and
+    the coverage stats (the lint driver's per-path eqn counts; big
+    traces run to thousands of eqns, so the walk is not re-done just
+    to count)."""
+    sites = list(iter_eqns(closed.jaxpr))
+    findings: list = []
+    for rule in RULES:
+        findings.extend(rule.run(ctx, sites))
+    return findings, len(sites)

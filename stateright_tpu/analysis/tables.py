@@ -1,0 +1,189 @@
+"""The single home of primitive/opcode classification.
+
+Three consumers audit the sparse-engine codegen contract and each
+needs to agree on what counts as "real compute", "a gather", or
+"carry movement":
+
+* the jaxpr-level kernel-lint rules (:mod:`.rules`, ``pytest -m
+  lint``, ``tools/lint_kernels.py``),
+* the codegen-shape tests (tests/test_codegen_shapes.py, which
+  calibrated the allowed residue against the hand paxos encoding),
+* the wave-wall profiler's per-HLO-category attribution
+  (stateright_tpu/wavewall.py), which classifies optimized-HLO
+  opcodes with the same vocabulary the round-5 device-trace analysis
+  used.
+
+Before round 7 the first two each carried a private copy of the ALU
+set and the third its own opcode table; a primitive added to one and
+not the others silently weakened the audit. Everything below is data
+(frozensets / dicts) plus two pure classifiers so the tables cannot
+drift per consumer.
+"""
+
+from __future__ import annotations
+
+import re
+
+# --------------------------------------------------------------------------
+# jaxpr-primitive side (the lint rules and codegen-shape tests)
+# --------------------------------------------------------------------------
+
+#: elementwise/ALU primitives — a ``[N, 1]`` output from any of these
+#: is real compute at 128x lane padding, the PERF.md §ordered tax.
+#: (Shape-only ops — slice, reshape, broadcast, concatenate — are NOT
+#: here: a ``[N, 1]`` slice from consuming a multi-lane gather row is
+#: the intended sparse idiom and fuses; ``[N, 1]`` COMPUTE does not.)
+ALU_PRIMS = frozenset({
+    "add", "sub", "mul", "div", "rem", "and", "or", "xor",
+    "shift_left", "shift_right_logical", "shift_right_arithmetic",
+    "select_n", "eq", "ne", "lt", "le", "gt", "ge", "min", "max",
+    "population_count", "convert_element_type", "neg", "not",
+})
+
+#: primitives that price as carry/block movement at the jaxpr level —
+#: the static fingerprint of the between-stage wave wall (PERF.md
+#: §wave-wall). The carry-copy-bytes estimator sums the output bytes
+#: of these inside ``cond``/``switch`` branches.
+CARRY_MOVE_PRIMS = frozenset({
+    "concatenate", "pad", "slice", "dynamic_slice",
+    "dynamic_update_slice", "copy",
+})
+
+#: minimum output bytes before a branch pad/concat counts as buffer
+#: assembly rather than index plumbing (a 2-operand ``[N, 1]`` concat
+#: that builds a gather index pair is the calibrated paxos residue and
+#: fuses; a full-F frontier rebuild does not).
+BRANCH_PAD_CONCAT_MIN_BYTES = 4096
+
+#: axis-0 growth factor above which a branch pad/concat reads as
+#: "pad small class result to full capacity" (the pre-round-6 pattern
+#: the class-local dynamic_update_slice rework deleted) rather than a
+#: merge-style append of comparably-sized halves.
+BRANCH_PAD_CONCAT_GROWTH = 2.0
+
+#: value-preserving unary ops a padded carry may pass through between
+#: a pad/concat and its branch return (a ``.astype(...)`` or reshape
+#: must not hide a peak-shape rebuild from the branch rule).
+PASSTHROUGH_PRIMS = frozenset({
+    "convert_element_type", "reshape", "copy", "bitcast_convert_type",
+    "stop_gradient",
+})
+
+
+def is_gather(primitive_name: str) -> bool:
+    """The gather classification every audit shares: any primitive
+    whose name contains ``gather`` (``gather``, ``dynamic_gather``,
+    batched variants) — at the jaxpr level take/``x[idx]``/
+    ``take_along_axis`` all lower to one of these."""
+    return "gather" in primitive_name
+
+
+def output_bytes(aval) -> int:
+    """Bytes of one jaxpr output aval (0 for abstract tokens)."""
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * dtype.itemsize
+
+
+# --------------------------------------------------------------------------
+# HLO-opcode side (the wave-wall profiler and the --hlo lint pass)
+# --------------------------------------------------------------------------
+
+#: dtype byte widths for HLO shape strings.
+DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+#: HLO opcode -> trace-category, the round-5 device-trace vocabulary
+#: (PERF.md). Copies/transposes/converts are XLA's between-stage data
+#: formatting; pad is class-quantization padding; slice/concat/
+#: dynamic-(update-)slice are carry and block movement; fusion is the
+#: actual stage compute.
+HLO_CATEGORY = {}
+for _op in ("copy", "copy-start", "copy-done", "bitcast",
+            "bitcast-convert", "transpose", "reshape", "convert"):
+    HLO_CATEGORY[_op] = "data formatting"
+HLO_CATEGORY["pad"] = "quantization padding"
+HLO_CATEGORY["dynamic-update-slice"] = "dynamic-update-slice"
+for _op in ("dynamic-slice", "slice", "concatenate"):
+    HLO_CATEGORY[_op] = "carry/slice movement"
+HLO_CATEGORY["sort"] = "sort"
+HLO_CATEGORY["gather"] = "gather"
+HLO_CATEGORY["scatter"] = "scatter"
+HLO_CATEGORY["fusion"] = "fusion"
+for _op in ("while", "conditional", "call", "tuple",
+            "get-tuple-element", "parameter", "constant",
+            "iota", "broadcast", "after-all", "partition-id",
+            "replica-id"):
+    HLO_CATEGORY[_op] = "control"
+for _op in ("add", "subtract", "multiply", "divide", "remainder",
+            "and", "or", "xor", "not", "negate", "compare",
+            "select", "shift-left", "shift-right-logical",
+            "shift-right-arithmetic", "popcnt", "clz",
+            "maximum", "minimum", "abs", "sign", "clamp",
+            "reduce", "reduce-window", "map", "exponential",
+            "log", "power"):
+    # XLA:CPU leaves elementwise ALU unfused where the TPU trace
+    # shows loop fusions — same stage-compute category.
+    HLO_CATEGORY[_op] = "elementwise compute"
+del _op
+
+#: the categories whose bytes ARE the wave wall (the carry-copy-bytes
+#: estimator's HLO-level numerator).
+HLO_WALL_CATEGORIES = frozenset({
+    "data formatting", "quantization padding",
+    "carry/slice movement", "dynamic-update-slice",
+})
+
+_SHAPE_RE = re.compile(r"\b([a-z]+\d*)\[([0-9,]*)\]")
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.*?)\s+"
+    r"([a-z][a-z0-9\-]*)\("
+)
+
+
+def hlo_category(opcode: str) -> str:
+    """Map an HLO opcode to the trace-category vocabulary."""
+    return HLO_CATEGORY.get(opcode, "other")
+
+
+def hlo_type_bytes(type_str: str) -> int:
+    """Output bytes of an HLO instruction's (possibly tuple) type."""
+    total = 0
+    for dt, dims in _SHAPE_RE.findall(type_str):
+        width = DTYPE_BYTES.get(dt)
+        if width is None:
+            continue
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n * width
+    return total
+
+
+def parse_hlo_categories(hlo_text: str) -> dict:
+    """Per-category ``{"ops": count, "bytes": output_bytes}`` over
+    every instruction of an optimized-HLO dump (sub-computations —
+    fusion bodies, while bodies, branch computations — included; their
+    instructions are what the categories exist to attribute)."""
+    out: dict = {}
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.match(line)
+        if m is None:
+            continue
+        type_str, opcode = m.groups()
+        cat = hlo_category(opcode)
+        slot = out.setdefault(cat, {"ops": 0, "bytes": 0})
+        slot["ops"] += 1
+        slot["bytes"] += hlo_type_bytes(type_str)
+    return out

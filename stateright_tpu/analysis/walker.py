@@ -1,0 +1,224 @@
+"""Jaxpr traversal for the kernel-lint rules.
+
+One walk, every consumer: :func:`iter_eqns` yields each equation of a
+(closed) jaxpr together with the stack of enclosing higher-order
+primitives — ``scan``/``while`` bodies, ``cond``/``switch`` branches
+(with the branch index), ``pjit``/``custom_jvp`` call bodies, anything
+that stores sub-jaxprs in its params — so a rule can ask "is this pad
+inside a switch branch?" without re-implementing the descent. The
+codegen-shape audit (:func:`audit_jaxpr`) and the lint rules
+(:mod:`.rules`) both run on this stream.
+
+Source attribution: every yielded equation carries its jax
+``source_info``; :func:`source_of` renders it as ``file:line (fn)``
+(the innermost non-jax user frame), which is what a lint finding
+prints so a flagged op points at the encoding/engine line that traced
+it, not at the walker.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Iterator
+
+
+@dataclass(frozen=True)
+class EqnSite:
+    """One equation plus where the walk found it.
+
+    ``stack`` is a tuple of ``(enclosing_primitive_name, branch_index
+    or None)`` from outermost to innermost — e.g. a pad inside the
+    third branch of the class-ladder switch inside the wave while-loop
+    walks in with ``(("while", None), ("cond", 2))``. ``jaxpr`` is the
+    (sub-)jaxpr the equation belongs to, so a rule can ask whether an
+    equation's result is one of its jaxpr's OUTPUTS (a branch
+    returning a rebuilt buffer as its carry) versus an internal
+    temporary (a sort lane that never leaves the branch).
+    """
+
+    eqn: Any
+    stack: tuple
+    jaxpr: Any = None
+
+    @property
+    def primitive(self) -> str:
+        return self.eqn.primitive.name
+
+    def in_branch(self) -> bool:
+        """True when the equation sits inside a ``cond``/``switch``
+        branch computation at any depth."""
+        return any(name == "cond" for name, _ in self.stack)
+
+    def reaches_output(self) -> bool:
+        """True when one of the equation's results is returned by its
+        enclosing (sub-)jaxpr — directly, or through a chain of
+        value-preserving unary ops (tables.PASSTHROUGH_PRIMS: a
+        ``.astype(...)``/reshape between a rebuilt buffer and the
+        branch return must not hide it). For an equation inside a
+        branch, reaching the output means the value is part of the
+        branch's returned carry.
+
+        Known limitation: a value laundered through a BINARY ALU
+        identity (``x | 0``, ``x + 0``) is not followed — treating
+        ALU ops as passthrough would over-approximate reachability
+        and flag legitimate in-branch compute whose result happens
+        to be returned."""
+        from .tables import PASSTHROUGH_PRIMS
+
+        jx = self.jaxpr
+        if jx is None:
+            return False
+        outs = set(map(id, jx.outvars))
+        frontier = {id(v) for v in self.eqn.outvars}
+        if frontier & outs:
+            return True
+        # follow pure passthroughs forward (the jaxpr is
+        # topologically ordered, so one linear scan covers chains)
+        for e in jx.eqns:
+            if e.primitive.name not in PASSTHROUGH_PRIMS:
+                continue
+            if any(id(v) in frontier for v in e.invars
+                   if hasattr(v, "count")):
+                for v in e.outvars:
+                    frontier.add(id(v))
+                    if id(v) in outs:
+                        return True
+        return False
+
+    def branch_path(self) -> str:
+        return "/".join(
+            name if idx is None else f"{name}[{idx}]"
+            for name, idx in self.stack
+        )
+
+
+def _sub_jaxprs(eqn) -> Iterator[tuple]:
+    """Yield ``(sub_jaxpr, branch_index or None)`` for every sub-jaxpr
+    stored in an equation's params. ``cond``'s ``branches`` param (the
+    jaxpr form of both ``lax.cond`` and ``lax.switch``) is the one
+    list whose position is meaningful — branch indices let the
+    branch-shape rules name the offending class."""
+    for key, p in eqn.params.items():
+        if hasattr(p, "jaxpr"):
+            yield p.jaxpr, None
+        elif hasattr(p, "eqns"):
+            # an open Jaxpr stored directly (e.g. shard_map's param)
+            yield p, None
+        elif isinstance(p, (list, tuple)):
+            for i, q in enumerate(p):
+                if hasattr(q, "jaxpr"):
+                    yield q.jaxpr, (i if key == "branches" else None)
+                elif hasattr(q, "eqns"):
+                    yield q, (i if key == "branches" else None)
+
+
+def iter_eqns(jaxpr, _stack: tuple = ()) -> Iterator[EqnSite]:
+    """Depth-first over every equation of ``jaxpr`` (a ``Jaxpr`` — pass
+    ``closed.jaxpr`` for a ``ClosedJaxpr``) including all sub-jaxprs."""
+    for eqn in jaxpr.eqns:
+        yield EqnSite(eqn, _stack, jaxpr)
+        name = eqn.primitive.name
+        for sub, branch in _sub_jaxprs(eqn):
+            yield from iter_eqns(sub, _stack + ((name, branch),))
+
+
+def source_of(eqn) -> str:
+    """``file:line (function)`` of the user frame that traced the
+    equation — the attribution a finding prints."""
+    si = getattr(eqn, "source_info", None)
+    if si is None:
+        return "<unknown>"
+    try:
+        from jax._src import source_info_util
+
+        return source_info_util.summarize(si)
+    except Exception:
+        return "<unknown>"
+
+
+# -- the shared per-eqn shape predicates -----------------------------------
+# One implementation each, consumed by BOTH the declarative rules
+# (analysis/rules.py) and the codegen-shape audit below — the
+# detection logic cannot drift between the lint and the tests.
+
+def eqn_dense_bool_k(eqn, k: int) -> bool:
+    """Any 2-D bool output whose LAST dim is ``k`` — the dense
+    ``[rows, K]`` mask at any row count (frontier rows, pair-buffer
+    rows, tile rows alike)."""
+    import numpy as np
+
+    for v in eqn.outvars:
+        sh = getattr(v.aval, "shape", None)
+        if (
+            sh is not None
+            and len(sh) == 2
+            and sh[1] == k
+            and getattr(v.aval, "dtype", None) == np.bool_
+        ):
+            return True
+    return False
+
+
+def eqn_alu_n1(eqn, n: int) -> bool:
+    """An ALU primitive with a ``[n, 1]``-shaped output — real
+    compute at 128x lane padding."""
+    from .tables import ALU_PRIMS
+
+    if eqn.primitive.name not in ALU_PRIMS:
+        return False
+    return any(
+        getattr(v.aval, "shape", None) == (n, 1) for v in eqn.outvars
+    )
+
+
+def eqn_wide_concat_n1(eqn, n: int) -> int:
+    """Count of ``[n, 1]`` operands when the eqn is a concatenate of
+    ≥3 of them (the stack-of-lane-scalars pattern); else 0."""
+    if eqn.primitive.name != "concatenate":
+        return 0
+    n1_ops = sum(
+        1 for v in eqn.invars
+        if getattr(v.aval, "shape", None) == (n, 1)
+    )
+    return n1_ops if n1_ops >= 3 else 0
+
+
+def audit_jaxpr(closed, *, n: int, k: int):
+    """The codegen-shape audit the tests calibrated (round 5/6),
+    run over the shared walk and predicates: gather count,
+    ``[n, 1]``-shaped ALU outputs, dense ``[*, k]`` bool outputs (any
+    row count — tile- and pair-buffer-shaped dense masks count too),
+    and concatenates of ≥3 ``[n, 1]`` operands (the
+    stack-of-lane-scalars pattern).
+
+    Returns ``dict(gathers, alu_n1, wide_concat_n1, bool_nk)`` with
+    the same keys tests/test_codegen_shapes.py always asserted on,
+    plus ``gather_sites`` / ``bool_nk_sites`` / ``alu_n1_sites``
+    (``(primitive, source)`` pairs) so a failure names the traced
+    line.
+    """
+    from .tables import is_gather
+
+    stats = dict(
+        gathers=0, alu_n1=[], wide_concat_n1=0, bool_nk=[],
+        gather_sites=[], alu_n1_sites=[], bool_nk_sites=[],
+        wide_concat_n1_sites=[],
+    )
+    for site in iter_eqns(closed.jaxpr):
+        eqn = site.eqn
+        name = site.primitive
+        if is_gather(name):
+            stats["gathers"] += 1
+            stats["gather_sites"].append((name, source_of(eqn)))
+        if eqn_wide_concat_n1(eqn, n):
+            stats["wide_concat_n1"] += 1
+            stats["wide_concat_n1_sites"].append(
+                (name, source_of(eqn))
+            )
+        if eqn_alu_n1(eqn, n):
+            stats["alu_n1"].append(name)
+            stats["alu_n1_sites"].append((name, source_of(eqn)))
+        if eqn_dense_bool_k(eqn, k):
+            stats["bool_nk"].append(name)
+            stats["bool_nk_sites"].append((name, source_of(eqn)))
+    return stats

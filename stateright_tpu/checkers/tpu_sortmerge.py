@@ -67,6 +67,7 @@ from ..encoding import (
     normalize_step_slot_result,
 )
 from ..model import Expectation
+from ..ops.bitmask import mask_words
 from ..ops.fingerprint import fingerprint_u32v
 from ..ops.u64 import U64, u64_add
 from .tpu import (
@@ -152,6 +153,14 @@ def sparse_pair_candidates(enc, frontier_f, fval_f, expand, *, EV, B_p,
     Returns ``(pidx[Ba], live[Ba], pslot[Ba], cnt[F_f], n_pairs,
     pair_ovf, tile_max)`` — ``pair_ovf`` is True when a row enabled
     more than EV slots or the wave enabled more than B_p pairs.
+
+    Codegen contract (pinned by ``pytest -m lint`` /
+    tools/lint_kernels.py for every registered encoding, in BOTH
+    invocation styles — this direct call and the sharded engine's
+    ``axis_name="shard"`` call under ``shard_map``): no dense
+    ``[F, K]`` bool anywhere, no gather anywhere; the bitmap
+    predicate, peel, and packed-append compaction are elementwise +
+    sort only (stateright_tpu/analysis/).
     """
     import jax
     import jax.numpy as jnp
@@ -162,7 +171,7 @@ def sparse_pair_candidates(enc, frontier_f, fval_f, expand, *, EV, B_p,
     F_f = frontier_f.shape[0]
     W = frontier_f.shape[1]
     K = enc.max_actions
-    L = (K + 31) // 32
+    L = mask_words(K)
     NPg = F_f * EV
     compaction = NPg > B_p
     bits_fn = getattr(enc, "enabled_bits_vec", None)
@@ -1213,7 +1222,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             NT = _divisor_at_least(F_f, want_tiles) if compaction else 1
             T = F_f // NT
             Ba = (B_p + T * EV) if compaction else NPg
-            L = (K + 31) // 32
+            L = mask_words(K)
             # Memory-lean mode: when the [Ba, W] successor tensor would
             # blow the flat budget (paxos check 4: 28M pairs × 19 lanes
             # ≈ 2GB at merge-time peak), fingerprint pairs in chunks
@@ -1494,10 +1503,13 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         def cond(c):
             return ~c["done"] & (c["wchunk"] < waves_per_sync)
 
-        # Tooling hook (stateright_tpu/wavewall.py): the un-jitted wave
-        # body, re-traceable on a captured carry, so the wave-wall
-        # profiler can time/lower ONE wave in isolation (the chunk
-        # program hides per-wave structure inside the while_loop).
+        # Tooling hook: the un-jitted wave body, re-traceable on a
+        # captured carry (stateright_tpu/wavewall.py times/lowers ONE
+        # wave in isolation — the chunk program hides per-wave
+        # structure inside the while_loop) or on eval_shape abstract
+        # carries (stateright_tpu/analysis/lint.py walks the traced
+        # switch branches for the no-branch-pad-concat rule and the
+        # carry-copy-bytes estimator, never allocating buffers).
         self._wave_body = body
 
         def chunk(carry):

@@ -57,6 +57,7 @@ import numpy as np
 from ..actor import Id
 from ..actor.register import Get, GetOk, Internal, Put, PutOk
 from ..encoding import EncodedModelBase
+from ..ops.bitmask import mask_words
 from ..semantics.register import ReadOk, ReadOp, WriteOk, WriteOp
 from .paxos import (
     Accept,
@@ -263,7 +264,7 @@ class PaxosEncoded(EncodedModelBase):
         self.universe = self._build_universe()
         self.index = {self._env_key(e): k for k, e in enumerate(self.universe)}
         self.K = len(self.universe)
-        self.net_lanes = (self.K + 31) // 32
+        self.net_lanes = mask_words(self.K)
         self.n_state_lanes = (
             self.S * (2 if self.two_lane else 1) + self.n_client_lanes
         )

@@ -108,3 +108,43 @@ def ping_pong_model(cfg: PingPongCfg) -> ActorModel:
             lambda m, s: s.history[1] <= s.history[0] + 1,
         )
     )
+
+
+def ping_pong_device_specs(cfg: PingPongCfg) -> dict:
+    """Device property/boundary specs for ``compile_actor_model`` —
+    the device counterparts of every host property above, plus the
+    boundary and closure bounds. One copy shared by the actor-compile
+    tests, the codegen-shape tests, and the kernel-lint encoding
+    registry (stateright_tpu/analysis/registry.py)."""
+    counts = lambda ctx: ctx.actor_values(lambda i, s: s)  # noqa: E731
+
+    def in_le_out(ctx, jnp):
+        return ctx.history_value(lambda h: int(h[0] <= h[1])) == 1
+
+    def out_le_in1(ctx, jnp):
+        return ctx.history_value(lambda h: int(h[1] <= h[0] + 1)) == 1
+
+    return dict(
+        properties={
+            "delta within 1": lambda ctx, jnp: (
+                jnp.max(counts(ctx)) - jnp.min(counts(ctx)) <= 1
+            ),
+            "can reach max": lambda ctx, jnp: jnp.any(
+                counts(ctx) == cfg.max_nat
+            ),
+            "must reach max": lambda ctx, jnp: jnp.any(
+                counts(ctx) == cfg.max_nat
+            ),
+            "must exceed max": lambda ctx, jnp: jnp.any(
+                counts(ctx) == cfg.max_nat + 1
+            ),
+            "#in <= #out": in_le_out,
+            "#out <= #in + 1": out_le_in1,
+        },
+        boundary=lambda ctx, jnp: jnp.all(counts(ctx) <= cfg.max_nat),
+        closure_actor_bound=lambda i, s: s <= cfg.max_nat,
+        # History counters only advance on non-no-op deliveries, which
+        # the actor-state bound caps at max_nat+1 per actor; beyond
+        # that the (in, out) pairs only occur outside the boundary.
+        closure_history_bound=lambda h: max(h) <= 2 * (cfg.max_nat + 2),
+    )

@@ -21,7 +21,12 @@ producers/consumers can't drift:
   the pair buffers.
 
 Word layout (everywhere): slot ``k`` lives in word ``k // 32`` at bit
-``k % 32``; tail bits of the last word are zero.
+``k % 32``; tail bits of the last word are zero. The builders are
+pinned against brute-force references over randomized inputs
+(tests/test_bitmask_props.py, including the ``k % 32 == 0``
+full-tail-word edge), and the gather-free / lane-shape contract of
+everything that CONSUMES them is pinned by the kernel lint
+(stateright_tpu/analysis/, ``pytest -m lint``).
 
 **Word-level guard builders (round 6).** A hand encoding's enabled
 predicate factors as "host-constant slot class × small state-dependent

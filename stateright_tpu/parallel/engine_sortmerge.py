@@ -50,7 +50,11 @@ checkers/tpu_sortmerge.py — including the round-6 WORD-NATIVE enabled
 predicate: encodings providing ``enabled_bits_vec`` never materialize
 a dense ``[F, K]`` bool on any shard, hand paxos/2pc and the compiled
 actor encodings alike), and only real candidates enter the routing
-sort and the shuffle.
+sort and the shuffle. This engine's invocation style of that pipeline
+— inside ``shard_map`` with ``axis_name="shard"``, which changes the
+traced program (``lax.pvary`` carry plumbing) — is pinned separately
+by the kernel lint's ``engine:sharded`` trace
+(stateright_tpu/analysis/lint.py, ``pytest -m lint``).
 
 On one device the shuffle degenerates to the identity and results are
 state-identical to the single-chip engines; tests pin identical

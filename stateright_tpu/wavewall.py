@@ -32,101 +32,25 @@ does, three ways, all runnable on CPU:
 Used by ``tools/profile_stages.py --wave-wall`` (prints the report
 next to the per-stage sums) and pinned on CPU by
 tests/test_wavewall.py.
+
+The opcode→category tables live in
+:mod:`stateright_tpu.analysis.tables` (round 7) — one table shared
+with the kernel-lint rules and the codegen-shape tests, so the
+profiler's attribution vocabulary and the lint's carry-movement
+pricing cannot drift. :func:`hlo_category` and
+:func:`parse_hlo_categories` stay importable from here.
 """
 
 from __future__ import annotations
 
-import re
 import time
 
 import numpy as np
 
-#: dtype byte widths for HLO shape strings.
-_DTYPE_BYTES = {
-    "pred": 1, "s8": 1, "u8": 1,
-    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
-    "s32": 4, "u32": 4, "f32": 4,
-    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
-    "c128": 16,
-}
-
-_SHAPE_RE = re.compile(r"\b([a-z]+\d*)\[([0-9,]*)\]")
-_INSTR_RE = re.compile(
-    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.*?)\s+"
-    r"([a-z][a-z0-9\-]*)\("
+from .analysis.tables import (  # noqa: F401 — the shared tables
+    hlo_category,
+    parse_hlo_categories,
 )
-
-
-def hlo_category(opcode: str) -> str:
-    """Map an HLO opcode to the trace-category vocabulary PERF.md's
-    round-5 analysis used. Copies/transposes/converts are XLA's
-    between-stage data formatting; pad is class-quantization padding;
-    slice/concat/dynamic-(update-)slice are carry and block movement;
-    fusion is the actual stage compute."""
-    if opcode in ("copy", "copy-start", "copy-done", "bitcast",
-                  "bitcast-convert", "transpose", "reshape", "convert"):
-        return "data formatting"
-    if opcode == "pad":
-        return "quantization padding"
-    if opcode in ("dynamic-update-slice",):
-        return "dynamic-update-slice"
-    if opcode in ("dynamic-slice", "slice", "concatenate"):
-        return "carry/slice movement"
-    if opcode == "sort":
-        return "sort"
-    if opcode in ("gather", "scatter"):
-        return opcode
-    if opcode == "fusion":
-        return "fusion"
-    if opcode in ("while", "conditional", "call", "tuple",
-                  "get-tuple-element", "parameter", "constant",
-                  "iota", "broadcast", "after-all", "partition-id",
-                  "replica-id"):
-        return "control"
-    if opcode in ("add", "subtract", "multiply", "divide", "remainder",
-                  "and", "or", "xor", "not", "negate", "compare",
-                  "select", "shift-left", "shift-right-logical",
-                  "shift-right-arithmetic", "popcnt", "clz",
-                  "maximum", "minimum", "abs", "sign", "clamp",
-                  "reduce", "reduce-window", "map", "exponential",
-                  "log", "power"):
-        # XLA:CPU leaves elementwise ALU unfused where the TPU trace
-        # shows loop fusions — same stage-compute category.
-        return "elementwise compute"
-    return "other"
-
-
-def _type_bytes(type_str: str) -> int:
-    """Output bytes of an HLO instruction's (possibly tuple) type."""
-    total = 0
-    for dt, dims in _SHAPE_RE.findall(type_str):
-        width = _DTYPE_BYTES.get(dt)
-        if width is None:
-            continue
-        n = 1
-        if dims:
-            for d in dims.split(","):
-                n *= int(d)
-        total += n * width
-    return total
-
-
-def parse_hlo_categories(hlo_text: str) -> dict:
-    """Per-category ``{"ops": count, "bytes": output_bytes}`` over
-    every instruction of an optimized-HLO dump (sub-computations —
-    fusion bodies, while bodies, branch computations — included; their
-    instructions are what the categories exist to attribute)."""
-    out: dict = {}
-    for line in hlo_text.splitlines():
-        m = _INSTR_RE.match(line)
-        if m is None:
-            continue
-        type_str, opcode = m.groups()
-        cat = hlo_category(opcode)
-        slot = out.setdefault(cat, {"ops": 0, "bytes": 0})
-        slot["ops"] += 1
-        slot["bytes"] += _type_bytes(type_str)
-    return out
 
 
 def _timed_loop(jit_fn, args) -> float:

@@ -1,0 +1,208 @@
+"""Property-based round-trips for the ops/bitmask.py word builders.
+
+The packed-word layout (slot ``k`` in word ``k // 32`` at bit
+``k % 32``, zero tail bits) is consumed by three independent parties —
+the engines' popcount/peel pipeline, the hand encodings' class-mask
+builders, and the compiled codegen's bit tables — so the builders are
+pinned against brute-force references over randomized inputs (seeded
+rng, many trials) rather than a handful of examples. ``K`` sweeps
+deliberately include ``k % 32 == 0`` (the no-partial-tail-word edge:
+``mask_words(64) == 2`` with every bit significant) alongside the
+straddle cases.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from stateright_tpu.ops.bitmask import (  # noqa: E402
+    bit_select,
+    mask_to_words,
+    mask_words,
+    or_class_words,
+    pack_bits_host,
+    popcount_words,
+    select_words_host,
+    slot_mask_host,
+    words_to_mask,
+)
+
+pytestmark = pytest.mark.lint
+
+#: k % 32 == 0 cases first (tail word exactly full), then straddles.
+KS = (32, 64, 96, 1, 17, 31, 33, 63, 65, 127, 200)
+
+
+def _ref_pack(flags):
+    words = [0] * max(1, (len(flags) + 31) // 32)
+    for i, f in enumerate(flags):
+        if f:
+            words[i // 32] |= 1 << (i % 32)
+    return tuple(words)
+
+
+@pytest.mark.parametrize("k", KS)
+def test_pack_bits_host_matches_reference_and_bit_select(k):
+    rng = np.random.default_rng(k)
+    for _ in range(20):
+        flags = (rng.random(k) < rng.random()).tolist()
+        words = pack_bits_host(flags)
+        assert words == _ref_pack(flags)
+        assert len(words) == max(1, mask_words(k))
+        # every bit reads back through the traced selector
+        idx = jnp.arange(k, dtype=jnp.uint32)
+        got = np.asarray(
+            jax.vmap(lambda i: bit_select(jnp, words, i))(idx)
+        )
+        assert (got == np.array(flags)).all()
+
+
+def test_pack_bits_host_empty():
+    assert pack_bits_host([]) == (0,)
+
+
+@pytest.mark.parametrize("k", KS)
+def test_mask_words_roundtrip_randomized(k):
+    """mask -> words -> mask is the identity; popcount matches; tail
+    bits beyond k are zero (words_to_mask would hide a dirty tail, so
+    check the words directly)."""
+    rng = np.random.default_rng(1000 + k)
+    L = mask_words(k)
+    for trial in range(20):
+        density = rng.random()
+        m = rng.random((7, k)) < density
+        words = np.asarray(mask_to_words(jnp, jnp.asarray(m)))
+        assert words.shape == (7, L)
+        back = np.asarray(
+            words_to_mask(jnp, jnp.asarray(words), k)
+        )
+        assert (back == m).all()
+        cnt = np.asarray(popcount_words(jnp, jnp.asarray(words)))
+        assert (cnt == m.sum(axis=1)).all()
+        # tail-word hygiene: bits at positions >= k must be zero —
+        # at k % 32 == 0 there ARE no tail bits (the edge case: every
+        # bit of the last word is significant).
+        tail_bits = L * 32 - k
+        if tail_bits:
+            assert (
+                words[:, -1] >> np.uint32(32 - tail_bits) == 0
+            ).all()
+        else:
+            # full last word must be reachable: force the top bit on
+            m2 = m.copy()
+            m2[:, k - 1] = True
+            w2 = np.asarray(mask_to_words(jnp, jnp.asarray(m2)))
+            assert (w2[:, -1] >> np.uint32(31) == 1).all()
+
+
+@pytest.mark.parametrize("k", KS)
+def test_slot_mask_host_is_indicator_pack(k):
+    rng = np.random.default_rng(2000 + k)
+    for _ in range(10):
+        n_slots = int(rng.integers(0, min(k, 12) + 1))
+        slots = sorted(
+            rng.choice(k, size=n_slots, replace=False).tolist()
+        )
+        flags = [i in set(slots) for i in range(k)]
+        assert slot_mask_host(k, slots) == _ref_pack(flags)
+    with pytest.raises(ValueError):
+        slot_mask_host(k, [k])
+    with pytest.raises(ValueError):
+        slot_mask_host(k, [-1])
+
+
+@pytest.mark.parametrize("k", KS)
+def test_or_class_words_matches_dense_or(k):
+    """or_class_words under random traced conditions equals the dense
+    OR reference, for host-tuple and array-valued classes alike —
+    including the L == 1 scalar-word fast path and all-zero class
+    dropping."""
+    rng = np.random.default_rng(3000 + k)
+    L = mask_words(k)
+    classes_host = [
+        sorted(
+            rng.choice(
+                k, size=int(rng.integers(0, min(k, 9) + 1)),
+                replace=False,
+            ).tolist()
+        )
+        for _ in range(5)
+    ] + [[]]  # the all-zero class must drop for free
+    masks = [slot_mask_host(k, cls) for cls in classes_host]
+
+    def build(conds):
+        return or_class_words(
+            jnp,
+            [(conds[i], masks[i]) for i in range(len(masks))],
+            L,
+        )
+
+    for _ in range(10):
+        conds = rng.random(len(masks)) < 0.5
+        got = np.asarray(jax.jit(build)(jnp.asarray(conds)))
+        assert got.shape == (L,)
+        want = np.zeros(L, np.uint64)
+        for on, m in zip(conds, masks):
+            if on:
+                want |= np.array(m, np.uint64)
+        assert (got == want.astype(np.uint32)).all()
+    # gather-free by construction
+    jx = jax.make_jaxpr(build)(jnp.zeros(len(masks), bool))
+    from stateright_tpu.analysis import is_gather, iter_eqns
+
+    assert not any(
+        is_gather(s.primitive) for s in iter_eqns(jx.jaxpr)
+    )
+
+
+@pytest.mark.parametrize("k", (32, 64, 17, 70))
+def test_select_words_host_matches_indexing(k):
+    rng = np.random.default_rng(4000 + k)
+    L = mask_words(k)
+    rows = [
+        slot_mask_host(
+            k,
+            sorted(
+                rng.choice(
+                    k, size=int(rng.integers(1, min(k, 8) + 1)),
+                    replace=False,
+                ).tolist()
+            ),
+        )
+        for _ in range(6)
+    ]
+
+    def sel(i):
+        return select_words_host(jnp, rows, i)
+
+    for v in range(len(rows)):
+        got = np.asarray(jax.jit(sel)(jnp.uint32(v)))
+        want = np.array(rows[v], np.uint32)
+        if L == 1:
+            # single-word rows select as scalars (const_words keeps
+            # vmapped guard math [N]-shaped)
+            assert got.shape == ()
+            assert got == want[0]
+        else:
+            assert (got == want).all()
+    # out-of-range picks rows[0] (the documented fallback)
+    got = np.asarray(jax.jit(sel)(jnp.uint32(len(rows) + 3)))
+    assert (
+        np.atleast_1d(got) == np.array(rows[0], np.uint32)
+    ).all()
+
+
+def test_words_roundtrip_through_engine_convention():
+    """words_to_mask(pack_bits_host(x)) == x for random x at the
+    k % 32 == 0 edge — the host-pack and device-unpack conventions
+    agree word for word."""
+    rng = np.random.default_rng(7)
+    for k in (32, 64, 96):
+        flags = (rng.random(k) < 0.5).tolist()
+        words = jnp.asarray(
+            np.array(pack_bits_host(flags), np.uint32)
+        )[None, :]
+        back = np.asarray(words_to_mask(jnp, words, k))[0]
+        assert (back == np.array(flags)).all()

@@ -22,6 +22,14 @@ same audit — table-row gathers by traced slot (the intended sparse
 idiom), ``[N, 1]`` slices from consuming multi-lane gather rows, and
 2-operand ``[N, 1]`` concats that build gather index pairs. Those
 fuse; ``[N, 1]`` COMPUTE and mask-path gathers do not.
+
+Round 7: the walk and the primitive tables moved to
+``stateright_tpu/analysis`` (walker.audit_jaxpr / tables.ALU_PRIMS) —
+one copy shared with the kernel-lint rules (``pytest -m lint``,
+tools/lint_kernels.py) and the wave-wall profiler's HLO attribution,
+so the three audits cannot drift. These tests keep the calibrated
+assertions; the lint runs the same tables as declarative rules over
+every registered encoding.
 """
 
 import numpy as np
@@ -32,8 +40,10 @@ import jax.numpy as jnp  # noqa: E402
 
 from stateright_tpu.actor import Network  # noqa: E402
 from stateright_tpu.actor.compile import compile_actor_model  # noqa: E402
+from stateright_tpu.analysis import audit_jaxpr  # noqa: E402
 from stateright_tpu.models.ping_pong import (  # noqa: E402
     PingPongCfg,
+    ping_pong_device_specs as ping_pong_specs,
     ping_pong_model,
 )
 from stateright_tpu.ops.bitmask import (  # noqa: E402
@@ -44,56 +54,10 @@ from stateright_tpu.ops.bitmask import (  # noqa: E402
     popcount_words,
     words_to_mask,
 )
-from test_actor_compile import ping_pong_specs  # noqa: E402
+
+pytestmark = pytest.mark.lint  # part of the kernel-lint tier-1 gate
 
 N = 64  # batch rows in every traced vmap
-
-#: elementwise/ALU primitives — a [N, 1] output from any of these is
-#: real compute at 128x lane padding, the PERF.md §ordered tax.
-_ALU = {
-    "add", "sub", "mul", "div", "rem", "and", "or", "xor",
-    "shift_left", "shift_right_logical", "shift_right_arithmetic",
-    "select_n", "eq", "ne", "lt", "le", "gt", "ge", "min", "max",
-    "population_count", "convert_element_type", "neg", "not",
-}
-
-
-def _audit(jaxpr):
-    """Walk every eqn (including sub-jaxprs): gather count, [N, 1] ALU
-    ops, [N, K]-or-wider bool outputs, and concatenates of ≥3 [N, 1]
-    operands (the stack-of-lane-scalars pattern)."""
-    stats = dict(gathers=0, alu_n1=[], wide_concat_n1=0, bool_nk=[])
-
-    def walk(jx, K):
-        for eq in jx.eqns:
-            name = eq.primitive.name
-            if "gather" in name:
-                stats["gathers"] += 1
-            if name == "concatenate":
-                n1_ops = sum(
-                    1 for v in eq.invars
-                    if getattr(v.aval, "shape", None) == (N, 1)
-                )
-                if n1_ops >= 3:
-                    stats["wide_concat_n1"] += 1
-            for v in eq.outvars:
-                sh = getattr(v.aval, "shape", None)
-                if sh == (N, 1) and name in _ALU:
-                    stats["alu_n1"].append(name)
-                if (
-                    sh == (N, K)
-                    and getattr(v.aval, "dtype", None) == np.bool_
-                ):
-                    stats["bool_nk"].append(name)
-            for p in eq.params.values():
-                if hasattr(p, "jaxpr"):
-                    walk(p.jaxpr, K)
-                if isinstance(p, (list, tuple)):
-                    for q in p:
-                        if hasattr(q, "jaxpr"):
-                            walk(q.jaxpr, K)
-
-    return stats, walk
 
 
 def _audit_enc(enc):
@@ -108,9 +72,7 @@ def _audit_enc(enc):
             jax.make_jaxpr(jax.vmap(enc.step_slot_vec))(vecs, slots),
         ),
     ):
-        stats, walk = _audit(jx)
-        walk(jx.jaxpr, enc.max_actions)
-        out[label] = stats
+        out[label] = audit_jaxpr(jx, n=N, k=enc.max_actions)
     return out
 
 
@@ -244,8 +206,10 @@ def _audit_engine_pair_pipeline(enc):
         sparse_pair_candidates,
     )
 
+    from stateright_tpu.analysis.lint import engine_pair_width
+
     K = enc.max_actions
-    EV = min(getattr(enc, "pair_width_hint", None) or K, K)
+    EV = engine_pair_width(enc)  # the lint traces the same pipeline
     assert EV < K, "audit needs a real sparse pair width"
 
     def pipe(frontier, fval):
@@ -259,9 +223,7 @@ def _audit_engine_pair_pipeline(enc):
         jnp.zeros((N, enc.width), jnp.uint32),
         jnp.zeros((N,), bool),
     )
-    stats, walk = _audit(jx)
-    walk(jx.jaxpr, K)
-    return stats
+    return audit_jaxpr(jx, n=N, k=K)
 
 
 def test_engine_path_no_dense_mask_hand_paxos():
@@ -329,6 +291,48 @@ def test_codegen_shapes_hand_encodings():
         assert a["step"]["gathers"] <= max_step_gathers, (
             type(enc).__name__, a["step"]["gathers"]
         )
+
+
+def test_codegen_shapes_hand_2pc_full_bar():
+    """The hand 2pc encoding meets the FULL compiled-codegen bar
+    (round 7: PR 2 landed its SparseEncodedModel interface but only
+    pinned the gather counts): its step path is pure slot arithmetic
+    — zero gathers, zero [N, 1] ALU, zero stack-of-scalars concats —
+    so any future 2pc edit that reaches for a per-slot table or a
+    lane-stacking concat fails here, not on a chip profile."""
+    from stateright_tpu.models.two_phase_commit_tpu import (
+        TwoPhaseSysEncoded,
+    )
+
+    a = _audit_enc(TwoPhaseSysEncoded(4))
+    assert a["step"]["gathers"] == 0, a["step"]["gather_sites"]
+    assert a["step"]["alu_n1"] == [], a["step"]["alu_n1_sites"]
+    assert a["step"]["wide_concat_n1"] == 0
+    assert a["bits"]["wide_concat_n1"] == 0
+    assert a["mask"]["bool_nk"] != [], (
+        "the mask path's dense bool[K] view is its contract — if this "
+        "disappears the audit is tracing the wrong function"
+    )
+
+
+def test_engine_path_no_dense_mask_hand_2pc():
+    """Round-7 calibration extension: the same engine-path audit the
+    paxos and compiled-ABD encodings are pinned by, for the hand 2pc
+    encoding (PR 2 gave it enabled_bits_vec; nothing pinned the
+    engine path it feeds). K=22 packs into a single uint32 word, so
+    this also covers the L=1 scalar-word lane of the shared
+    pipeline."""
+    from stateright_tpu.models.two_phase_commit_tpu import (
+        TwoPhaseSysEncoded,
+    )
+
+    enc = TwoPhaseSysEncoded(4)
+    s = _audit_engine_pair_pipeline(enc)
+    assert s["bool_nk"] == [], (
+        "dense [F, K] bool on the hand-2pc engine path",
+        s["bool_nk_sites"],
+    )
+    assert s["gathers"] == 0, s["gather_sites"]
 
 
 def test_bitmask_helpers_roundtrip():

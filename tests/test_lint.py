@@ -1,0 +1,344 @@
+"""The kernel-lint gate (``pytest -m lint``, round 7).
+
+Two halves:
+
+* the GATE — the full rule registry over every registered encoding ×
+  both sparse engine pipelines plus the wave-body fixture must come
+  back clean (the same run ``tools/lint_kernels.py`` exits 0 on);
+* the TEETH — deliberate regressions (re-densified enabled mask, a
+  mask-path table gather, ``[N, 1]`` lane math, a stepped-up gather
+  count, a branch that pads its class result to peak shape) must each
+  be caught by the NAMED rule with a source-attributed finding.
+
+The teeth tests are what make the gate trustworthy: a lint that
+passes clean code but misses the priced artifacts would let the next
+encoding refactor silently re-grow the 8x/82x taxes the rules pin.
+"""
+
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from stateright_tpu.analysis import (  # noqa: E402
+    ENCODINGS,
+    EncodingSpec,
+    RULES,
+    TraceCtx,
+    lint_encoding,
+    lint_wave_body,
+    run_lint,
+    run_rules,
+)
+from stateright_tpu.models.two_phase_commit_tpu import (  # noqa: E402
+    TwoPhaseSysEncoded,
+)
+
+pytestmark = pytest.mark.lint
+
+
+def _errors(findings):
+    return [f for f in findings if f.severity == "error"]
+
+
+def _spec(cls, max_step_gathers=0):
+    return EncodingSpec(
+        name=cls.__name__,
+        kind="hand",
+        factory=lambda: cls(4),
+        max_step_gathers=max_step_gathers,
+    )
+
+
+# -- the gate --------------------------------------------------------------
+
+def test_lint_clean_all_registered():
+    """Every registered encoding × both engine pipelines × the
+    wave-body fixture: zero error-severity findings. This is the
+    tier-1 codegen-contract gate."""
+    report = run_lint()
+    errors = [
+        f for f in report["findings"] if f["severity"] == "error"
+    ]
+    assert report["clean"], errors
+    # Coverage: every registered encoding traced on every path the
+    # contract names, for both engines, in BOTH pipeline shapes —
+    # the small-wave variant AND the production compaction/tiled-mask
+    # branches (review finding: the compaction path the bench lanes
+    # actually run was previously never audited).
+    covered = {(p["encoding"], p["path"]) for p in report["paths"]}
+    for spec in ENCODINGS:
+        for path in ("bits", "mask", "step",
+                     "engine:single", "engine:single+compact",
+                     "engine:sharded", "engine:sharded+compact"):
+            assert (spec.name, path) in covered, (spec.name, path)
+    assert any(p["path"] == "wave-body" for p in report["paths"])
+
+
+def test_lint_registry_names_all_rules():
+    names = {r.name for r in RULES}
+    assert names == {
+        "no-dense-mask", "no-mask-gather", "allowed-table-gather",
+        "no-lane-padded-alu", "no-branch-pad-concat",
+        "carry-copy-bytes",
+    }
+
+
+def test_wave_body_estimator_emits():
+    """The carry-copy-bytes estimator prices the class-ladder switch
+    on the wave-body fixture (informational — the number is the
+    static handle on ROADMAP's switch-carry-movement lever)."""
+    findings, stats = lint_wave_body()
+    assert not _errors(findings)
+    est = [f for f in findings if f.rule == "carry-copy-bytes"]
+    assert len(est) == 1
+    data = est[0].data
+    assert data["switches"] > 0
+    assert data["switch_carry_bytes"] > 0
+    assert est[0].source  # attributed to the engine source line
+
+
+# -- the teeth -------------------------------------------------------------
+
+class _DensifiedMask(TwoPhaseSysEncoded):
+    """Regression fixture: rebuilds the enabled words by materializing
+    the dense bool[K] validity row first (exactly the [F, K] pass the
+    82x ablation removed)."""
+
+    def enabled_bits_vec(self, vec):
+        from stateright_tpu.ops.bitmask import mask_to_words
+
+        _, valid = self.step_vec(vec)  # dense bool[K]
+        return mask_to_words(jnp, valid)
+
+
+class _GatherMask(TwoPhaseSysEncoded):
+    """Regression fixture: a per-state table gather on the mask path
+    (the compiled-codegen tax PR 1 removed)."""
+
+    def enabled_bits_vec(self, vec):
+        tbl = jnp.arange(8, dtype=jnp.uint32)
+        return super().enabled_bits_vec(vec) | tbl[vec[0] % 8][None]
+
+
+class _LanePaddedStep(TwoPhaseSysEncoded):
+    """Regression fixture: [1]-shaped word math on the step path —
+    [N, 1] ALU under vmap, the 128x tile-padding artifact."""
+
+    def step_slot_vec(self, vec, slot):
+        out = super().step_slot_vec(vec, slot)
+        bump = slot.reshape(1) & jnp.uint32(0)  # [1]-shaped `and`
+        return out.at[:1].set(out[:1] | bump)
+
+
+class _TableStep(TwoPhaseSysEncoded):
+    """Regression fixture: two per-slot table gathers on a step path
+    whose allowance is one."""
+
+    def step_slot_vec(self, vec, slot):
+        t1 = jnp.arange(32, dtype=jnp.uint32)
+        t2 = jnp.arange(64, dtype=jnp.uint32)
+        extra = (t1[slot % 32] & jnp.uint32(0)) | (
+            t2[slot % 64] & jnp.uint32(0)
+        )
+        return super().step_slot_vec(vec, slot) | extra
+
+
+def test_lint_catches_dense_mask_regression():
+    findings, _ = lint_encoding(
+        _spec(_DensifiedMask), engines=("single",)
+    )
+    hits = [
+        f for f in _errors(findings) if f.rule == "no-dense-mask"
+    ]
+    assert hits, _errors(findings)
+    # Source-attributed to the traced encoding line, not the walker.
+    assert any(
+        "two_phase_commit_tpu" in (f.source or "")
+        or "test_lint" in (f.source or "")
+        for f in hits
+    ), [f.source for f in hits]
+    # And it leaks into the engine pipeline audit too: the engine
+    # consumes the words, so the dense pass rides in.
+    assert any(f.path in ("bits", "engine:single") for f in hits)
+
+
+def test_lint_catches_mask_gather_regression():
+    findings, _ = lint_encoding(
+        _spec(_GatherMask), engines=("single",)
+    )
+    hits = [
+        f for f in _errors(findings) if f.rule == "no-mask-gather"
+    ]
+    assert hits, _errors(findings)
+    assert all(f.source for f in hits)
+
+
+def test_lint_catches_lane_padded_alu_regression():
+    findings, _ = lint_encoding(
+        _spec(_LanePaddedStep), engines=("single",)
+    )
+    hits = [
+        f
+        for f in _errors(findings)
+        if f.rule == "no-lane-padded-alu" and f.path == "step"
+    ]
+    assert hits, _errors(findings)
+
+
+def test_lint_catches_table_gather_overflow():
+    findings, _ = lint_encoding(
+        _spec(_TableStep, max_step_gathers=1), engines=("single",)
+    )
+    hits = [
+        f
+        for f in _errors(findings)
+        if f.rule == "allowed-table-gather"
+    ]
+    assert hits, _errors(findings)
+    assert hits[0].data["gathers"] > hits[0].data["allowance"]
+
+
+def test_lint_step_gather_at_zero_allowance_names_table_rule():
+    """A gather on a ZERO-allowance step path (hand 2pc: pure slot
+    arithmetic) reports under allowed-table-gather with the
+    table-row diagnosis — not under no-mask-gather with a mask-path
+    message (review finding: the wrong rule name sends the
+    maintainer to the wrong contract)."""
+    findings, _ = lint_encoding(
+        _spec(_TableStep, max_step_gathers=0), engines=("single",)
+    )
+    step_hits = [f for f in _errors(findings) if f.path == "step"]
+    rules = {f.rule for f in step_hits}
+    assert "allowed-table-gather" in rules, step_hits
+    assert "no-mask-gather" not in rules, step_hits
+
+
+def test_lint_catches_branch_pad_concat():
+    """The pre-round-6 carry pattern — a switch branch returning its
+    class result padded to peak shape — is caught in both forms
+    (concat-with-zeros and jnp.pad), while class-local
+    dynamic_update_slice branches pass."""
+    from jax import lax
+
+    F, W = 512, 4
+
+    def concat_form(i, carry, rows):
+        def br_good(c):
+            return dict(
+                c,
+                frontier=lax.dynamic_update_slice(
+                    c["frontier"], rows, (0, 0)
+                ),
+            )
+
+        def br_bad(c):
+            full = jnp.concatenate(
+                [rows * 2, jnp.zeros((F - 8, W), jnp.uint32)], axis=0
+            )
+            return dict(c, frontier=full)
+
+        return lax.switch(i, [br_good, br_bad], carry)
+
+    def pad_form(i, carry, rows):
+        def br(c):
+            return dict(c, frontier=jnp.pad(rows, ((0, F - 8), (0, 0))))
+
+        return lax.switch(i, [br, br], carry)
+
+    ctx = TraceCtx(
+        path="wave-body", encoding="synthetic", n=64, k=0,
+        sparse=False, allow_gathers=None, check_lane_alu=False,
+        check_branches=True,
+    )
+    carry = dict(frontier=jnp.zeros((F, W), jnp.uint32))
+    rows = jnp.ones((8, W), jnp.uint32)
+    for form, prim in ((concat_form, "concatenate"), (pad_form, "pad")):
+        jx = jax.make_jaxpr(form)(jnp.int32(0), carry, rows)
+        hits = [
+            f
+            for f in _errors(run_rules(ctx, jx))
+            if f.rule == "no-branch-pad-concat"
+        ]
+        assert hits and hits[0].primitive == prim, (form, hits)
+        assert "[1]" in hits[0].message or "cond" in hits[0].message
+
+
+def test_lint_catches_branch_pad_through_passthrough():
+    """The branch rule follows value-preserving unary ops: a padded
+    carry laundered through `.astype(...)`/reshape before the branch
+    return is still caught (review finding: a single convert between
+    the concat and the returned carry must not bypass the rule)."""
+    from jax import lax
+
+    F, W = 512, 4
+
+    def laundered(i, carry, rows):
+        def br(c):
+            full = jnp.concatenate(
+                [rows * 2, jnp.zeros((F - 8, W), jnp.int32)], axis=0
+            )
+            # convert + reshape between the rebuild and the return
+            return dict(
+                c,
+                frontier=full.astype(jnp.uint32).reshape(F, W),
+            )
+
+        return lax.switch(i, [br, br], carry)
+
+    ctx = TraceCtx(
+        path="wave-body", encoding="synthetic", n=64, k=0,
+        sparse=False, allow_gathers=None, check_lane_alu=False,
+        check_branches=True,
+    )
+    carry = dict(frontier=jnp.zeros((F, W), jnp.uint32))
+    jx = jax.make_jaxpr(laundered)(
+        jnp.int32(0), carry, jnp.ones((8, W), jnp.int32)
+    )
+    hits = [
+        f
+        for f in _errors(run_rules(ctx, jx))
+        if f.rule == "no-branch-pad-concat"
+    ]
+    assert hits, "passthrough chain hid the peak-shape rebuild"
+
+
+def test_lint_records_dense_rule_skip_when_ev_equals_k():
+    """When an encoding's pair width EV == K the engine-path
+    dense-mask rule cannot run (the [N, EV] pair grid is
+    shape-identical to the dense mask) — the report must record the
+    skip as an info finding, not a silent '0 errors' (review
+    finding: coverage claims must be honest). The registered
+    compiled ping-pong encoding is exactly this case."""
+    from stateright_tpu.analysis import get_encoding_spec
+    from stateright_tpu.analysis.lint import engine_pair_width
+
+    spec = get_encoding_spec("compiled-ping-pong-nondup")
+    enc = spec.factory()
+    assert engine_pair_width(enc) == enc.max_actions  # the edge
+    findings, _ = lint_encoding(spec, engines=("single",))
+    skips = [
+        f
+        for f in findings
+        if f.severity == "info"
+        and f.rule == "no-dense-mask"
+        and f.path == "engine:single"
+    ]
+    assert skips and "SKIPPED" in skips[0].message
+
+
+def test_lint_report_shape():
+    """The --json artifact contract: rules, paths, findings, clean."""
+    report = run_lint(
+        encodings=(_spec(_GatherMask),),
+        engines=("single",),
+        wave_body=False,
+    )
+    assert report["clean"] is False
+    assert {r["name"] for r in report["rules"]} == {
+        r.name for r in RULES
+    }
+    bad = [f for f in report["findings"] if f["severity"] == "error"]
+    assert bad and all(
+        {"rule", "encoding", "path", "message"} <= set(f) for f in bad
+    )

@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Kernel-lint CLI: pin the sparse-engine codegen contract, on CPU.
+
+Runs the static-analysis rule registry (stateright_tpu/analysis/) over
+every registered encoding (hand paxos, hand 2pc, compiled ABD ordered,
+compiled ping-pong) × both sparse engine pipelines (the single-chip
+and sharded invocations of ``sparse_pair_candidates``), plus the
+engine wave-body fixture for the branch-shape rule and the
+carry-copy-bytes estimator. Exit status 0 iff clean — the same gate
+``pytest -m lint`` runs in tier-1.
+
+Usage:
+  python tools/lint_kernels.py                # human report, exit != 0 on findings
+  python tools/lint_kernels.py --json         # also write LINT_r*.json
+  python tools/lint_kernels.py --json out.json
+  python tools/lint_kernels.py --encoding hand-2pc-rm4
+  python tools/lint_kernels.py --no-wave-body # skip the fixture trace
+  python tools/lint_kernels.py --hlo          # add compiled-HLO category
+                                              # pricing per engine path
+                                              # (slower: compiles on CPU)
+
+The ``--json`` artifact lands alongside the BENCH_r*.json artifacts
+(auto-numbered past the highest existing BENCH/LINT round) so a perf
+round can point at "lint clean at r07" the way it points at its bench
+lane.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _next_artifact_path(repo_root: str) -> str:
+    """LINT_rNN.json, numbered past every BENCH_r*/LINT_r* round so
+    the lint artifact slots into the same round sequence."""
+    best = 0
+    for pat in ("BENCH_r*.json", "LINT_r*.json"):
+        for p in glob.glob(os.path.join(repo_root, pat)):
+            m = re.search(r"_r(\d+)\.json$", p)
+            if m:
+                best = max(best, int(m.group(1)))
+    return os.path.join(repo_root, f"LINT_r{best + 1:02d}.json")
+
+
+def _hlo_pricing(encodings) -> dict:
+    """Optional --hlo pass: compile each encoding's engine pipeline on
+    the current backend and price the wall categories (the HLO-level
+    counterpart of the jaxpr carry-copy-bytes estimate), via the same
+    shared tables the wave-wall profiler reports with."""
+    import jax
+    import jax.numpy as jnp
+
+    from stateright_tpu.analysis import (
+        HLO_WALL_CATEGORIES,
+        parse_hlo_categories,
+    )
+    from stateright_tpu.analysis.lint import LINT_N, engine_pipe_params
+    from stateright_tpu.checkers.tpu_sortmerge import (
+        sparse_pair_candidates,
+    )
+
+    out = {}
+    n = LINT_N
+    for spec in encodings:
+        enc = spec.factory()
+        # the SAME invocation recipes the jaxpr rules audited
+        # (engine_pipe_params, BOTH pipeline shapes) — the --hlo pass
+        # must price the programs the lint traced, not a private
+        # variant.
+        for compact in (False, True):
+            params = engine_pipe_params(enc, n, compact)
+
+            def pipe(frontier, fval):
+                return sparse_pair_candidates(
+                    enc, frontier, fval, jnp.bool_(True), **params
+                )
+
+            hlo = (
+                jax.jit(pipe)
+                .lower(
+                    jnp.zeros((n, enc.width), jnp.uint32),
+                    jnp.zeros((n,), bool),
+                )
+                .compile()
+                .as_text()
+            )
+            cats = parse_hlo_categories(hlo)
+            wall = sum(
+                s["bytes"] for c, s in cats.items()
+                if c in HLO_WALL_CATEGORIES
+            )
+            key = spec.name + ("+compact" if compact else "")
+            out[key] = {
+                "categories": cats,
+                "wall_bytes": wall,
+            }
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="static kernel-lint over the sparse-engine "
+        "codegen contract"
+    )
+    ap.add_argument(
+        "--json", nargs="?", const="auto", default=None,
+        metavar="PATH",
+        help="write the report as JSON (default: auto-numbered "
+        "LINT_r*.json in the repo root)",
+    )
+    ap.add_argument(
+        "--encoding", action="append", default=None,
+        help="lint only this registered encoding (repeatable)",
+    )
+    ap.add_argument(
+        "--engines", default="single,sharded",
+        help="comma-separated engine pipelines (default both)",
+    )
+    ap.add_argument(
+        "--no-wave-body", action="store_true",
+        help="skip the engine wave-body fixture trace",
+    )
+    ap.add_argument(
+        "--hlo", action="store_true",
+        help="also compile each engine pipeline and price the HLO "
+        "wall categories (slower)",
+    )
+    args = ap.parse_args()
+
+    from stateright_tpu.analysis import (
+        ENCODINGS,
+        format_report,
+        get_encoding_spec,
+        run_lint,
+    )
+
+    if args.encoding:
+        specs = tuple(get_encoding_spec(n) for n in args.encoding)
+    else:
+        specs = ENCODINGS
+
+    report = run_lint(
+        encodings=specs,
+        engines=tuple(args.engines.split(",")),
+        wave_body=not args.no_wave_body,
+    )
+    if args.hlo:
+        report["hlo"] = _hlo_pricing(specs)
+
+    print(format_report(report))
+    if args.hlo:
+        print("hlo wall-category bytes (engine pipeline, compiled):")
+        for name, h in report["hlo"].items():
+            print(f"  {name:36s} {h['wall_bytes'] / 1e6:9.2f} MB")
+
+    if args.json is not None:
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        path = (
+            _next_artifact_path(repo_root)
+            if args.json == "auto"
+            else args.json
+        )
+        with open(path, "w") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {path}")
+
+    sys.exit(0 if report["clean"] else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -181,7 +181,9 @@ def stage_profile(kind, n, caps, target):
     results["props(frontier)"] = _timed(s_props, (frontier_f, acc0))
 
     # -- stage: enabled mask only (the [F,K] predicate pass) ------------
-    L = (K + 31) // 32
+    from stateright_tpu.ops.bitmask import mask_words
+
+    L = mask_words(K)
     mb = c.mask_budget_cells
 
     def mask_only(fr):

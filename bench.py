@@ -455,6 +455,15 @@ def main():
     ap.add_argument("--quick", action="store_true", help="skip the largest workload")
     ap.add_argument("--verbose", action="store_true", help="per-run wave metrics")
     ap.add_argument("--runs", type=int, default=2)
+    ap.add_argument(
+        "--trace", nargs="?", const="default",
+        choices=("default", "deep"), default=None,
+        help="record run telemetry for the HEADLINE workload's timed "
+        "runs (stateright_tpu/telemetry.py) and write auto-numbered "
+        "TRACE_r*.jsonl + TRACE_r*.trace.json artifacts; 'deep' adds "
+        "per-wave syncs (real per-wave walls, so do not read the "
+        "headline states/sec off a deep-traced run)",
+    )
     args = ap.parse_args()
 
     import jax
@@ -478,12 +487,33 @@ def main():
 
     host_sps = bench_host_oracle()
 
+    tracer = None
+    if args.trace is not None:
+        from stateright_tpu.telemetry import RunTracer
+
+        tracer = RunTracer(level=args.trace)
+
     detail = {}
     headline_name, headline_sps = None, 0.0
-    for name, spawn, hybrid_spawn, expected in tpu_workloads(
-        quick=args.quick
-    ):
-        checker, sec = time_checker(spawn, runs=args.runs)
+    loads = tpu_workloads(quick=args.quick)
+    for i, (name, spawn, hybrid_spawn, expected) in enumerate(loads):
+        if tracer is not None and i == len(loads) - 1:
+            # Trace the headline lane's timed runs (warm run last, so
+            # trace_diff's default last-run view reads the warm one).
+            # Artifacts land in a finally: a failed/interrupted run's
+            # partial trace is the one needed for diagnosis.
+            from stateright_tpu.telemetry import write_artifacts
+
+            try:
+                with tracer.activate():
+                    checker, sec = time_checker(spawn, runs=args.runs)
+            finally:
+                if tracer.events:
+                    jsonl, chrome = write_artifacts(tracer)
+                    detail["trace_artifacts"] = [jsonl, chrome]
+                    _stderr(f"trace: wrote {jsonl} + {chrome}")
+        else:
+            checker, sec = time_checker(spawn, runs=args.runs)
         unique = checker.unique_state_count()
         if unique != expected:
             _stderr(f"ERROR {name}: unique={unique} != expected {expected}")
@@ -533,6 +563,12 @@ def main():
     if not args.quick:
         detail["ttfc"] = bench_ttfc(runs=args.runs)
 
+    # Provenance block (stateright_tpu/artifacts.py): the BENCH
+    # artifact the driver captures from this line must name the
+    # toolchain/device/SHA it was measured under — a states/sec with
+    # no context is not comparable across rounds.
+    from stateright_tpu.artifacts import provenance
+
     print(
         json.dumps(
             {
@@ -541,6 +577,7 @@ def main():
                 "unit": "states/sec",
                 "vs_baseline": round(headline_sps / host_sps, 2),
                 "sync_floor_ms": sync_floor_ms,
+                "provenance": provenance(lane={"headline": headline_name}),
                 "detail": detail,
             }
         )

@@ -1,0 +1,116 @@
+"""Run-artifact conventions shared by every tool that writes one.
+
+The repo root accumulates numbered round artifacts — ``BENCH_r*.json``
+(driver-captured bench output), ``LINT_r*.json`` (kernel-lint,
+tools/lint_kernels.py), ``MULTICHIP_r*.json`` (sharded dryrun), and
+``TRACE_r*.jsonl`` / ``TRACE_r*.trace.json`` (run telemetry,
+stateright_tpu/telemetry.py). They form ONE round sequence: a perf
+round points at "lint clean at r07, trace at r07" the way it points at
+its bench lane, so every writer numbers past the highest round of ANY
+family. This module is the single home for that numbering (the lint
+CLI and the trace exporter used to risk growing private copies) and
+for the provenance block every artifact embeds — the "number with no
+context" fix: a count or a wall time is only comparable across rounds
+when the artifact names the jax/jaxlib versions, device, platform,
+git SHA, and lane config it was measured under.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import subprocess
+import sys
+
+#: every artifact family that participates in the shared round
+#: numbering (stem of ``<STEM>_rNN.<ext>``).
+ARTIFACT_STEMS = ("BENCH", "LINT", "MULTICHIP", "TRACE")
+
+
+def repo_root() -> str:
+    """The repo root this package sits in (artifacts land beside
+    ROADMAP.md / BENCH_r*.json)."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def next_round(root: str | None = None,
+               stems: tuple = ARTIFACT_STEMS) -> int:
+    """The next free round number: one past the highest ``_rNN`` of
+    any listed artifact family (any extension)."""
+    root = repo_root() if root is None else root
+    best = 0
+    for stem in stems:
+        for p in glob.glob(os.path.join(root, f"{stem}_r*.*")):
+            m = re.search(r"_r(\d+)\.", os.path.basename(p))
+            if m:
+                best = max(best, int(m.group(1)))
+    return best + 1
+
+
+def artifact_path(stem: str, ext: str = "json",
+                  root: str | None = None,
+                  round: int | None = None) -> str:
+    """``<root>/<stem>_rNN.<ext>``, auto-numbered unless ``round`` is
+    pinned (pin it to write a multi-file artifact pair — e.g. the
+    trace exporter's ``TRACE_rNN.jsonl`` + ``TRACE_rNN.trace.json`` —
+    into one round)."""
+    root = repo_root() if root is None else root
+    if round is None:
+        round = next_round(root)
+    return os.path.join(root, f"{stem}_r{round:02d}.{ext}")
+
+
+def _git_sha(root: str) -> str | None:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=root, capture_output=True, text=True, timeout=10,
+        )
+        if out.returncode == 0:
+            return out.stdout.strip()
+    except (OSError, subprocess.SubprocessError):
+        pass
+    return None
+
+
+def provenance(lane: dict | None = None) -> dict:
+    """The context block embedded in every artifact: toolchain
+    versions, the device the numbers were measured on, the git SHA of
+    the code that produced them, and the exact lane config. Best
+    effort — a field the environment can't answer is None, never a
+    raise (artifacts must still be writable from a stripped
+    container)."""
+    out: dict = {
+        "python": sys.version.split()[0],
+        "jax": None,
+        "jaxlib": None,
+        "backend": None,
+        "device_kind": None,
+        "device_count": None,
+        "platform_version": None,
+        "git_sha": _git_sha(repo_root()),
+    }
+    try:
+        import jax
+
+        out["jax"] = jax.__version__
+        try:
+            import jaxlib
+
+            out["jaxlib"] = jaxlib.__version__
+        except (ImportError, AttributeError):
+            pass
+        devices = jax.devices()
+        out["backend"] = jax.default_backend()
+        out["device_kind"] = devices[0].device_kind if devices else None
+        out["device_count"] = len(devices)
+        try:
+            out["platform_version"] = devices[0].client.platform_version
+        except (AttributeError, IndexError):
+            pass
+    except Exception:  # jax not importable / no backend: still usable
+        pass
+    if lane is not None:
+        out["lane"] = lane
+    return out

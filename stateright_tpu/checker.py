@@ -203,6 +203,15 @@ class Checker:
             if self._run_error is not None:
                 raise self._run_error
             return
+        # Run telemetry (stateright_tpu/telemetry.py): when a tracer
+        # is active, every engine's execution is bracketed by
+        # run_begin/run_end events here — the one place all engines
+        # pass through — so host and device checkers trace alike.
+        from . import telemetry
+
+        tracer = telemetry.current_tracer()
+        if tracer is not None:
+            tracer.begin_run(lane=self._lane_config())
         self._started_at = time.monotonic()
         try:
             self._run(reporter)
@@ -216,9 +225,35 @@ class Checker:
             self._finished_at = time.monotonic()
             self._done = True
             self._run_error = exc
+            if tracer is not None:
+                tracer.end_run(
+                    error=f"{type(exc).__name__}: {exc}",
+                    **self._run_stats(),
+                )
             raise
         self._finished_at = time.monotonic()
         self._done = True
+        if tracer is not None:
+            tracer.end_run(error=None, **self._run_stats())
+
+    def _lane_config(self) -> dict:
+        """The run's lane description, embedded in the trace
+        run_begin event (engines extend with shapes/budgets)."""
+        return dict(
+            engine=type(self).__name__,
+            model=type(self.model).__name__,
+            target_state_count=self.builder._target_state_count,
+            target_max_depth=self.builder._target_max_depth,
+        )
+
+    def _run_stats(self) -> dict:
+        """The run's outcome summary for the trace run_end event."""
+        return dict(
+            total_states=self._total_states,
+            unique_states=self._unique_states,
+            max_depth=self._max_depth,
+            duration_sec=round(self.duration_sec(), 6),
+        )
 
     # -- status (checker.rs:287-314) -------------------------------------
 

@@ -53,12 +53,21 @@ class BfsChecker(ParentTraceMixin, Checker):
         self.generated: dict[int, Optional[int]] = {}
 
     def _run(self, reporter: Optional[Reporter] = None) -> None:
+        from .. import telemetry
+
         model = self.model
         props = list(model.properties())
         ebits_init = self._eventually_bits_init()
         visitor = self.builder._visitor
         target_states = self.builder._target_state_count
         target_depth = self.builder._target_max_depth
+        # Host-phase telemetry: property evaluation runs once per
+        # popped state, so it accumulates into ONE phase_total event
+        # instead of an event per state (telemetry.phase_acc); the
+        # shared no-op keeps the untraced loop cost-free.
+        tracer = telemetry.current_tracer()
+        prop_acc = (tracer.phase_acc("property_check") if tracer
+                    else telemetry._NULL_SPAN)
 
         pending: deque[tuple[object, int, int, int]] = deque()
         for init in model.init_states():
@@ -86,16 +95,23 @@ class BfsChecker(ParentTraceMixin, Checker):
                 )
 
             # Property evaluation on the popped state (bfs.rs:223-268).
-            for i, prop in enumerate(props):
-                if prop.expectation == Expectation.ALWAYS:
-                    if not prop.condition(model, state):
-                        self._discover(prop.name, fp)
-                elif prop.expectation == Expectation.SOMETIMES:
-                    if prop.condition(model, state):
-                        self._discover(prop.name, fp)
-                else:  # EVENTUALLY
-                    if ebits & (1 << i) and prop.condition(model, state):
-                        ebits &= ~(1 << i)
+            # Discoveries are RECORDED after the timed block: _discover
+            # reconstructs the counterexample path under its own span,
+            # which must not also count into property_check.
+            hit = []
+            with prop_acc:
+                for i, prop in enumerate(props):
+                    if prop.expectation == Expectation.ALWAYS:
+                        if not prop.condition(model, state):
+                            hit.append(prop.name)
+                    elif prop.expectation == Expectation.SOMETIMES:
+                        if prop.condition(model, state):
+                            hit.append(prop.name)
+                    else:  # EVENTUALLY
+                        if ebits & (1 << i) and prop.condition(model, state):
+                            ebits &= ~(1 << i)
+            for name in hit:
+                self._discover(name, fp)
 
             if self._all_discovered():
                 break

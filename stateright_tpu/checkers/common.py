@@ -32,6 +32,10 @@ class ParentTraceMixin:
 
     def _discover(self, name: str, fp: int) -> None:
         if name not in self._discoveries:
-            self._discoveries[name] = Path.from_fingerprints(
-                self.model, self._reconstruct_fps(fp)
-            )
+            from .. import telemetry
+
+            with telemetry.span("counterexample_reconstruction",
+                                property=name):
+                self._discoveries[name] = Path.from_fingerprints(
+                    self.model, self._reconstruct_fps(fp)
+                )

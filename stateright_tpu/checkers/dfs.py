@@ -34,9 +34,17 @@ class DfsChecker(Checker):
 
     def _discover(self, name: str, trace: tuple[int, ...]) -> None:
         if name not in self._discoveries:
-            self._discoveries[name] = Path.from_fingerprints(self.model, list(trace))
+            from .. import telemetry
+
+            with telemetry.span("counterexample_reconstruction",
+                                property=name):
+                self._discoveries[name] = Path.from_fingerprints(
+                    self.model, list(trace)
+                )
 
     def _run(self, reporter: Optional[Reporter] = None) -> None:
+        from .. import telemetry
+
         model = self.model
         props = list(model.properties())
         ebits_init = self._eventually_bits_init()
@@ -44,11 +52,23 @@ class DfsChecker(Checker):
         symmetry = self.builder._symmetry
         target_states = self.builder._target_state_count
         target_depth = self.builder._target_max_depth
+        # Host-phase telemetry: per-state costs accumulate into one
+        # phase_total event apiece (telemetry.phase_acc); the shared
+        # no-op keeps the untraced loop cost-free.
+        tracer = telemetry.current_tracer()
+        prop_acc = (tracer.phase_acc("property_check") if tracer
+                    else telemetry._NULL_SPAN)
+        sym_acc = (
+            tracer.phase_acc("symmetry_canonicalization")
+            if tracer is not None and symmetry is not None
+            else telemetry._NULL_SPAN
+        )
 
         def visited_key(state, fp: int) -> int:
             if symmetry is None:
                 return fp
-            return fingerprint(symmetry(state))
+            with sym_acc:
+                return fingerprint(symmetry(state))
 
         pending: list[tuple[object, tuple[int, ...], int]] = []
         for init in model.init_states():
@@ -75,16 +95,23 @@ class DfsChecker(Checker):
             if visitor is not None:
                 visitor.visit(model, Path.from_fingerprints(model, list(trace)))
 
-            for i, prop in enumerate(props):
-                if prop.expectation == Expectation.ALWAYS:
-                    if not prop.condition(model, state):
-                        self._discover(prop.name, trace)
-                elif prop.expectation == Expectation.SOMETIMES:
-                    if prop.condition(model, state):
-                        self._discover(prop.name, trace)
-                else:  # EVENTUALLY
-                    if ebits & (1 << i) and prop.condition(model, state):
-                        ebits &= ~(1 << i)
+            # Discoveries are RECORDED after the timed block: _discover
+            # reconstructs the counterexample path under its own span,
+            # which must not also count into property_check.
+            hit = []
+            with prop_acc:
+                for i, prop in enumerate(props):
+                    if prop.expectation == Expectation.ALWAYS:
+                        if not prop.condition(model, state):
+                            hit.append(prop.name)
+                    elif prop.expectation == Expectation.SOMETIMES:
+                        if prop.condition(model, state):
+                            hit.append(prop.name)
+                    else:  # EVENTUALLY
+                        if ebits & (1 << i) and prop.condition(model, state):
+                            ebits &= ~(1 << i)
+            for name in hit:
+                self._discover(name, trace)
 
             if self._all_discovered():
                 break

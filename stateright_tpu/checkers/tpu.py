@@ -39,6 +39,7 @@ inside ``shard_map``.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Optional
 
 import numpy as np
@@ -316,6 +317,9 @@ class TpuBfsChecker(Checker):
         #: per-run wave metrics for observability (SURVEY §5): updated
         #: at each host sync point.
         self.metrics: dict[str, float] = {}
+        #: the active RunTracer (telemetry.py), resolved at _run time;
+        #: engine variants gate their device wave log on it.
+        self._tracer = None
 
     # -- results ----------------------------------------------------------
 
@@ -629,6 +633,27 @@ class TpuBfsChecker(Checker):
     def _run(self, reporter: Optional[Reporter] = None) -> None:
         import jax.numpy as jnp
 
+        from .. import telemetry
+
+        # Telemetry attach (stateright_tpu/telemetry.py): resolved
+        # ONCE per run, BEFORE program build — engine variants gate
+        # their device-side wave log (and its cache key) on it. At
+        # level="deep" the engine takes the extra syncs the default
+        # path refuses: one wave per chunk, so every wave gets a real
+        # wall time and a device/fetch split (counts are invariant to
+        # waves_per_sync — it only sets the sync cadence).
+        tracer = telemetry.current_tracer()
+        self._tracer = tracer
+        if (tracer is not None and tracer.level == "deep"
+                and self.waves_per_sync != 1):
+            tracer.event(
+                "deep_sync_override",
+                waves_per_sync_old=self.waves_per_sync,
+                waves_per_sync=1,
+            )
+            self.waves_per_sync = 1
+            self._programs = None
+
         enc = self.encoded
         props = list(self.model.properties())
         n_props = len(props)
@@ -663,17 +688,54 @@ class TpuBfsChecker(Checker):
             self.cancelled = True
             return
         if self._programs is None:
-            self._programs = self._lookup_programs(n0)
+            with telemetry.span("compile", engine=type(self).__name__):
+                self._programs = self._lookup_programs(n0)
         seed_fn, chunk_fn = self._programs
 
-        carry = seed_fn(jnp.asarray(init))  # the run's one upload
+        with telemetry.span("seed_upload"):
+            carry = seed_fn(jnp.asarray(init))  # the run's one upload
 
+        chunk_idx = 0
+        prev_waves = 0
+        deep = tracer is not None and tracer.level == "deep"
         while True:
             if self.cancel_event is not None and self.cancel_event.is_set():
                 self.cancelled = True
                 return
+            t0 = time.monotonic()
             carry, stats = chunk_fn(carry)
+            t_disp = time.monotonic()  # async dispatch returns here
+            t_dev = t_disp
+            dev_sec = None
+            if deep:
+                # The deep level's extra sync: block on the carry so
+                # the device compute and the stats fetch split apart.
+                import jax
+
+                jax.block_until_ready(carry)
+                t_dev = time.monotonic()
+                dev_sec = t_dev - t_disp
             s = np.asarray(stats)  # the chunk's one readback
+            t1 = time.monotonic()
+            if tracer is not None:
+                waves_now = int(s[4])
+                n_waves = waves_now - prev_waves
+                rows = self._wave_log_rows(s, n_props)
+                tracer.record_chunk(
+                    chunk=chunk_idx,
+                    wave0=prev_waves,
+                    t0=t0,
+                    t1=t1,
+                    dispatch_sec=t_disp - t0,
+                    device_sec=dev_sec,
+                    fetch_sec=t1 - t_dev,
+                    n_waves=n_waves,
+                    wave_rows=(None if rows is None
+                               else rows[:n_waves]),
+                    pairs_valid=self._wave_log_pairs_valid(),
+                )
+                prev_waves = waves_now
+                chunk_idx += 1
             done = bool(s[0])
             self._total_states = int(s[6]) | (int(s[7]) << 32)
             self._unique_states = int(s[8])
@@ -822,6 +884,33 @@ class TpuBfsChecker(Checker):
         """Hook for engine variants that append metric lanes after the
         per-property discovery lanes (see parallel/engine.py)."""
 
+    def _wave_log_rows(self, s: np.ndarray, n_props: int):
+        """Hook: the device wave-log rows out of a chunk's packed
+        stats ([waves_per_sync, telemetry.WAVE_LOG_LANES] int array),
+        or None when this engine keeps no per-wave log (the hash-table
+        engine — its chunks still produce chunk/span events)."""
+        return None
+
+    def _wave_log_pairs_valid(self) -> bool:
+        """Hook: whether wave-log lane 1 really is the enabled-pair
+        popcount (False on engines that can't see it from the log
+        wrapper; the tracer then records ``enabled_pairs: null``)."""
+        return True
+
+    def _lane_config(self) -> dict:
+        lane = super()._lane_config()
+        lane.update(
+            encoding=type(self.encoded).__name__,
+            width=self.encoded.width,
+            max_actions=self.encoded.max_actions,
+            capacity=self.capacity,
+            frontier_capacity=self.frontier_capacity,
+            cand_capacity=self.cand_capacity,
+            waves_per_sync=self.waves_per_sync,
+            track_paths=self.track_paths,
+        )
+        return lane
+
     def _capture_final(self, carry) -> None:
         """Stash device handles needed for lazy path reconstruction."""
         self._final_tables = (
@@ -888,6 +977,13 @@ class TpuBfsChecker(Checker):
         """Walk the parent forest, then replay the HOST model matching
         device fingerprints of encoded successors (bfs.rs:371-400 +
         path.rs:20-97, with the encoder as the bridge)."""
+        from .. import telemetry
+
+        with telemetry.span("counterexample_reconstruction",
+                            fingerprint=f"{fp:#x}"):
+            return self._reconstruct_inner(fp)
+
+    def _reconstruct_inner(self, fp: int) -> Path:
         generated = self._build_generated()
         fps = [fp]
         while True:

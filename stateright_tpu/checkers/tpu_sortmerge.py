@@ -497,6 +497,10 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     # run, so one resize suffices for pair_width —
                     # and a pure pair_width overflow must NOT also
                     # inflate (and persist) the candidate budget.
+                    self._note_budget_growth(
+                        "pair_width", self._pair_width(), int(rowen),
+                        _attempt,
+                    )
                     self.pair_width = int(rowen)
                     continue
                 # The observed peak only covers waves BEFORE the
@@ -504,14 +508,42 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 # converged budget still ends within ~4x of the true
                 # peak and one clean re-run records the exact value.
                 peak = self.metrics.get("max_wave_candidates", 0)
-                self.cand_capacity = max(
+                grown = max(
                     int(peak * 1.15) + 1024,
                     4 * (self.cand_capacity or 1),
                 )
+                self._note_budget_growth(
+                    "cand_capacity", self.cand_capacity, grown,
+                    _attempt,
+                )
+                self.cand_capacity = grown
         raise RuntimeError(
             "auto budget did not converge in 6 attempts; last overflow: "
             f"{last_exc}"
         ) from last_exc
+
+    def _note_budget_growth(self, kind: str, old, new,
+                            attempt: int) -> None:
+        """The geometric capacity ladder used to retry SILENTLY: a
+        run that overflowed and re-ran 3x read as 'slow', not
+        'mis-budgeted'. Every resize now lands as a one-line warning
+        naming the old/new capacity plus a telemetry event (when a
+        tracer is active) so the retry shows up in TRACE artifacts."""
+        import warnings
+
+        from .. import telemetry
+
+        warnings.warn(
+            f"auto-budget: {kind} {old} -> {new} after a buffer "
+            f"overflow (retry {attempt + 1}); the resized wave "
+            "programs recompile at the new shapes",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+        telemetry.emit(
+            "auto_budget_retry", kind=kind, old=old, new=new,
+            attempt=attempt + 1,
+        )
 
     def _reset_for_retry(self) -> None:
         """Discard one failed attempt's partial results so the resized
@@ -550,7 +582,46 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             self._use_sparse(),
             self._pair_width(),
             self.mask_budget_cells,
+            # traced runs carry the wave log: a different program.
+            self._wave_log_enabled(),
         )
+
+    # -- telemetry (stateright_tpu/telemetry.py) ---------------------------
+
+    def _wave_log_enabled(self) -> bool:
+        """Whether the chunk carry includes the per-wave trace log.
+        Resolved from the tracer tpu.py's ``_run`` attached before
+        program build, so the flag, the compiled program, and the
+        stats parser can't disagree."""
+        return self._tracer is not None
+
+    def _wave_log_rows(self, s: np.ndarray, n_props: int):
+        if not self._wave_log_enabled():
+            return None
+        from ..telemetry import WAVE_LOG_LANES as WL
+
+        off = 11 + 3 * n_props + 3  # scalars + discovery + peak lanes
+        return s[off:off + self.waves_per_sync * WL].reshape(
+            self.waves_per_sync, WL
+        )
+
+    def _lane_config(self) -> dict:
+        lane = super()._lane_config()
+        lane.update(
+            sparse=self._use_sparse(),
+            pair_width=(self._pair_width() if self._use_sparse()
+                        else None),
+            auto_budget=self.auto_budget,
+            tiles=self.tiles,
+            tile_rows=self.tile_rows,
+            f_min=self.f_min,
+            v_min=self.v_min,
+            ladder_step=self.ladder_step,
+            v_ladder_step=self.v_ladder_step,
+            flat_budget_bytes=self.flat_budget_bytes,
+            mask_budget_cells=self.mask_budget_cells,
+        )
+        return lane
 
     def _maybe_warn_occupancy(self, occupancy: float) -> None:
         """No probe pressure: the sorted array works at 100% occupancy
@@ -604,6 +675,15 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         waves_per_sync = self.waves_per_sync
         ebits_init = self._eventually_bits_init()
         track_paths = self.track_paths
+        # Per-wave trace log (telemetry.py): when a tracer is active
+        # the carry gains a small uint32[waves_per_sync, WL] log the
+        # wave body appends one row to, downloaded WITH the packed
+        # stats — one readback per chunk, async dispatch preserved.
+        # Gated (and cache-keyed, _cache_extras) so untraced runs
+        # compile the exact programs they always did.
+        from ..telemetry import WAVE_LOG_LANES as WL
+
+        trace_log = self._wave_log_enabled()
         # Parent log rows: every unique state (≤ C) gets one entry;
         # the F-row block write at a dynamic offset needs headroom.
         L = C + F if track_paths else 0
@@ -648,8 +728,17 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             )
             fval = jnp.arange(F) < n0
             ebits = jnp.where(fval, jnp.uint32(ebits_init), jnp.uint32(0))
+            extra = (
+                dict(
+                    wlog=jnp.zeros((waves_per_sync, WL), jnp.uint32),
+                    wv_pairs=jnp.uint32(0),
+                )
+                if trace_log
+                else {}
+            )
             return dict(
                 v_lo=v_lo,
+                **extra,
                 v_hi=v_hi,
                 pl_child_lo=jnp.zeros(L, jnp.uint32),
                 pl_child_hi=jnp.zeros(L, jnp.uint32),
@@ -708,7 +797,8 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
 
         def make_merge(c, vc, B_eff, ck_lo, ck_hi, fetch, n_cand,
                        disc_found, disc_lo, disc_hi, c_overflow,
-                       e_overflow, max_tile_cand, max_rowen=None):
+                       e_overflow, max_tile_cand, max_rowen=None,
+                       wv_pairs=None):
             """The merge stage for visited-prefix class vc: one stable
             3-lane merge sort (visited-first ⇒ first-of-run wins and
             intra-wave duplicates resolve for free), a 1-lane
@@ -898,7 +988,20 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     & ~c_overflow
                     & ~e_overflow
                 )
+                trace_extra = {}
+                if trace_log:
+                    # The wave log rides the carry untouched here; the
+                    # body wrapper writes this wave's row after the
+                    # switch returns. wv_pairs surfaces the wave's
+                    # enabled-pair popcount (sparse) / candidate count
+                    # (dense) to that wrapper.
+                    trace_extra = dict(
+                        wlog=c["wlog"],
+                        wv_pairs=(n_cand if wv_pairs is None
+                                  else wv_pairs).astype(jnp.uint32),
+                    )
                 return dict(
+                    **trace_extra,
                     v_lo=v_lo_new,
                     v_hi=v_hi_new,
                     pl_child_lo=pl_child_lo,
@@ -1476,6 +1579,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                             c_overflow, e_overflow,
                             jnp.maximum(c["max_tile_cand"], tile_max),
                             jnp.maximum(c["max_rowen"], jnp.max(cnt)),
+                            wv_pairs=n_pairs,
                         )
                         for vc in range(len(v_ladder))
                     ],
@@ -1494,11 +1598,38 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             for V_i in v_ladder[:-1]:
                 v_class = v_class + (u > jnp.uint32(V_i)).astype(jnp.int32)
             mk = make_sparse_wave if use_sparse else make_wave
-            return lax.switch(
+            c2 = lax.switch(
                 f_class,
                 [mk(fc, v_class) for fc in range(len(f_ladder))],
                 c,
             )
+            if trace_log:
+                # One wave-log row (telemetry.WAVE_LOG_FIELDS): the
+                # pre/post carry delta gives candidates (gen counter)
+                # and new states; wv_pairs carries the enabled
+                # popcount out of the merge. Row index = wchunk (the
+                # within-chunk wave number, always < waves_per_sync
+                # while the loop runs).
+                row = jnp.stack(
+                    [
+                        n_f,
+                        c2["wv_pairs"],
+                        c2["gen_lo"] - c["gen_lo"],
+                        c2["new"] - c["new"],
+                        c2["new"],
+                        c["depth"].astype(jnp.uint32),
+                        f_class.astype(jnp.uint32),
+                        v_class.astype(jnp.uint32),
+                    ]
+                )
+                c2 = dict(
+                    c2,
+                    wlog=lax.dynamic_update_slice(
+                        c2["wlog"], row[None, :],
+                        (c["wchunk"], jnp.int32(0)),
+                    ),
+                )
+            return c2
 
         def cond(c):
             return ~c["done"] & (c["wchunk"] < waves_per_sync)
@@ -1530,16 +1661,19 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     c["e_overflow"].astype(jnp.uint32),
                 ]
             )
-            stats = jnp.concatenate(
-                [
-                    scalars,
-                    c["disc_found"].astype(jnp.uint32),
-                    c["disc_lo"],
-                    c["disc_hi"],
-                    jnp.stack([c["max_cand"], c["max_tile_cand"],
-                               c["max_rowen"]]),
-                ]
-            )
+            parts = [
+                scalars,
+                c["disc_found"].astype(jnp.uint32),
+                c["disc_lo"],
+                c["disc_hi"],
+                jnp.stack([c["max_cand"], c["max_tile_cand"],
+                           c["max_rowen"]]),
+            ]
+            if trace_log:
+                # The wave log rides the SAME packed readback — no
+                # extra sync (waves_per_sync × WL uint32 ≈ 2 KB).
+                parts.append(c["wlog"].reshape(-1))
+            stats = jnp.concatenate(parts)
             return c, stats
 
         return jax.jit(seed), jax.jit(chunk, donate_argnums=0)

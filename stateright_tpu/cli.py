@@ -9,6 +9,7 @@ dispatches the same surface for every bundled workload:
     python -m stateright_tpu 2pc check-sym 5
     python -m stateright_tpu 2pc check-tpu 6          (wave engine)
     python -m stateright_tpu paxos check 2 [network]
+    python -m stateright_tpu paxos check-tpu 4 --trace    (run telemetry)
     python -m stateright_tpu paxos explore 2 localhost:3000
     python -m stateright_tpu paxos spawn
 
@@ -17,6 +18,14 @@ everywhere except interaction-style BFS cases); ``check-tpu`` — the
 addition this framework exists for — runs the same workload on the
 accelerator wave engine. Output goes through ``WriteReporter`` so the
 report shape (``Done. states=… unique=… …``) matches report.rs:60-98.
+
+``--trace`` (anywhere on the line) records run telemetry
+(stateright_tpu/telemetry.py): per-wave events from the engine's
+device wave log, host-phase spans, and the chunk dispatch/fetch wall
+split, exported as auto-numbered ``TRACE_r*.jsonl`` +
+``TRACE_r*.trace.json`` (Chrome trace) in the repo root.
+``--trace=deep`` adds per-wave syncs for real per-wave wall times.
+Diff two trace artifacts with ``tools/trace_diff.py``.
 """
 
 from __future__ import annotations
@@ -40,8 +49,11 @@ def _network(args: list[str], index: int) -> Network:
     return Network.from_name(name)
 
 
-def _report(checker) -> None:
-    checker.report(WriteReporter(sys.stdout))
+def _report(checker, out=None) -> None:
+    """The one reporting path every check lane shares: the reference-
+    format ``Reporter`` (report.rs:60-98) — no lane formats privately
+    (tests/test_report.py pins the format through this seam)."""
+    checker.report(WriteReporter(out if out is not None else sys.stdout))
 
 
 def _explore(builder, args: list[str], index: int) -> None:
@@ -409,10 +421,30 @@ def _usage(model: str | None = None) -> None:
                 extra = ""  # fixed harness: no count, no network
             print(f"  python -m stateright_tpu {model} {sub} {extra}")
     print(f"NETWORK: {' | '.join(Network.names())}")
+    print(
+        "FLAGS: --trace[=deep] on any check lane writes TRACE_r*.jsonl"
+        " + TRACE_r*.trace.json run telemetry (tools/trace_diff.py "
+        "compares two)"
+    )
+
+
+def _pop_trace_flag(argv: list[str]) -> tuple[str | None, list[str]]:
+    """Strip ``--trace`` / ``--trace=deep`` from anywhere in argv."""
+    level = None
+    rest = []
+    for a in argv:
+        if a == "--trace":
+            level = "default"
+        elif a.startswith("--trace="):
+            level = a.split("=", 1)[1]
+        else:
+            rest.append(a)
+    return level, rest
 
 
 def main(argv: list[str] | None = None) -> None:
     argv = sys.argv[1:] if argv is None else argv
+    trace_level, argv = _pop_trace_flag(argv)
     if not argv or argv[0] not in _MODELS:
         _usage()
         return
@@ -421,4 +453,23 @@ def main(argv: list[str] | None = None) -> None:
     if not rest or rest[0] not in subs:
         _usage(model)
         return
-    handler(rest[0], rest[1:])
+    if trace_level is None:
+        handler(rest[0], rest[1:])
+        return
+    if trace_level not in ("default", "deep"):
+        raise SystemExit(
+            f"--trace={trace_level}: unknown level "
+            "(use --trace or --trace=deep)"
+        )
+    from .telemetry import RunTracer, write_artifacts
+
+    tracer = RunTracer(level=trace_level)
+    try:
+        with tracer.activate():
+            handler(rest[0], rest[1:])
+    finally:
+        # A failed/interrupted run's partial trace is the one you
+        # need for diagnosis — write whatever was collected.
+        if tracer.events:
+            jsonl, chrome = write_artifacts(tracer)
+            print(f"trace: wrote {jsonl} + {chrome}", file=sys.stderr)

@@ -194,6 +194,20 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             )
             self.metrics["max_wave_candidates"] = int(extra[2])
 
+    def _wave_log_pairs_valid(self) -> bool:
+        # The sharded log wrapper can't see the enabled-pair popcount
+        # (it lives inside the per-shard wave switch): lane 1 is 0 and
+        # the tracer records enabled_pairs=null.
+        return False
+
+    def _lane_config(self) -> dict:
+        lane = super()._lane_config()
+        lane.update(
+            n_shards=self.n_shards,
+            bucket_capacity=self.bucket_capacity,
+        )
+        return lane
+
     # -- device programs ---------------------------------------------------
 
     def _build_programs(self, n0: int):
@@ -256,6 +270,16 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
         waves_per_sync = self.waves_per_sync
         ebits_init = self._eventually_bits_init()
         track_paths = self.track_paths
+        # Per-wave trace log (telemetry.py): GLOBAL per-wave counters
+        # (psum'd frontier rows, the replicated gen/new deltas) appended
+        # by a wrapper around the wave body — the inner wave/merge
+        # builders never see the log, so the replicated row stays out
+        # of the shard-varying carry plumbing. The enabled-pair
+        # popcount is not visible at this level: lane 1 logs 0 and the
+        # host records enabled_pairs=null (_wave_log_pairs_valid).
+        from ..telemetry import WAVE_LOG_LANES as WL
+
+        trace_log = self._wave_log_enabled()
 
         # Class ladders, agreed across shards per wave via lax.pmax
         # (collectives are collective: every shard must take the same
@@ -350,6 +374,11 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                 [sk_hi, jnp.full(pad, _SENT, jnp.uint32)]
             )
             return dict(
+                **(
+                    dict(wlog=jnp.zeros((waves_per_sync, WL),
+                                        jnp.uint32))
+                    if trace_log else {}
+                ),
                 v_lo=v_lo,
                 v_hi=v_hi,
                 pl_child_lo=jnp.zeros(L, jnp.uint32),
@@ -949,11 +978,38 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                 v_class = v_class + (
                     u_max > jnp.uint32(V_i)
                 ).astype(jnp.int32)
-            return lax.switch(
+            if trace_log:
+                n_tot = lax.psum(c["n_loc"][0], "shard")
+            ci = {k: v for k, v in c.items() if k != "wlog"}
+            c2 = lax.switch(
                 f_class,
                 [make_wave(fc, v_class) for fc in range(len(f_ladder))],
-                c,
+                ci,
             )
+            if trace_log:
+                # Every lane here is replicated (psum/pmax results and
+                # the engine's replicated run counters), so the log
+                # matches the stats' P() out-spec.
+                row = jnp.stack(
+                    [
+                        n_tot,
+                        jnp.uint32(0),  # enabled pairs: not visible
+                        c2["gen_lo"] - c["gen_lo"],
+                        c2["new"] - c["new"],
+                        c2["new"],
+                        c["depth"].astype(jnp.uint32),
+                        f_class.astype(jnp.uint32),
+                        v_class.astype(jnp.uint32),
+                    ]
+                )
+                c2 = dict(
+                    c2,
+                    wlog=lax.dynamic_update_slice(
+                        c["wlog"], row[None, :],
+                        (c["wchunk"], jnp.int32(0)),
+                    ),
+                )
+            return c2
 
         def cond(c):
             return ~c["done"] & (c["wchunk"] < waves_per_sync)
@@ -979,21 +1035,23 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                     c["e_overflow"].astype(jnp.uint32),
                 ]
             )
-            stats = jnp.concatenate(
-                [
-                    scalars,
-                    c["disc_found"].astype(jnp.uint32),
-                    c["disc_lo"],
-                    c["disc_hi"],
-                    jnp.stack(
-                        [c["sent_lo"], c["sent_hi"], c["max_cand"]]
-                    ),
-                ]
-            )
+            parts = [
+                scalars,
+                c["disc_found"].astype(jnp.uint32),
+                c["disc_lo"],
+                c["disc_hi"],
+                jnp.stack(
+                    [c["sent_lo"], c["sent_hi"], c["max_cand"]]
+                ),
+            ]
+            if trace_log:
+                parts.append(c["wlog"].reshape(-1))
+            stats = jnp.concatenate(parts)
             return c, stats
 
         P_shard = P("shard")
         specs = dict(
+            **(dict(wlog=P()) if trace_log else {}),
             v_lo=P_shard,
             v_hi=P_shard,
             pl_child_lo=P_shard,

@@ -1,0 +1,671 @@
+"""Run-level wave telemetry: structured trace events for real check
+runs.
+
+The engines' observability story used to be three ad-hoc peak counters
+and a wave-wall profiler that re-times ONE wave offline
+(stateright_tpu/wavewall.py) — a real ``paxos check 4`` left no record
+of what each wave actually did, so every chip measurement was a number
+typed into PERF.md with no diffable artifact behind it. This module is
+the missing layer (the per-iteration frontier/dedup telemetry that
+GPUexplore's scalability study and cloud-scale exploration both lean
+on — PAPERS.md: arXiv 1801.05857, 1203.6806):
+
+* **Per-wave events** — wave index, frontier rows, enabled-pair
+  popcount, candidate count, post-dedup new-state count, running
+  unique total, depth, and the (frontier, visited) class the adaptive
+  ladder dispatched. Assembled from a small device-side wave log the
+  sort-merge engines append inside the chunk ``while_loop``
+  (8 uint32 lanes × waves_per_sync rows, downloaded WITH the packed
+  stats — one readback per chunk, so the default path keeps async
+  dispatch and the <5% overhead bar; see WAVE_LOG_LANES).
+* **Chunk events** — the host-side wall split the engine can measure
+  without extra syncs: device dispatch (the async ``chunk_fn`` call)
+  vs host fetch (the blocking stats readback, which at the default
+  level includes the device wait). ``level="deep"`` adds the extra
+  syncs the default path refuses: the engine forces one wave per
+  chunk and blocks on the carry before the fetch, so every wave gets
+  a REAL wall time and a device/fetch split.
+* **Host-phase spans** — compile, seed upload, counterexample
+  reconstruction, symmetry canonicalization, property checks — via
+  the context-manager API (:func:`span` / :meth:`RunTracer.phase_acc`)
+  used by checker.py and the host checkers. When no tracer is active
+  every hook is a no-op.
+* **Exporters** — JSONL (``TRACE_r*.jsonl``, auto-numbered beside the
+  BENCH/LINT artifacts via :mod:`stateright_tpu.artifacts`) and
+  Chrome-trace/Perfetto JSON (``TRACE_r*.trace.json``), plus the
+  wave-aligned differ behind ``tools/trace_diff.py`` — the mechanism
+  A/B rounds (chip re-measure, carry rework) record their
+  before/after through.
+
+Activation is explicit and process-global: CLI/bench/tools build a
+:class:`RunTracer` and run the checker inside ``tracer.activate()``;
+engines pick it up with :func:`current_tracer` at ``_run`` time (a
+plain global, not a contextvar — the hybrid racer's device side runs
+in a worker thread and must see it).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Optional
+
+#: bump when an event type gains/loses REQUIRED fields.
+SCHEMA_VERSION = 1
+
+#: device wave-log lane layout (uint32[waves_per_sync, WAVE_LOG_LANES]
+#: in the chunk carry; the engines write one row per wave, the host
+#: unpacks rows into ``wave`` events). Lane 1 is 0 on engines that
+#: can't see the enabled popcount from the log wrapper (the sharded
+#: engine) — those pass ``pairs_valid=False`` and the event carries
+#: ``enabled_pairs: null``.
+WAVE_LOG_LANES = 8
+WAVE_LOG_FIELDS = (
+    "frontier_rows",   # live rows entering the wave
+    "enabled_pairs",   # enabled-bitmap popcount (sparse single-chip)
+    "candidates",      # surviving candidates (what the gen counter adds)
+    "new_states",      # post-dedup winners appended to visited
+    "unique_total",    # running unique count AFTER the wave
+    "depth",           # depth entering the wave
+    "f_class",         # frontier ladder class dispatched
+    "v_class",         # visited ladder class dispatched
+)
+
+_ACTIVE: Optional["RunTracer"] = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def current_tracer() -> Optional["RunTracer"]:
+    """The process-active tracer, or None (the common, zero-overhead
+    case — every instrumentation site guards on this)."""
+    return _ACTIVE
+
+
+class _NullSpan:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def span(phase: str, **meta):
+    """Module-level span hook: a real span on the active tracer, a
+    shared no-op context manager otherwise — call sites never need a
+    tracer reference or an if."""
+    tracer = _ACTIVE
+    if tracer is None:
+        return _NULL_SPAN
+    return tracer.span(phase, **meta)
+
+
+def emit(ev: str, **fields) -> None:
+    """Module-level instant-event hook (no-op without a tracer)."""
+    tracer = _ACTIVE
+    if tracer is not None:
+        tracer.event(ev, **fields)
+
+
+class _PhaseAcc:
+    """Reusable accumulating timer for phases that run once per STATE
+    (property checks, symmetry canonicalization): entering/exiting
+    adds to a per-run total instead of emitting an event per state —
+    one ``phase_total`` event lands at run end. Create once, reuse in
+    the hot loop."""
+
+    __slots__ = ("tracer", "phase", "_t0")
+
+    def __init__(self, tracer: "RunTracer", phase: str):
+        self.tracer = tracer
+        self.phase = phase
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self.tracer._accumulate(self.phase, time.monotonic() - self._t0)
+        return False
+
+
+class RunTracer:
+    """Collects one process's trace events; see the module docstring.
+
+    ``level`` is ``"default"`` (no extra device syncs: exact per-wave
+    COUNTS from the chunk wave log, per-chunk wall split, per-wave
+    times estimated by even division and flagged ``t_est``) or
+    ``"deep"`` (engines force waves_per_sync=1 and block on the carry:
+    real per-wave walls and a device/fetch split, at per-wave sync
+    cost)."""
+
+    def __init__(self, level: str = "default"):
+        if level not in ("default", "deep"):
+            raise ValueError(f"unknown trace level {level!r}")
+        self.level = level
+        self.events: list[dict] = []
+        self._t_base = time.monotonic()
+        self._lock = threading.Lock()
+        self._run_idx = -1
+        self._run_open = False
+        self._phase_totals: dict[str, list] = {}
+
+    # -- activation ------------------------------------------------------
+
+    @contextmanager
+    def activate(self):
+        """Install as the process-active tracer for the block."""
+        global _ACTIVE
+        with _ACTIVE_LOCK:
+            if _ACTIVE is not None and _ACTIVE is not self:
+                raise RuntimeError("another RunTracer is already active")
+            _ACTIVE = self
+        try:
+            yield self
+        finally:
+            with _ACTIVE_LOCK:
+                _ACTIVE = None
+
+    # -- event plumbing --------------------------------------------------
+
+    def _now(self) -> float:
+        return time.monotonic() - self._t_base
+
+    def _append(self, ev: dict) -> None:
+        with self._lock:
+            self.events.append(ev)
+
+    def event(self, ev: str, **fields) -> None:
+        """Instant event (auto-budget retries, level overrides, ...)."""
+        self._append(
+            dict(ev=ev, run=self._run_idx, t=round(self._now(), 6),
+                 **fields)
+        )
+
+    # -- runs ------------------------------------------------------------
+
+    def begin_run(self, lane: dict | None = None) -> int:
+        """Open a run (one checker execution). Embeds provenance —
+        the satellite contract: every TRACE artifact names the
+        toolchain/device/SHA/lane it measured."""
+        from .artifacts import provenance
+
+        with self._lock:
+            self._run_idx += 1
+            self._run_open = True
+            self._phase_totals = {}
+            self.events.append(
+                dict(
+                    ev="run_begin",
+                    run=self._run_idx,
+                    t=round(self._now(), 6),
+                    schema=SCHEMA_VERSION,
+                    level=self.level,
+                    provenance=provenance(),
+                    lane=lane or {},
+                )
+            )
+            return self._run_idx
+
+    def end_run(self, *, error: str | None = None, **stats) -> None:
+        if not self._run_open:
+            return
+        for phase, (dur, count) in sorted(self._phase_totals.items()):
+            self._append(
+                dict(ev="phase_total", run=self._run_idx, phase=phase,
+                     dur=round(dur, 6), count=count)
+            )
+        self.event("run_end", error=error,
+                   **{k: v for k, v in stats.items()})
+        self._run_open = False
+
+    # -- spans / accumulators -------------------------------------------
+
+    @contextmanager
+    def span(self, phase: str, **meta):
+        t0 = self._now()
+        try:
+            yield self
+        finally:
+            t1 = self._now()
+            self._append(
+                dict(ev="span", run=self._run_idx, phase=phase,
+                     t0=round(t0, 6), t1=round(t1, 6),
+                     dur=round(t1 - t0, 6), **meta)
+            )
+
+    def phase_acc(self, phase: str) -> _PhaseAcc:
+        return _PhaseAcc(self, phase)
+
+    def _accumulate(self, phase: str, dur: float) -> None:
+        tot = self._phase_totals.setdefault(phase, [0.0, 0])
+        tot[0] += dur
+        tot[1] += 1
+
+    # -- engine chunk/wave ingestion -------------------------------------
+
+    def record_chunk(
+        self,
+        *,
+        chunk: int,
+        wave0: int,
+        t0: float,
+        t1: float,
+        dispatch_sec: float,
+        fetch_sec: float,
+        device_sec: float | None = None,
+        n_waves: int | None = None,
+        wave_rows=None,
+        pairs_valid: bool = True,
+    ) -> None:
+        """One chunk sync: the host wall split plus the downloaded
+        device wave-log rows (``wave_rows``: int array
+        [n_waves, WAVE_LOG_LANES]; None for engines without a wave
+        log — the chunk event still lands). ``t0``/``t1`` are absolute
+        ``time.monotonic()`` stamps bracketing dispatch→fetch."""
+        rt0 = t0 - self._t_base
+        rt1 = t1 - self._t_base
+        if wave_rows is not None and n_waves is None:
+            n_waves = len(wave_rows)
+        self._append(
+            dict(
+                ev="chunk", run=self._run_idx, chunk=chunk,
+                wave0=wave0, waves=n_waves,
+                t0=round(rt0, 6), t1=round(rt1, 6),
+                dispatch_sec=round(dispatch_sec, 6),
+                device_sec=(None if device_sec is None
+                            else round(device_sec, 6)),
+                fetch_sec=round(fetch_sec, 6),
+            )
+        )
+        if wave_rows is None or n_waves is None or n_waves == 0:
+            return
+        # Default level: the chunk ran async, so per-wave walls don't
+        # exist — spread the chunk interval evenly and flag the
+        # estimate. Deep level (1 wave/chunk): the division is exact.
+        per = (rt1 - rt0) / n_waves
+        est = not (self.level == "deep" and n_waves == 1)
+        for i in range(n_waves):
+            row = [int(x) for x in wave_rows[i]]
+            fields = dict(zip(WAVE_LOG_FIELDS, row))
+            if not pairs_valid:
+                fields["enabled_pairs"] = None
+            self._append(
+                dict(
+                    ev="wave", run=self._run_idx, wave=wave0 + i,
+                    chunk=chunk,
+                    t0=round(rt0 + i * per, 6),
+                    t1=round(rt0 + (i + 1) * per, 6),
+                    t_est=est,
+                    **fields,
+                )
+            )
+
+    # -- exporters -------------------------------------------------------
+
+    def write_jsonl(self, path: str) -> str:
+        with open(path, "w") as fh:
+            with self._lock:
+                for ev in self.events:
+                    fh.write(json.dumps(ev, sort_keys=True) + "\n")
+        return path
+
+    def write_chrome_trace(self, path: str) -> str:
+        """Chrome-trace / Perfetto JSON: host phases, device chunks,
+        and waves on three named tracks, plus counter tracks for the
+        frontier/new-state curves (``chrome://tracing`` or
+        ui.perfetto.dev)."""
+        with self._lock:
+            events = list(self.events)
+        out: list[dict] = []
+        for pid, name in ((0, "stateright_tpu"),):
+            out.append(dict(ph="M", pid=pid, name="process_name",
+                            args=dict(name=name)))
+        for tid, name in ((0, "host phases"), (1, "device chunks"),
+                          (2, "waves")):
+            out.append(dict(ph="M", pid=0, tid=tid, name="thread_name",
+                            args=dict(name=name)))
+
+        def us(t):
+            return round(t * 1e6, 1)
+
+        for ev in events:
+            kind = ev.get("ev")
+            if kind == "span":
+                out.append(
+                    dict(ph="X", pid=0, tid=0, name=ev["phase"],
+                         ts=us(ev["t0"]), dur=us(ev["dur"]),
+                         args={k: v for k, v in ev.items()
+                               if k not in ("ev", "t0", "t1", "dur")})
+                )
+            elif kind == "chunk":
+                out.append(
+                    dict(ph="X", pid=0, tid=1,
+                         name=f"chunk {ev['chunk']}",
+                         ts=us(ev["t0"]),
+                         dur=us(ev["t1"] - ev["t0"]),
+                         args={k: ev[k] for k in
+                               ("run", "waves", "dispatch_sec",
+                                "device_sec", "fetch_sec")})
+                )
+            elif kind == "wave":
+                args = {k: ev[k] for k in WAVE_LOG_FIELDS}
+                args["t_est"] = ev["t_est"]
+                out.append(
+                    dict(ph="X", pid=0, tid=2,
+                         name=f"wave {ev['wave']}",
+                         ts=us(ev["t0"]),
+                         dur=us(ev["t1"] - ev["t0"]), args=args)
+                )
+                out.append(
+                    dict(ph="C", pid=0, name="frontier_rows",
+                         ts=us(ev["t0"]),
+                         args=dict(rows=ev["frontier_rows"]))
+                )
+                out.append(
+                    dict(ph="C", pid=0, name="new_states",
+                         ts=us(ev["t0"]),
+                         args=dict(new=ev["new_states"]))
+                )
+            elif kind in ("run_begin", "run_end", "phase_total"):
+                out.append(
+                    dict(ph="i", pid=0, tid=0, s="g", name=kind,
+                         ts=us(ev.get("t", ev.get("dur", 0.0))
+                               if kind != "phase_total"
+                               else events[0].get("t", 0.0)),
+                         args={k: v for k, v in ev.items()
+                               if k != "ev"})
+                )
+            else:  # instant engine events (auto_budget_retry, ...)
+                out.append(
+                    dict(ph="i", pid=0, tid=1, s="t", name=kind,
+                         ts=us(ev.get("t", 0.0)),
+                         args={k: v for k, v in ev.items()
+                               if k not in ("ev", "t")})
+                )
+        with open(path, "w") as fh:
+            json.dump(dict(traceEvents=out, displayTimeUnit="ms"), fh)
+        return path
+
+
+def write_artifacts(tracer: RunTracer, root: str | None = None,
+                    round: int | None = None) -> tuple[str, str]:
+    """Write the auto-numbered artifact PAIR (JSONL + Chrome trace)
+    into one round slot beside the BENCH/LINT artifacts."""
+    from .artifacts import artifact_path, next_round, repo_root
+
+    root = repo_root() if root is None else root
+    if round is None:
+        round = next_round(root)
+    jsonl = tracer.write_jsonl(
+        artifact_path("TRACE", "jsonl", root=root, round=round)
+    )
+    chrome = tracer.write_chrome_trace(
+        artifact_path("TRACE", "trace.json", root=root, round=round)
+    )
+    return jsonl, chrome
+
+
+# -- trace loading / validation / diff -----------------------------------
+#
+# The logic behind tools/trace_diff.py lives here so tests import it the
+# way the lint tests import stateright_tpu.analysis.
+
+_REQUIRED = {
+    "run_begin": ("run", "schema", "level", "provenance", "lane"),
+    "run_end": ("run", "t"),
+    "span": ("run", "phase", "t0", "t1", "dur"),
+    "phase_total": ("run", "phase", "dur", "count"),
+    "chunk": ("run", "chunk", "wave0", "t0", "t1", "dispatch_sec",
+              "fetch_sec"),
+    "wave": ("run", "wave", "chunk", "t0", "t1", "t_est")
+    + WAVE_LOG_FIELDS,
+}
+
+
+def load_trace(path: str) -> list[dict]:
+    """Parse a TRACE_r*.jsonl file; raises ValueError on malformed
+    lines."""
+    events = []
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError as exc:
+                raise ValueError(
+                    f"{path}:{lineno}: not JSON: {exc}"
+                ) from exc
+            if not isinstance(ev, dict) or "ev" not in ev:
+                raise ValueError(
+                    f"{path}:{lineno}: event without an 'ev' field"
+                )
+            events.append(ev)
+    return events
+
+
+def validate_events(events: list[dict]) -> None:
+    """Schema check: every known event type carries its required
+    fields, runs open with run_begin, and wave counters are internally
+    consistent (unique_total is the running post-dedup sum). A wave
+    index that does NOT advance marks an auto-budget retry restart
+    (the resized attempt re-explores from wave 0 inside the same run)
+    — the running-sum check resets there instead of rejecting the
+    legitimate artifact. Raises ValueError on the first violation."""
+    open_runs: set[int] = set()
+    last_unique: dict[int, int] = {}
+    last_wave: dict[int, int] = {}
+    for i, ev in enumerate(events):
+        kind = ev["ev"]
+        for field in _REQUIRED.get(kind, ()):
+            if field not in ev:
+                raise ValueError(
+                    f"event {i} ({kind}): missing field {field!r}"
+                )
+        if kind == "run_begin":
+            if ev["schema"] > SCHEMA_VERSION:
+                raise ValueError(
+                    f"event {i}: schema {ev['schema']} newer than "
+                    f"reader ({SCHEMA_VERSION})"
+                )
+            open_runs.add(ev["run"])
+        elif kind == "wave":
+            run = ev["run"]
+            if run not in open_runs:
+                raise ValueError(
+                    f"event {i}: wave outside an open run"
+                )
+            if run in last_wave and ev["wave"] <= last_wave[run]:
+                last_unique.pop(run, None)  # retry restart
+            prev = last_unique.get(run)
+            if prev is not None and ev["unique_total"] != (
+                prev + ev["new_states"]
+            ):
+                raise ValueError(
+                    f"event {i}: wave {ev['wave']} unique_total "
+                    f"{ev['unique_total']} != previous {prev} + "
+                    f"new_states {ev['new_states']}"
+                )
+            last_unique[run] = ev["unique_total"]
+            last_wave[run] = ev["wave"]
+
+
+def _runs(events: list[dict]) -> list[int]:
+    return sorted({ev["run"] for ev in events if ev["ev"] == "run_begin"})
+
+
+def _run_view(events: list[dict], run: int) -> dict:
+    view: dict = dict(run=run, begin=None, end=None, waves=[],
+                      chunks=[], spans=[], phase_totals={})
+    for ev in events:
+        if ev.get("run") != run:
+            continue
+        kind = ev["ev"]
+        if kind == "run_begin":
+            view["begin"] = ev
+        elif kind == "run_end":
+            view["end"] = ev
+        elif kind == "wave":
+            view["waves"].append(ev)
+        elif kind == "chunk":
+            view["chunks"].append(ev)
+        elif kind == "span":
+            view["spans"].append(ev)
+        elif kind == "phase_total":
+            view["phase_totals"][ev["phase"]] = ev
+    view["waves"].sort(key=lambda w: w["wave"])
+    return view
+
+
+def _phase_durations(view: dict) -> dict[str, float]:
+    """Per-phase wall totals for one run: named spans, accumulated
+    phase totals, the chunk-level dispatch/fetch split, and the wave
+    wall sum."""
+    out: dict[str, float] = {}
+    for s in view["spans"]:
+        out[s["phase"]] = out.get(s["phase"], 0.0) + s["dur"]
+    for phase, ev in view["phase_totals"].items():
+        out[phase] = out.get(phase, 0.0) + ev["dur"]
+    disp = sum(c["dispatch_sec"] for c in view["chunks"])
+    fetch = sum(c["fetch_sec"] for c in view["chunks"])
+    dev = sum(c["device_sec"] or 0.0 for c in view["chunks"])
+    if view["chunks"]:
+        out["device_dispatch"] = disp
+        out["host_fetch"] = fetch
+        if dev:
+            out["device_wait"] = dev
+    if view["waves"]:
+        out["waves_wall"] = sum(
+            w["t1"] - w["t0"] for w in view["waves"]
+        )
+    end = view["end"]
+    if end is not None and end.get("duration_sec") is not None:
+        out["run_total"] = end["duration_sec"]
+    return out
+
+
+#: wave counters trace_diff requires to MATCH between the two sides —
+#: two traces of the same workload must explore the same space.
+DIFF_COUNTERS = ("frontier_rows", "candidates", "new_states",
+                 "unique_total")
+
+
+def diff_traces(
+    a_events: list[dict],
+    b_events: list[dict],
+    *,
+    run_a: int | None = None,
+    run_b: int | None = None,
+    threshold: float = 0.10,
+    min_sec: float = 0.05,
+) -> dict:
+    """Align two traces wave-by-wave and price the per-phase deltas.
+
+    Returns a report dict:
+      ``divergences`` — per-wave counter mismatches (a traced A/B of
+        one workload must have identical exploration; any mismatch
+        fails the gate),
+      ``phases`` — {phase: {a, b, delta, rel}},
+      ``regressions`` — phases where B exceeds A by more than
+        ``threshold`` (relative), ignoring phases under ``min_sec``
+        on the A side (noise floor),
+      ``ok`` — True iff no divergence and no regression.
+
+    ``run_a``/``run_b`` default to the LAST run in each file (bench
+    traces warm-run-last)."""
+    va = _run_view(a_events, _runs(a_events)[-1] if run_a is None
+                   else run_a)
+    vb = _run_view(b_events, _runs(b_events)[-1] if run_b is None
+                   else run_b)
+
+    divergences = []
+    wa = {w["wave"]: w for w in va["waves"]}
+    wb = {w["wave"]: w for w in vb["waves"]}
+    for i in sorted(set(wa) | set(wb)):
+        if i not in wa or i not in wb:
+            divergences.append(
+                dict(wave=i, field="present",
+                     a=i in wa, b=i in wb)
+            )
+            continue
+        for field in DIFF_COUNTERS:
+            if wa[i][field] != wb[i][field]:
+                divergences.append(
+                    dict(wave=i, field=field,
+                         a=wa[i][field], b=wb[i][field])
+                )
+
+    pa = _phase_durations(va)
+    pb = _phase_durations(vb)
+    phases = {}
+    regressions = []
+    for phase in sorted(set(pa) | set(pb)):
+        a = pa.get(phase, 0.0)
+        b = pb.get(phase, 0.0)
+        rel = (b - a) / a if a > 0 else (float("inf") if b > 0 else 0.0)
+        phases[phase] = dict(a=round(a, 6), b=round(b, 6),
+                             delta=round(b - a, 6),
+                             rel=round(rel, 4) if rel != float("inf")
+                             else None)
+        if a >= min_sec and rel > threshold:
+            regressions.append(phase)
+
+    return dict(
+        run_a=va["run"], run_b=vb["run"],
+        waves_a=len(va["waves"]), waves_b=len(vb["waves"]),
+        divergences=divergences,
+        phases=phases,
+        regressions=regressions,
+        threshold=threshold,
+        min_sec=min_sec,
+        ok=not divergences and not regressions,
+    )
+
+
+def format_diff(report: dict) -> str:
+    lines = [
+        f"trace diff: run A#{report['run_a']} "
+        f"({report['waves_a']} waves) vs run B#{report['run_b']} "
+        f"({report['waves_b']} waves)",
+    ]
+    if report["divergences"]:
+        lines.append(
+            f"WAVE DIVERGENCE ({len(report['divergences'])} "
+            "mismatches) — the two traces did not explore the same "
+            "space:"
+        )
+        for d in report["divergences"][:10]:
+            lines.append(
+                f"  wave {d['wave']:5d} {d['field']:14s} "
+                f"A={d['a']} B={d['b']}"
+            )
+        if len(report["divergences"]) > 10:
+            lines.append(
+                f"  ... {len(report['divergences']) - 10} more"
+            )
+    lines.append(
+        f"{'phase':28s} {'A sec':>10s} {'B sec':>10s} "
+        f"{'delta':>10s} {'rel':>8s}"
+    )
+    for phase, p in report["phases"].items():
+        rel = "n/a" if p["rel"] is None else f"{p['rel']:+.1%}"
+        flag = "  <-- REGRESSION" if phase in report["regressions"] \
+            else ""
+        lines.append(
+            f"{phase:28s} {p['a']:10.4f} {p['b']:10.4f} "
+            f"{p['delta']:+10.4f} {rel:>8s}{flag}"
+        )
+    verdict = "OK" if report["ok"] else (
+        "FAIL: wave divergence" if report["divergences"]
+        else f"FAIL: {len(report['regressions'])} phase(s) past "
+             f"+{report['threshold']:.0%}"
+    )
+    lines.append(f"verdict: {verdict}")
+    return "\n".join(lines)

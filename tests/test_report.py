@@ -1,0 +1,144 @@
+"""Direct tests for the Reporter path (report.rs:10-98 parity).
+
+``checker.report()`` / ``WriteReporter.report_checking`` /
+``report_discoveries`` are the CLI's entire output surface
+(cli._report routes every check lane through them) but had no direct
+coverage — a format drift would only have shown up as a human reading
+CLI output. These tests pin:
+
+* the reference text protocol (``Checking. states=…`` /
+  ``Done. … sec=…`` / ``Discovered "name" classification path``),
+* the fingerprint-only branch for ``track_paths=False`` engines,
+* periodic ``report_checking`` callbacks from the host BFS loop,
+* ``checker.report()`` emitting the final snapshot + discoveries,
+* cli._report using the same Reporter (no private formatting).
+"""
+
+import io
+import re
+
+import pytest
+
+from stateright_tpu.report import ReportData, Reporter, WriteReporter
+
+
+def _increment_bfs():
+    from stateright_tpu.models.increment import Increment
+
+    return Increment(thread_count=2).checker().spawn_bfs()
+
+
+def test_write_reporter_checking_formats():
+    out = io.StringIO()
+    r = WriteReporter(out)
+    r.report_checking(ReportData(
+        total_states=10, unique_states=7, max_depth=3,
+        duration_sec=0.5, done=False,
+    ))
+    r.report_checking(ReportData(
+        total_states=20, unique_states=14, max_depth=5,
+        duration_sec=1.25, done=True,
+    ))
+    lines = out.getvalue().splitlines()
+    assert lines[0] == "Checking. states=10, unique=7, depth=3"
+    assert lines[1] == "Done. states=20, unique=14, depth=5, sec=1.250"
+
+
+def test_report_discoveries_full_paths():
+    c = _increment_bfs().join()
+    assert "fin" in c.discoveries()
+    out = io.StringIO()
+    WriteReporter(out).report_discoveries(c)
+    text = out.getvalue()
+    # reference format: Discovered "name" classification <encoded path>
+    m = re.search(
+        r'^Discovered "fin" counterexample (\S+)$', text, re.M
+    )
+    assert m, text
+    assert m.group(1) == c.discoveries()["fin"].encode()
+    # the replayed steps follow, with action arrows between states
+    assert "-- " in text and " -->" in text
+
+
+def test_report_discoveries_fingerprint_only():
+    from stateright_tpu.models.increment import Increment
+
+    c = (
+        Increment(thread_count=2)
+        .checker()
+        .spawn_tpu_sortmerge(
+            capacity=1 << 12, frontier_capacity=256,
+            cand_capacity=1024, track_paths=False,
+        )
+        .join()
+    )
+    fps = c.discovery_fingerprints()
+    assert "fin" in fps
+    out = io.StringIO()
+    WriteReporter(out).report_discoveries(c)
+    text = out.getvalue()
+    assert re.search(
+        r'^Discovered "fin" counterexample 0x[0-9a-f]{16} '
+        r"\(fingerprint only", text, re.M
+    ), text
+    assert f"{fps['fin']:#018x}" in text
+
+
+def test_checker_report_emits_final_snapshot_and_discoveries():
+    c = _increment_bfs()
+    out = io.StringIO()
+    ret = c.report(WriteReporter(out))
+    assert ret is c  # fluent, checker.rs:330-431
+    text = out.getvalue()
+    assert f"Done. states={c.state_count()}, " \
+           f"unique={c.unique_state_count()}, " \
+           f"depth={c.max_depth()}," in text
+    assert 'Discovered "fin" counterexample' in text
+    # join_and_report is an alias of the same path
+    out2 = io.StringIO()
+    c.join_and_report(WriteReporter(out2))
+    assert "Done." in out2.getvalue()
+
+
+def test_bfs_periodic_report_checking_callbacks():
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    class Rec(Reporter):
+        def __init__(self):
+            self.snapshots = []
+
+        def delay(self):
+            return 0.0  # report after every popped state
+
+        def report_checking(self, data):
+            self.snapshots.append(data)
+
+    rec = Rec()
+    c = TwoPhaseSys(rm_count=3).checker().spawn_bfs()
+    c.report(rec)
+    # periodic (done=False) snapshots from inside the loop, then the
+    # final done=True snapshot from report()
+    assert len(rec.snapshots) >= 2
+    assert any(not d.done for d in rec.snapshots[:-1])
+    final = rec.snapshots[-1]
+    assert final.done and final.unique_states == 288
+    # progress is monotonic
+    uniques = [d.unique_states for d in rec.snapshots]
+    assert uniques == sorted(uniques)
+
+
+def test_default_reporter_is_inert():
+    r = Reporter()
+    assert r.delay() == 1.0
+    r.report_checking(ReportData(1, 1, 1, 0.0, True))  # no-op
+    r.report_discoveries(_increment_bfs().join())  # no-op
+
+
+def test_cli_report_routes_through_write_reporter():
+    from stateright_tpu.cli import _report
+
+    out = io.StringIO()
+    _report(_increment_bfs(), out=out)
+    text = out.getvalue()
+    assert text.startswith("Done. states=")
+    assert 'Discovered "fin" counterexample' in text

@@ -1,0 +1,585 @@
+"""Run-telemetry gate (``pytest -m trace``).
+
+Covers the tentpole surface end to end on CPU:
+
+* the tracer core — spans, phase accumulators, activation exclusivity,
+  JSONL round-trip + schema validation, Chrome-trace export;
+* the engine wave log — a traced sparse sort-merge run produces one
+  ``wave`` event per wave whose counters reconcile exactly with the
+  checker's final counts, and tracing NEVER changes the counts (the
+  smoke contract: traced paxos check == untraced paxos check);
+* the sharded engine's log (psum'd global counters, enabled_pairs
+  null), the deep level (one wave per chunk, real walls), the
+  auto-budget retry event + warning, and the host-phase spans in the
+  host checkers;
+* the trace differ behind tools/trace_diff.py — wave alignment,
+  per-phase regression thresholds, and the CLI's exit codes;
+* the shared artifact numbering/provenance helper
+  (stateright_tpu/artifacts.py) both exporters ride.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from stateright_tpu import artifacts, telemetry  # noqa: E402
+from stateright_tpu.telemetry import (  # noqa: E402
+    RunTracer,
+    WAVE_LOG_FIELDS,
+    diff_traces,
+    format_diff,
+    load_trace,
+    validate_events,
+    write_artifacts,
+)
+
+pytestmark = pytest.mark.trace
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _twopc_engine(rm=3, **kw):
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    kw.setdefault("capacity", 1 << 10)
+    kw.setdefault("frontier_capacity", 256)
+    kw.setdefault("cand_capacity", 1024)
+    kw.setdefault("track_paths", False)
+    return TwoPhaseSys(rm_count=rm).checker().spawn_tpu_sortmerge(**kw)
+
+
+# -- tracer core ---------------------------------------------------------
+
+
+def test_tracer_spans_events_and_roundtrip(tmp_path):
+    tr = RunTracer()
+    with tr.activate():
+        assert telemetry.current_tracer() is tr
+        tr.begin_run(lane=dict(engine="X"))
+        with telemetry.span("compile", engine="X"):
+            pass
+        acc = tr.phase_acc("property_check")
+        for _ in range(3):
+            with acc:
+                pass
+        tr.event("auto_budget_retry", kind="cand_capacity",
+                 old=8, new=64, attempt=1)
+        tr.end_run(error=None, total_states=5, unique_states=5,
+                   max_depth=2, duration_sec=0.01)
+    assert telemetry.current_tracer() is None
+
+    path = tr.write_jsonl(str(tmp_path / "t.jsonl"))
+    evs = load_trace(path)
+    validate_events(evs)
+    kinds = [e["ev"] for e in evs]
+    assert kinds[0] == "run_begin"
+    assert "span" in kinds and "phase_total" in kinds
+    assert kinds[-1] == "run_end"
+    span = next(e for e in evs if e["ev"] == "span")
+    assert span["phase"] == "compile" and span["dur"] >= 0
+    acc_ev = next(e for e in evs if e["ev"] == "phase_total")
+    assert acc_ev["phase"] == "property_check" and acc_ev["count"] == 3
+    begin = evs[0]
+    # provenance embedded in every run (the satellite contract)
+    assert begin["provenance"]["jax"] == jax.__version__
+    assert begin["provenance"]["backend"] == "cpu"
+    assert begin["lane"] == {"engine": "X"}
+
+    chrome = tr.write_chrome_trace(str(tmp_path / "t.trace.json"))
+    ct = json.load(open(chrome))
+    assert isinstance(ct["traceEvents"], list) and ct["traceEvents"]
+    assert any(e.get("ph") == "X" for e in ct["traceEvents"])
+
+
+def test_tracer_activation_is_exclusive():
+    a, b = RunTracer(), RunTracer()
+    with a.activate():
+        with pytest.raises(RuntimeError):
+            with b.activate():
+                pass
+    # released after exit
+    with b.activate():
+        assert telemetry.current_tracer() is b
+
+
+def test_span_is_noop_without_tracer():
+    with telemetry.span("anything"):
+        pass
+    telemetry.emit("ignored", x=1)  # no tracer: swallowed
+
+
+def test_validate_rejects_inconsistent_wave_counters(tmp_path):
+    tr = RunTracer()
+    with tr.activate():
+        tr.begin_run()
+        tr.record_chunk(
+            chunk=0, wave0=0, t0=0.0, t1=1.0,
+            dispatch_sec=0.1, fetch_sec=0.9,
+            wave_rows=np.array([[1, 2, 2, 2, 10, 1, 0, 0],
+                                [2, 4, 4, 4, 99, 2, 0, 0]]),
+        )
+        tr.end_run()
+    with pytest.raises(ValueError, match="unique_total"):
+        validate_events(tr.events)
+
+
+def test_unknown_level_rejected():
+    with pytest.raises(ValueError):
+        RunTracer(level="verbose")
+
+
+# -- engine wave log (single chip) ---------------------------------------
+
+
+def test_traced_run_counts_unchanged_and_schema_valid(tmp_path):
+    """The smoke contract: a traced sparse engine run explores the
+    SAME space as an untraced one and its artifacts are schema-valid
+    (the paxos lane rides the identical code path; see
+    test_trace_smoke_paxos for the paxos-shaped version)."""
+    c0 = _twopc_engine().join()
+    tr = RunTracer()
+    with tr.activate():
+        c1 = _twopc_engine().join()
+    assert c1.unique_state_count() == c0.unique_state_count() == 288
+    assert c1.state_count() == c0.state_count()
+
+    jsonl, chrome = write_artifacts(tr, root=str(tmp_path))
+    assert os.path.basename(jsonl).startswith("TRACE_r")
+    evs = load_trace(jsonl)
+    validate_events(evs)
+    waves = [e for e in evs if e["ev"] == "wave"]
+    assert waves, "a traced engine run must produce wave events"
+    # exact reconciliation with the checker's final counters
+    assert waves[-1]["unique_total"] == c1.unique_state_count()
+    n0 = waves[0]["unique_total"] - waves[0]["new_states"]
+    assert n0 + sum(w["new_states"] for w in waves) == (
+        c1.unique_state_count()
+    )
+    assert n0 + sum(w["candidates"] for w in waves) == c1.state_count()
+    assert waves[0]["depth"] == 1
+    assert all(w["enabled_pairs"] >= w["candidates"] for w in waves)
+    for field in WAVE_LOG_FIELDS:
+        assert field in waves[0]
+    # lane config names the engine and its budgets
+    lane = evs[0]["lane"]
+    assert lane["engine"] == "SortMergeTpuBfsChecker"
+    assert lane["sparse"] is True
+    ct = json.load(open(chrome))
+    assert any(e.get("name", "").startswith("wave")
+               for e in ct["traceEvents"])
+
+
+def test_trace_smoke_paxos(tmp_path):
+    """Traced ``paxos check`` smoke on CPU (the tier-1-sized 2-client
+    lane; the full check-3/check-4 shapes run the identical traced
+    program and are exercised by the slow-marked test below): JSONL +
+    Chrome artifacts, identical state counts to untraced."""
+    from stateright_tpu.models.paxos import PaxosModelCfg, paxos_model
+
+    def spawn():
+        return (
+            paxos_model(PaxosModelCfg(client_count=2, server_count=3))
+            .checker()
+            .spawn_tpu_sortmerge(
+                capacity=1 << 15,
+                frontier_capacity=1 << 12,
+                cand_capacity=1 << 14,
+                track_paths=False,
+            )
+        )
+
+    c0 = spawn().join()
+    tr = RunTracer()
+    with tr.activate():
+        c1 = spawn().join()
+    assert c1.unique_state_count() == c0.unique_state_count() == 16668
+    jsonl, chrome = write_artifacts(tr, root=str(tmp_path))
+    evs = load_trace(jsonl)
+    validate_events(evs)
+    waves = [e for e in evs if e["ev"] == "wave"]
+    assert waves[-1]["unique_total"] == 16668
+    assert json.load(open(chrome))["traceEvents"]
+
+
+@pytest.mark.slow
+def test_trace_smoke_paxos_check_3(tmp_path):
+    """The full satellite smoke at `paxos check 3` scale (1,194,428
+    states on CPU — slow-marked)."""
+    from stateright_tpu.models.paxos import PaxosModelCfg, paxos_model
+    from stateright_tpu.models.paxos_tpu import STRUCTURAL_SIZES
+
+    def spawn():
+        return (
+            paxos_model(PaxosModelCfg(client_count=3, server_count=3))
+            .checker()
+            .spawn_tpu_sortmerge(
+                track_paths=False, cand_capacity=1 << 22,
+                **STRUCTURAL_SIZES[3],
+            )
+        )
+
+    c0 = spawn().join()
+    tr = RunTracer()
+    with tr.activate():
+        c1 = spawn().join()
+    assert c1.unique_state_count() == c0.unique_state_count() == 1194428
+    jsonl, _ = write_artifacts(tr, root=str(tmp_path))
+    evs = load_trace(jsonl)
+    validate_events(evs)
+    waves = [e for e in evs if e["ev"] == "wave"]
+    assert waves[-1]["unique_total"] == 1194428
+
+
+def test_deep_level_gives_real_per_wave_walls():
+    tr = RunTracer(level="deep")
+    with tr.activate():
+        c = _twopc_engine().join()
+    assert c.unique_state_count() == 288
+    chunks = [e for e in tr.events if e["ev"] == "chunk"]
+    waves = [e for e in tr.events if e["ev"] == "wave"]
+    assert len(chunks) == len(waves)  # one wave per chunk
+    assert all(ch["device_sec"] is not None for ch in chunks)
+    assert all(w["t_est"] is False for w in waves)
+    assert any(e["ev"] == "deep_sync_override" for e in tr.events)
+
+
+def test_traced_sharded_engine_wave_log():
+    from jax.sharding import Mesh
+
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    devices = jax.devices()
+    if len(devices) < 4:
+        pytest.skip("needs the virtual multi-device CPU mesh")
+    mesh = Mesh(np.array(devices[:4]), ("shard",))
+    tr = RunTracer()
+    with tr.activate():
+        c = (
+            TwoPhaseSys(rm_count=3)
+            .checker()
+            .spawn_tpu_sharded_sortmerge(
+                mesh=mesh,
+                capacity=1 << 10,
+                frontier_capacity=256,
+                cand_capacity=1024,
+                bucket_capacity=512,
+                waves_per_sync=8,
+                track_paths=False,
+            )
+            .join()
+        )
+    assert c.unique_state_count() == 288
+    validate_events(tr.events)
+    waves = [e for e in tr.events if e["ev"] == "wave"]
+    assert waves and waves[-1]["unique_total"] == 288
+    # global (psum'd) frontier rows, not per-shard
+    assert waves[0]["frontier_rows"] == 1
+    # the sharded log wrapper can't see the enabled popcount
+    assert all(w["enabled_pairs"] is None for w in waves)
+    assert tr.events[0]["lane"]["n_shards"] == 4
+
+
+def test_auto_budget_retry_event_and_warning(tmp_path):
+    """Satellite: a forced overflow on the geometric capacity ladder
+    must produce a telemetry event AND a one-line warning naming the
+    old/new capacity (the retry used to be silent)."""
+    tr = RunTracer()
+    with tr.activate(), warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        c = _twopc_engine(cand_capacity="auto")
+        c._budget_store = lambda: str(tmp_path / "budgets.json")
+        c.cand_capacity = 8  # force the first wave over budget
+        c.join()
+    assert c.unique_state_count() == 288
+    msgs = [str(w.message) for w in rec
+            if "auto-budget" in str(w.message)]
+    assert msgs and "8 ->" in msgs[0]
+    evs = [e for e in tr.events if e["ev"] == "auto_budget_retry"]
+    assert evs and evs[0]["old"] == 8 and evs[0]["new"] > 8
+    assert evs[0]["kind"] == "cand_capacity"
+    # the clean re-run's waves overwrite the failed attempt's indices:
+    # the final wave still reconciles
+    waves = [e for e in tr.events if e["ev"] == "wave"]
+    assert waves[-1]["unique_total"] == 288
+    # a retried run's trace is a LEGITIMATE artifact: the validator
+    # treats a non-advancing wave index as an attempt restart (and
+    # trace_diff's last-occurrence alignment reads the clean attempt)
+    validate_events(tr.events)
+    rep = diff_traces(tr.events, tr.events)
+    assert not rep["divergences"]
+
+
+def test_untraced_run_keeps_wave_log_out_of_carry():
+    c = _twopc_engine()
+    c.keep_final_carry = True
+    c.join()
+    assert "wlog" not in c._final_carry
+    assert "wv_pairs" not in c._final_carry
+
+
+# -- host-phase spans ----------------------------------------------------
+
+
+def test_host_bfs_phase_totals_and_reconstruction_span():
+    from stateright_tpu.models.increment import Increment
+
+    tr = RunTracer()
+    with tr.activate():
+        c = Increment(thread_count=2).checker().spawn_bfs().join()
+    assert "fin" in c.discoveries()
+    kinds = {e["ev"] for e in tr.events}
+    assert {"run_begin", "run_end"} <= kinds
+    totals = {e["phase"] for e in tr.events if e["ev"] == "phase_total"}
+    assert "property_check" in totals
+    spans = {e["phase"] for e in tr.events if e["ev"] == "span"}
+    assert "counterexample_reconstruction" in spans
+    end = next(e for e in tr.events if e["ev"] == "run_end")
+    assert end["unique_states"] == c.unique_state_count()
+    assert end["error"] is None
+
+
+def test_host_dfs_symmetry_span():
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    tr = RunTracer()
+    with tr.activate():
+        TwoPhaseSys(rm_count=2).checker().symmetry().spawn_dfs().join()
+    totals = {e["phase"] for e in tr.events if e["ev"] == "phase_total"}
+    assert "symmetry_canonicalization" in totals
+    assert "property_check" in totals
+
+
+def test_device_engine_spans_and_chunk_split():
+    tr = RunTracer()
+    with tr.activate():
+        _twopc_engine().join()
+    spans = {e["phase"] for e in tr.events if e["ev"] == "span"}
+    assert {"compile", "seed_upload"} <= spans
+    chunks = [e for e in tr.events if e["ev"] == "chunk"]
+    assert chunks
+    for ch in chunks:
+        assert ch["dispatch_sec"] >= 0 and ch["fetch_sec"] >= 0
+        assert ch["device_sec"] is None  # default level: no extra sync
+
+
+def test_failed_run_ends_with_error():
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    tr = RunTracer()
+    with tr.activate():
+        c = (
+            TwoPhaseSys(rm_count=3)
+            .checker()
+            .spawn_tpu_sortmerge(
+                capacity=64, frontier_capacity=64, cand_capacity=256,
+                track_paths=False,
+            )
+        )
+        with pytest.raises(RuntimeError, match="overflow"):
+            c.join()
+    end = next(e for e in tr.events if e["ev"] == "run_end")
+    assert end["error"] and "overflow" in end["error"]
+
+
+# -- trace diff ----------------------------------------------------------
+
+
+def _synthetic_trace(tmp_path, name, *, fetch=0.9, new=(9, 40, 100),
+                     total=2.0):
+    tr = RunTracer()
+    with tr.activate():
+        tr.begin_run(lane=dict(engine="T"))
+        with telemetry.span("compile"):
+            pass
+        u = 1
+        rows = []
+        for i, n in enumerate(new):
+            u += n
+            rows.append([max(n, 1), n + 2, n + 1, n, u, i + 1, 0, 0])
+        tr.record_chunk(
+            chunk=0, wave0=0, t0=0.0, t1=1.0,
+            dispatch_sec=0.01, fetch_sec=fetch,
+            wave_rows=np.array(rows),
+        )
+        tr.end_run(error=None, total_states=sum(new), unique_states=u,
+                   max_depth=len(new), duration_sec=total)
+    path = str(tmp_path / name)
+    tr.write_jsonl(path)
+    return path
+
+
+def test_trace_diff_clean_and_regression(tmp_path):
+    a = load_trace(_synthetic_trace(tmp_path, "a.jsonl"))
+    b = load_trace(_synthetic_trace(tmp_path, "b.jsonl"))
+    rep = diff_traces(a, b)
+    assert rep["ok"] and not rep["divergences"]
+    assert "verdict: OK" in format_diff(rep)
+
+    slow = load_trace(
+        _synthetic_trace(tmp_path, "slow.jsonl", fetch=2.0, total=4.0)
+    )
+    rep2 = diff_traces(a, slow)
+    assert not rep2["ok"]
+    assert "host_fetch" in rep2["regressions"]
+    assert "run_total" in rep2["regressions"]
+    assert "REGRESSION" in format_diff(rep2)
+    # the faster direction is not a regression
+    assert diff_traces(slow, a)["ok"]
+
+
+def test_trace_diff_wave_divergence(tmp_path):
+    a = load_trace(_synthetic_trace(tmp_path, "a.jsonl"))
+    d = load_trace(
+        _synthetic_trace(tmp_path, "d.jsonl", new=(9, 41, 100))
+    )
+    rep = diff_traces(a, d)
+    assert not rep["ok"]
+    fields = {x["field"] for x in rep["divergences"]}
+    assert "new_states" in fields and "unique_total" in fields
+    assert "DIVERGENCE" in format_diff(rep)
+
+
+def test_trace_diff_cli_exit_codes(tmp_path):
+    a = _synthetic_trace(tmp_path, "a.jsonl")
+    b = _synthetic_trace(tmp_path, "b.jsonl", fetch=2.0, total=4.0)
+    tool = os.path.join(REPO_ROOT, "tools", "trace_diff.py")
+
+    def run(*argv):
+        return subprocess.run(
+            [sys.executable, tool, *argv],
+            capture_output=True, text=True, cwd=REPO_ROOT,
+        )
+
+    ok = run(a, a)
+    assert ok.returncode == 0, ok.stderr
+    assert "verdict: OK" in ok.stdout
+
+    reg = run(a, b)
+    assert reg.returncode == 1
+    assert "REGRESSION" in reg.stdout
+
+    loose = run(a, b, "--threshold", "10.0")
+    assert loose.returncode == 0
+
+    bad = str(tmp_path / "bad.jsonl")
+    with open(bad, "w") as fh:
+        fh.write("not json\n")
+    assert run(a, bad).returncode == 2
+
+
+# -- CLI flag ------------------------------------------------------------
+
+
+def test_cli_pop_trace_flag():
+    from stateright_tpu.cli import _pop_trace_flag
+
+    assert _pop_trace_flag(["paxos", "check", "2"]) == (
+        None, ["paxos", "check", "2"]
+    )
+    assert _pop_trace_flag(["paxos", "--trace", "check-tpu", "4"]) == (
+        "default", ["paxos", "check-tpu", "4"]
+    )
+    assert _pop_trace_flag(["2pc", "check-tpu", "3", "--trace=deep"]) == (
+        "deep", ["2pc", "check-tpu", "3"]
+    )
+
+
+def test_cli_rejects_unknown_trace_level():
+    from stateright_tpu import cli
+
+    with pytest.raises(SystemExit, match="verbose"):
+        cli.main(["increment", "check-tpu", "2", "--trace=verbose"])
+
+
+def test_cli_trace_writes_artifacts_on_failure(tmp_path, monkeypatch):
+    """A traced run that raises must still leave its partial trace
+    (the failure is what the trace is for)."""
+    from stateright_tpu import cli
+
+    monkeypatch.setattr(artifacts, "repo_root", lambda: str(tmp_path))
+
+    def boom(sub, args):
+        from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+        TwoPhaseSys(rm_count=3).checker().spawn_tpu_sortmerge(
+            capacity=64, frontier_capacity=64, cand_capacity=256,
+            track_paths=False,
+        ).join()
+
+    monkeypatch.setitem(cli._MODELS, "2pc", (boom, ["check-tpu"]))
+    with pytest.raises(RuntimeError, match="overflow"):
+        cli.main(["2pc", "check-tpu", "3", "--trace"])
+    written = os.listdir(tmp_path)
+    assert any(f.startswith("TRACE_r") and f.endswith(".jsonl")
+               for f in written)
+    jsonl = next(f for f in written if f.endswith(".jsonl"))
+    evs = load_trace(str(tmp_path / jsonl))
+    end = next(e for e in evs if e["ev"] == "run_end")
+    assert end["error"] and "overflow" in end["error"]
+
+
+def test_cli_trace_writes_artifacts(tmp_path, monkeypatch, capsys):
+    from stateright_tpu import cli
+
+    monkeypatch.setattr(artifacts, "repo_root", lambda: str(tmp_path))
+    cli.main(["increment", "check-tpu", "2", "--trace"])
+    out = capsys.readouterr()
+    assert "Done." in out.out
+    written = sorted(os.listdir(tmp_path))
+    assert any(f.startswith("TRACE_r") and f.endswith(".jsonl")
+               for f in written)
+    assert any(f.endswith(".trace.json") for f in written)
+    jsonl = next(f for f in written if f.endswith(".jsonl"))
+    evs = load_trace(str(tmp_path / jsonl))
+    validate_events(evs)
+    assert any(e["ev"] == "wave" for e in evs)
+
+
+# -- shared artifact numbering / provenance ------------------------------
+
+
+def test_artifact_numbering_shared_across_families(tmp_path):
+    root = str(tmp_path)
+    assert artifacts.next_round(root) == 1
+    open(os.path.join(root, "BENCH_r03.json"), "w").close()
+    open(os.path.join(root, "TRACE_r05.jsonl"), "w").close()
+    assert artifacts.next_round(root) == 6
+    assert artifacts.artifact_path("LINT", "json", root=root).endswith(
+        "LINT_r06.json"
+    )
+    p = artifacts.artifact_path("TRACE", "trace.json", root=root,
+                                round=9)
+    assert p.endswith("TRACE_r09.trace.json")
+
+
+def test_lint_cli_uses_shared_numbering(tmp_path, monkeypatch):
+    """tools/lint_kernels.py --json and the trace exporter share ONE
+    numbering helper: both consult every artifact family."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "lint_kernels", os.path.join(REPO_ROOT, "tools",
+                                     "lint_kernels.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # the module must not have grown a private numbering copy back
+    assert not hasattr(mod, "_next_artifact_path")
+
+
+def test_provenance_block():
+    p = artifacts.provenance(lane={"headline": "x"})
+    assert p["jax"] == jax.__version__
+    assert p["backend"] == "cpu"
+    assert p["device_count"] >= 1
+    assert p["python"]
+    assert p["lane"] == {"headline": "x"}
+    # the repo is a git checkout: the SHA must resolve
+    assert p["git_sha"] and len(p["git_sha"]) == 40

@@ -19,34 +19,22 @@ Usage:
                                               # pricing per engine path
                                               # (slower: compiles on CPU)
 
-The ``--json`` artifact lands alongside the BENCH_r*.json artifacts
-(auto-numbered past the highest existing BENCH/LINT round) so a perf
-round can point at "lint clean at r07" the way it points at its bench
-lane.
+The ``--json`` artifact lands alongside the BENCH_r*.json artifacts,
+auto-numbered past the highest existing round of ANY artifact family
+(the shared helper in stateright_tpu/artifacts.py — the same one the
+telemetry TRACE exporter uses) so a perf round can point at "lint
+clean at r07" the way it points at its bench lane; the artifact embeds
+the standard provenance block (jax/jaxlib, device, git SHA).
 """
 
 import argparse
-import glob
 import json
 import os
-import re
 import sys
 
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
-
-
-def _next_artifact_path(repo_root: str) -> str:
-    """LINT_rNN.json, numbered past every BENCH_r*/LINT_r* round so
-    the lint artifact slots into the same round sequence."""
-    best = 0
-    for pat in ("BENCH_r*.json", "LINT_r*.json"):
-        for p in glob.glob(os.path.join(repo_root, pat)):
-            m = re.search(r"_r(\d+)\.json$", p)
-            if m:
-                best = max(best, int(m.group(1)))
-    return os.path.join(repo_root, f"LINT_r{best + 1:02d}.json")
 
 
 def _hlo_pricing(encodings) -> dict:
@@ -161,11 +149,18 @@ def main():
             print(f"  {name:36s} {h['wall_bytes'] / 1e6:9.2f} MB")
 
     if args.json is not None:
-        repo_root = os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__))
+        from stateright_tpu.artifacts import artifact_path, provenance
+
+        report["provenance"] = provenance(
+            lane=dict(
+                encodings=[s.name for s in specs],
+                engines=args.engines.split(","),
+                wave_body=not args.no_wave_body,
+                hlo=args.hlo,
+            )
         )
         path = (
-            _next_artifact_path(repo_root)
+            artifact_path("LINT", "json")
             if args.json == "auto"
             else args.json
         )

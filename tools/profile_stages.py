@@ -22,6 +22,7 @@ Usage:
 """
 
 import argparse
+import os
 import time
 
 REPS = 8
@@ -498,6 +499,13 @@ def main():
     ap.add_argument("--target", type=int)
     ap.add_argument("--wave-profile", action="store_true")
     ap.add_argument("--wave-wall", action="store_true")
+    ap.add_argument(
+        "--trace", nargs="?", const="default",
+        choices=("default", "deep"), default=None,
+        help="record run telemetry for the profiled engine runs and "
+        "write TRACE_r*.jsonl + TRACE_r*.trace.json artifacts "
+        "(stateright_tpu/telemetry.py)",
+    )
     args = ap.parse_args()
 
     import jax
@@ -529,12 +537,33 @@ def main():
     else:
         raise SystemExit("pass --paxos N or --twopc N")
 
-    if args.wave_profile:
-        wave_profile(kind, n, caps)
-    elif args.wave_wall:
-        wave_wall(kind, n, caps, args.target or default_target)
-    else:
-        stage_profile(kind, n, caps, args.target or default_target)
+    def dispatch():
+        if args.wave_profile:
+            wave_profile(kind, n, caps)
+        elif args.wave_wall:
+            wave_wall(kind, n, caps, args.target or default_target)
+        else:
+            stage_profile(kind, n, caps, args.target or default_target)
+
+    if args.trace is None:
+        dispatch()
+        return
+    import sys as _sys
+
+    _sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from stateright_tpu.telemetry import RunTracer, write_artifacts
+
+    tracer = RunTracer(level=args.trace)
+    try:
+        with tracer.activate():
+            dispatch()
+    finally:
+        # a failed/interrupted profile's partial trace still lands
+        if tracer.events:
+            jsonl, chrome = write_artifacts(tracer)
+            print(f"trace: wrote {jsonl} + {chrome}")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Trace-diff gate: align two TRACE_r*.jsonl run-telemetry artifacts
+wave-by-wave and price the per-phase deltas.
+
+This is the mechanism A/B rounds record their before/after through
+(ROADMAP: the BENCH_r06 chip re-measure, the carry-rework ablation):
+instead of two numbers typed into PERF.md, each side is a trace
+artifact and this tool is the comparison —
+
+* **wave alignment** — the per-wave counters (frontier rows,
+  candidates, new states, running unique total) must MATCH: two
+  traces of the same workload explore the same space, so any
+  divergence means the runs are not comparable (different model,
+  bounds, or a correctness regression) and the gate fails regardless
+  of timing.
+* **per-phase deltas** — host spans (compile, reconstruction,
+  property checks), the chunk dispatch/fetch wall split, the wave
+  wall, and the run total, each reported as A/B/delta/relative.
+* **regression threshold** — exit nonzero when any phase at least
+  ``--min-sec`` long on the A side grew by more than ``--threshold``
+  (relative), or on any wave divergence.
+
+Usage:
+  python tools/trace_diff.py TRACE_r07.jsonl TRACE_r08.jsonl
+  python tools/trace_diff.py a.jsonl b.jsonl --threshold 0.05
+  python tools/trace_diff.py a.jsonl b.jsonl --run-a 0 --run-b 2
+
+Exit status: 0 clean, 1 regression/divergence, 2 bad input.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="diff two TRACE_r*.jsonl run-telemetry artifacts"
+    )
+    ap.add_argument("a", help="baseline trace (JSONL)")
+    ap.add_argument("b", help="candidate trace (JSONL)")
+    ap.add_argument(
+        "--threshold", type=float, default=0.10,
+        help="relative per-phase regression bar (default 0.10 = +10%%)",
+    )
+    ap.add_argument(
+        "--min-sec", type=float, default=0.05,
+        help="ignore phases shorter than this on the A side "
+        "(noise floor, default 0.05s)",
+    )
+    ap.add_argument(
+        "--run-a", type=int, default=None,
+        help="run index inside A (default: the last run)",
+    )
+    ap.add_argument(
+        "--run-b", type=int, default=None,
+        help="run index inside B (default: the last run)",
+    )
+    args = ap.parse_args()
+
+    from stateright_tpu.telemetry import (
+        diff_traces,
+        format_diff,
+        load_trace,
+        validate_events,
+    )
+
+    try:
+        a = load_trace(args.a)
+        b = load_trace(args.b)
+        validate_events(a)
+        validate_events(b)
+    except (OSError, ValueError) as exc:
+        print(f"trace_diff: bad input: {exc}", file=sys.stderr)
+        sys.exit(2)
+
+    try:
+        report = diff_traces(
+            a, b,
+            run_a=args.run_a, run_b=args.run_b,
+            threshold=args.threshold, min_sec=args.min_sec,
+        )
+    except IndexError:
+        print("trace_diff: a file contains no runs", file=sys.stderr)
+        sys.exit(2)
+
+    print(format_diff(report))
+    sys.exit(0 if report["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -493,6 +493,21 @@ def main():
 
         tracer = RunTracer(level=args.trace)
 
+    # LINT cross-reference (round 9): every lane's detail embeds the
+    # newest LINT artifact's carry-copy-bytes totals, so a BENCH
+    # number and the static switch-carry state it was measured under
+    # pair up without hand-matching round numbers (the gated
+    # carry-copy rule, stateright_tpu/analysis/).
+    from stateright_tpu.artifacts import latest_lint_summary
+
+    lint_ref = latest_lint_summary()
+    if lint_ref is not None:
+        _stderr(
+            f"lint ref: {lint_ref['artifact']} "
+            f"carry_copy_bytes={lint_ref['carry_copy_bytes']} "
+            f"clean={lint_ref['clean']}"
+        )
+
     detail = {}
     headline_name, headline_sps = None, 0.0
     loads = tpu_workloads(quick=args.quick)
@@ -524,6 +539,10 @@ def main():
             "unique": unique,
             "sec": round(sec, 4),
             "states_per_sec": round(sps),
+            # name only — the full cross-reference block lives once
+            # in provenance, not N+1 times per artifact line
+            **({"lint": lint_ref["artifact"]}
+               if lint_ref is not None else {}),
         }
         _stderr(
             f"tpu  {name}: unique={unique} sec={sec:.3f} "
@@ -577,7 +596,13 @@ def main():
                 "unit": "states/sec",
                 "vs_baseline": round(headline_sps / host_sps, 2),
                 "sync_floor_ms": sync_floor_ms,
-                "provenance": provenance(lane={"headline": headline_name}),
+                "provenance": provenance(
+                    lane={
+                        "headline": headline_name,
+                        **({"lint": lint_ref}
+                           if lint_ref is not None else {}),
+                    }
+                ),
                 "detail": detail,
             }
         )

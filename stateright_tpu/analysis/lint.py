@@ -4,13 +4,23 @@ For each registered encoding (:mod:`.registry`) the driver traces
 
 * ``bits`` — ``vmap(enabled_bits_vec)``: the word-native mask path
   the sparse engines consume,
+* ``bits[t]`` — the TRANSPOSED invocation of the same path
+  (``enabled_bits_cols`` over a ``[W, N]`` block — the round-9
+  resident layout, registry.TRANSPOSED_PATHS), same rules and
+  allowances,
 * ``mask`` — ``vmap(enabled_mask_vec)``: the dense contract view
   (bool[K] IS its return type, so the dense-mask rule is off; the
   gather rule still applies),
 * ``step`` — ``vmap(step_slot_vec)``: the per-pair transition path,
+* ``step[t]`` / ``step[t1]`` — the transposed-successor pair step
+  in BOTH backend seams (``step_slot_cols_fn``: row states in for
+  the TPU invocation, ``[W, N]`` column states in for the XLA:CPU
+  one; ``[W, N]`` successors out either way), same table-gather
+  allowance,
 * ``engine:single`` — the shared sparse pair pipeline
   (checkers/tpu_sortmerge.py ``sparse_pair_candidates``) exactly as
-  the single-chip engine invokes it,
+  the single-chip engine invokes it — with the ``[W, N]`` frontier
+  (registry.ENGINE_LAYOUT),
 * ``engine:sharded`` — the same pipeline under ``shard_map`` with
   ``axis_name="shard"``, exactly as the sharded engine
   (parallel/engine_sortmerge.py) invokes it,
@@ -47,19 +57,39 @@ LINT_N = 64
 
 
 def trace_encoding_paths(enc, n: int = LINT_N) -> dict:
-    """``{label: ClosedJaxpr}`` for the three per-encoding contract
-    paths, traced at ``n`` batch rows."""
+    """``{label: ClosedJaxpr}`` for the per-encoding contract paths,
+    traced at ``n`` batch rows — the row-major contract views (bits /
+    mask / step) AND the transposed ``[W, N]`` invocations the engines
+    actually run (``bits[t]`` / ``step[t]``, registry.TRANSPOSED_PATHS
+    — the round-9 resident layout; same rules, same allowances)."""
     import jax
     import jax.numpy as jnp
 
+    from ..encoding import enabled_bits_cols, step_slot_cols_fn
+
     vecs = jnp.zeros((n, enc.width), jnp.uint32)
+    vecs_t = jnp.zeros((enc.width, n), jnp.uint32)
     slots = jnp.zeros((n,), jnp.uint32)
     return {
         "bits": jax.make_jaxpr(jax.vmap(enc.enabled_bits_vec))(vecs),
+        "bits[t]": jax.make_jaxpr(
+            lambda v: enabled_bits_cols(enc, v)
+        )(vecs_t),
         "mask": jax.make_jaxpr(jax.vmap(enc.enabled_mask_vec))(vecs),
         "step": jax.make_jaxpr(jax.vmap(enc.step_slot_vec))(
             vecs, slots
         ),
+        # BOTH backend seams of the transposed pair step: states_axis
+        # 0 is the TPU invocation (row states off the seam-transpose
+        # gather), states_axis 1 the XLA:CPU one (resident columns
+        # gathered directly) — the engines pick per backend
+        # (tpu_sortmerge/engine_sortmerge), so the gate must pin both.
+        "step[t]": jax.make_jaxpr(
+            step_slot_cols_fn(enc, states_axis=0)
+        )(vecs, slots),
+        "step[t1]": jax.make_jaxpr(
+            step_slot_cols_fn(enc, states_axis=1)
+        )(vecs_t, slots),
     }
 
 
@@ -98,6 +128,23 @@ def engine_pipe_params(enc, n: int = LINT_N,
     )
 
 
+def engine_trace_operands(enc, n: int = LINT_N) -> tuple:
+    """``(frontier, fval, n_rows)`` of the traced engine invocation —
+    the FULL resident ``[W, 2n]`` carry buffer with the class width
+    ``n`` passed explicitly via ``n_rows``, exactly as both engines
+    call ``sparse_pair_candidates`` since round 9 (capacity > class
+    width on any real run, so the gated jaxpr must slice the larger
+    buffer too: a codegen artifact specific to the n_rows path — a
+    materialized strided-prefix copy, say — has to show up HERE, not
+    first on a chip). Shared by the jaxpr traces and the tool's
+    ``--hlo`` compile pass."""
+    import jax.numpy as jnp
+
+    frontier = jnp.zeros((enc.width, 2 * n), jnp.uint32)
+    fval = jnp.zeros((n,), bool)
+    return frontier, fval, n
+
+
 def trace_engine_pipeline(enc, engine: str = "single",
                           n: int = LINT_N, compact: bool = False):
     """Trace ``sparse_pair_candidates`` at ``n`` frontier rows, in the
@@ -114,15 +161,16 @@ def trace_engine_pipeline(enc, engine: str = "single",
     from ..checkers.tpu_sortmerge import sparse_pair_candidates
 
     params = engine_pipe_params(enc, n, compact)
+    # The [W, N] resident layout (registry.ENGINE_LAYOUT): the traced
+    # pipeline IS the engines' transposed invocation — full carry
+    # buffer, class width via n_rows (engine_trace_operands).
+    frontier, fval, n_rows = engine_trace_operands(enc, n)
 
-    def pipe(frontier, fval, axis_name=None):
+    def pipe(frontier_t, fval, axis_name=None):
         return sparse_pair_candidates(
-            enc, frontier, fval, jnp.bool_(True),
-            axis_name=axis_name, **params,
+            enc, frontier_t, fval, jnp.bool_(True),
+            axis_name=axis_name, n_rows=n_rows, **params,
         )
-
-    frontier = jnp.zeros((n, enc.width), jnp.uint32)
-    fval = jnp.zeros((n,), bool)
     if engine == "single":
         return jax.make_jaxpr(pipe)(frontier, fval)
     if engine != "sharded":
@@ -196,7 +244,10 @@ def trace_wave_body_fixture(track_paths: bool = True):
 def _ctx_for_path(spec: EncodingSpec, enc, label: str,
                   n: int = LINT_N) -> TraceCtx:
     K = enc.max_actions
-    if label == "bits":
+    if label in ("bits", "bits[t]"):
+        # the transposed invocation runs the SAME rules at the same
+        # allowances — the [W, N] batching must not re-grow a gather
+        # or a lane-padded op the row-major view is pinned clean of.
         return TraceCtx(path=label, encoding=spec.name, n=n, k=K,
                         sparse=True, allow_gathers=0,
                         check_lane_alu=True)
@@ -206,7 +257,7 @@ def _ctx_for_path(spec: EncodingSpec, enc, label: str,
         return TraceCtx(path=label, encoding=spec.name, n=n, k=K,
                         sparse=False, allow_gathers=0,
                         check_lane_alu=False)
-    if label == "step":
+    if label in ("step", "step[t]", "step[t1]"):
         return TraceCtx(path=label, encoding=spec.name, n=n, k=K,
                         sparse=False,
                         allow_gathers=spec.max_step_gathers,

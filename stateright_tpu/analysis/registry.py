@@ -18,12 +18,34 @@ varying:
 
 Adding an encoding to the engines means adding a spec here — the
 ``pytest -m lint`` gate then pins its codegen automatically.
+
+**Pipeline layout (round 9, PERF.md §layout).** The engines keep
+resident state in the transposed ``[W, N]`` layout, so the engine
+pipelines below (:data:`ENGINE_LAYOUT`) are traced with a ``[W, N]``
+frontier — there is no row-major resident path left to trace — and
+every encoding's contract paths are traced in BOTH invocation styles:
+the row-major vmap-over-rows contract view (``bits`` / ``step``) and
+the transposed axis-1 batched invocation the engines actually run
+(:data:`TRANSPOSED_PATHS`: ``bits[t]`` plus the pair step in BOTH
+backend seams, ``step[t]`` row-states-in / ``step[t1]``
+column-states-in, via encoding.py's ``*_cols`` adapters). All five
+gated rules run over each.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
 from typing import Callable
+
+#: resident layout of every traced engine pipeline: frontier
+#: ``uint32[W, N]`` (minor dim = rows), matching what the sort-merge
+#: engines pass to ``sparse_pair_candidates`` since round 9.
+ENGINE_LAYOUT = "[W,N]"
+
+#: per-encoding transposed contract paths the lint driver traces in
+#: addition to the row-major views — the ``[W, N]`` invocation of the
+#: mask and step kernels (enabled_bits_cols / step_slot_cols_fn).
+TRANSPOSED_PATHS = ("bits[t]", "step[t]", "step[t1]")
 
 
 @dataclass(frozen=True)

@@ -24,10 +24,14 @@ these artifacts; each rule pins one of them:
   never rebuild a full-capacity tensor by padding/concatenating a
   small class result up to peak shape (the pre-round-6 carry pattern:
   a 2-row tail wave paying the 686k-row peak wave's copies);
-* ``carry-copy-bytes`` — an informational estimator that prices the
-  switch-carry movement ROADMAP names as the next lever: bytes every
-  ``cond``/``switch`` must materialize for its carry, and the
-  carry-movement bytes inside each branch.
+* ``carry-copy-bytes`` — prices the switch-carry movement: bytes
+  every ``cond``/``switch`` must materialize for its carry, and the
+  carry-movement bytes inside each branch. The estimate is an info
+  finding; fixtures listed in ``tables.CARRY_COPY_BYTE_BUDGETS`` are
+  additionally GATED (round 9) — exceeding the per-fixture byte
+  budget is an error, so the round-9 class collapse (PERF.md
+  §layout: 1.42 MB → 0.24 MB per wave on the 2pc fixture) cannot
+  silently regress.
 
 A rule sees the shared walk (:mod:`.walker`) plus a :class:`TraceCtx`
 describing the traced path, and yields :class:`Finding`\\ s. Rules
@@ -43,6 +47,7 @@ from typing import Any, Callable, Iterable, Optional
 from .tables import (
     BRANCH_PAD_CONCAT_GROWTH,
     BRANCH_PAD_CONCAT_MIN_BYTES,
+    CARRY_COPY_BYTE_BUDGETS,
     CARRY_MOVE_PRIMS,
     is_gather,
     output_bytes,
@@ -365,12 +370,14 @@ def _no_branch_pad_concat(ctx: TraceCtx, sites: list) -> Iterable[Finding]:
 
 def _carry_copy_bytes(ctx: TraceCtx, sites: list) -> Iterable[Finding]:
     """Price the carry each ``cond``/``switch`` materializes: the
-    bytes of every branch's returned carry (the movement XLA still
-    performs between classes — ROADMAP's named next lever) plus the
-    carry-movement primitive bytes inside branches. Informational:
-    the number exists so a future carry rework can show the delta
-    statically, the way the round-6 rework showed up in the wave-wall
-    HLO categories."""
+    bytes of every branch's returned carry (the movement XLA performs
+    between classes) plus the carry-movement primitive bytes inside
+    branches. The estimate always lands as an info finding; since
+    round 9 the rule is also GATED — a fixture listed in
+    ``tables.CARRY_COPY_BYTE_BUDGETS`` whose switch-carry total
+    exceeds its budget yields an ERROR, so a refactor can't silently
+    re-inflate the switch carries the round-9 class collapse removed
+    (the 2pc fixture went 1.42 MB → 0.24 MB/wave; PERF.md §layout)."""
     if not ctx.check_branches:
         return
     switch_bytes = 0
@@ -393,6 +400,7 @@ def _carry_copy_bytes(ctx: TraceCtx, sites: list) -> Iterable[Finding]:
     if n_switches == 0:
         return
     top_b, top_nb, top_src = top
+    budget = CARRY_COPY_BYTE_BUDGETS.get(ctx.encoding)
     yield Finding(
         rule="carry-copy-bytes",
         severity="info",
@@ -404,6 +412,8 @@ def _carry_copy_bytes(ctx: TraceCtx, sites: list) -> Iterable[Finding]:
             f"{top_b / 1e6:.2f} MB x {top_nb} branches @ {top_src}); "
             f"{move_bytes / 1e6:.2f} MB of pad/slice/concat/"
             "dynamic_update_slice outputs inside branches"
+            + (f"; budget {budget / 1e6:.2f} MB"
+               if budget is not None else "")
         ),
         primitive="cond",
         source=top_src,
@@ -412,8 +422,33 @@ def _carry_copy_bytes(ctx: TraceCtx, sites: list) -> Iterable[Finding]:
             "switch_carry_bytes": switch_bytes,
             "fattest_switch_bytes": top_b,
             "branch_move_bytes": move_bytes,
+            **({"budget_bytes": budget} if budget is not None else {}),
         },
     )
+    if budget is not None and switch_bytes > budget:
+        yield Finding(
+            rule="carry-copy-bytes",
+            severity="error",
+            encoding=ctx.encoding,
+            path=ctx.path,
+            message=(
+                f"switch-carry bytes {switch_bytes:,} exceed this "
+                f"fixture's budget {budget:,} "
+                "(analysis/tables.CARRY_COPY_BYTE_BUDGETS) — the "
+                "class ladder is copying carry tuples between "
+                "branches again; keep merge cores returning the "
+                "shared SoA result and resident-buffer updates in "
+                "ONE fetch switch per wave (the round-9 collapse, "
+                "PERF.md §layout). Raise the budget only for a "
+                "deliberate, priced carry addition."
+            ),
+            primitive="cond",
+            source=top_src,
+            data={
+                "switch_carry_bytes": switch_bytes,
+                "budget_bytes": budget,
+            },
+        )
 
 
 #: the registry — ``tools/lint_kernels.py`` and ``pytest -m lint``
@@ -462,8 +497,9 @@ RULES: tuple = (
     Rule(
         name="carry-copy-bytes",
         description=(
-            "informational: price the carry bytes each switch "
-            "materializes (ROADMAP's switch-carry-movement lever)"
+            "price the carry bytes each switch materializes; GATED "
+            "against per-fixture byte budgets "
+            "(tables.CARRY_COPY_BYTE_BUDGETS)"
         ),
         run=_carry_copy_bytes,
     ),

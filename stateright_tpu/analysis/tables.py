@@ -69,6 +69,21 @@ PASSTHROUGH_PRIMS = frozenset({
     "stop_gradient",
 })
 
+#: per-fixture budgets for the GATED carry-copy-bytes rule (round 9):
+#: the total bytes the wave body's cond/switch eqns may carry as
+#: branch outputs. The round-9 class collapse (slim merge cores +
+#: one fetch switch per wave + SoA vkeys/plog, PERF.md §layout) took
+#: the 2pc-rm3 fixture from 21 switches / 1,422,204 B to
+#: 9 switches / 244,316 B; the budget sits ~30% above the measured
+#: value so incidental carry additions (a new counter lane) pass but
+#: a structural regression — another full-carry switch boundary, a
+#: re-duplicated parent-log lane — fails the lint loudly instead of
+#: silently re-inflating the wave wall. Keys are the fixture names
+#: the lint driver traces (TraceCtx.encoding).
+CARRY_COPY_BYTE_BUDGETS = {
+    "engine-fixture(2pc-rm3)": 320_000,
+}
+
 
 def is_gather(primitive_name: str) -> bool:
     """The gather classification every audit shares: any primitive

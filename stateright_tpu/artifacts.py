@@ -18,6 +18,7 @@ git SHA, and lane config it was measured under.
 from __future__ import annotations
 
 import glob
+import json
 import os
 import re
 import subprocess
@@ -59,6 +60,125 @@ def artifact_path(stem: str, ext: str = "json",
     if round is None:
         round = next_round(root)
     return os.path.join(root, f"{stem}_r{round:02d}.{ext}")
+
+
+def latest_artifact(stem: str, root: str | None = None) -> str | None:
+    """Path of the highest-round artifact of one family (any
+    extension), or None when the family has no artifacts yet. A round
+    can hold several files (the trace exporter's ``TRACE_rNN.jsonl``
+    + ``TRACE_rNN.trace.json`` pair); ties break to the
+    lexicographically-first basename — deterministic across
+    filesystems, and for the trace pair it picks the JSONL event log
+    over the derived Chrome-trace export."""
+    root = repo_root() if root is None else root
+    best, path = -1, None
+    for p in sorted(glob.glob(os.path.join(root, f"{stem}_r*.*")),
+                    key=os.path.basename):
+        m = re.search(r"_r(\d+)\.", os.path.basename(p))
+        if m and int(m.group(1)) > best:
+            best, path = int(m.group(1)), p
+    return path
+
+
+def latest_lint_summary(root: str | None = None) -> dict | None:
+    """Cross-reference block for the newest ``LINT_r*.json``: the
+    artifact name plus its wave-body ``carry-copy-bytes`` totals (the
+    gated switch-carry metric, analysis/rules.py). bench.py embeds
+    this in every lane's provenance so a BENCH number and the LINT
+    round it was measured against pair up without hand-matching round
+    numbers. Best effort: None when no artifact exists or it predates
+    the estimator."""
+    path = latest_artifact("LINT", root)
+    if path is None:
+        return None
+    # Best effort means structurally too: a hand-edited or truncated
+    # artifact (null data block, string byte counts, findings not a
+    # list) must degrade to None, not abort bench.py at startup.
+    try:
+        with open(path) as fh:
+            report = json.load(fh)
+        # Keyed per fixture (encoding), wave-body path only: the
+        # number CARRY_COPY_BYTE_BUDGETS prices is per-fixture, so a
+        # future second wave-body fixture must not silently turn the
+        # scalar into a cross-fixture sum.
+        per_fix: dict = {}
+        for f in report.get("findings", ()):
+            if (f.get("rule") == "carry-copy-bytes"
+                    and f.get("severity") == "info"
+                    and f.get("path") == "wave-body"):
+                data = f.get("data")
+                if not isinstance(data, dict):
+                    # a stripped data block is "predates the
+                    # estimator", not "measured zero bytes"
+                    continue
+                name = str(f.get("encoding"))
+                c, m = per_fix.get(name, (0, 0))
+                per_fix[name] = (
+                    c + int(data.get("switch_carry_bytes", 0)),
+                    m + int(data.get("branch_move_bytes", 0)),
+                )
+        # Surface the SHA the lint artifact was produced AT — a
+        # consumer (or reader of the BENCH artifact) can then see at
+        # a glance whether the static numbers match the benched
+        # commit, which is the hand-matching this block exists to
+        # eliminate. Guarded like the findings walk: a mangled
+        # provenance field degrades, never aborts.
+        prov = report.get("provenance")
+        lint_sha = (prov.get("git_sha")
+                    if isinstance(prov, dict) else None)
+    except (OSError, ValueError, TypeError, AttributeError, KeyError):
+        return None
+    if not per_fix:
+        return None
+    # The HEAD to compare against is the checkout the artifact lives
+    # in (the root argument), not necessarily this package's repo —
+    # and an unanswerable HEAD (no git) means "unknown", not False.
+    # A DIRTY tree also means "unknown": the artifact may have been
+    # measured on uncommitted code HEAD says nothing about, so a
+    # bare sha match would claim a pairing the commit can't back.
+    repo = repo_root() if root is None else root
+    head = _git_sha(repo)
+    dirty = _git_dirty(repo)
+    out = {
+        "artifact": os.path.basename(path),
+        "clean": bool(report.get("clean")),
+        "git_sha": lint_sha,
+        "sha_matches_head": (
+            lint_sha == head
+            if lint_sha is not None and head is not None
+            and dirty is False
+            else None
+        ),
+    }
+    if len(per_fix) == 1:
+        ((carry, move),) = per_fix.values()
+        out["carry_copy_bytes"] = carry
+        out["branch_move_bytes"] = move
+    else:
+        # ambiguous as a scalar — expose the per-fixture breakdown
+        # instead of a sum no budget entry corresponds to
+        out["carry_copy_bytes"] = None
+        out["branch_move_bytes"] = None
+        out["fixtures"] = {
+            name: {"carry_copy_bytes": c, "branch_move_bytes": m}
+            for name, (c, m) in sorted(per_fix.items())
+        }
+    return out
+
+
+def _git_dirty(root: str) -> bool | None:
+    """True when the working tree has uncommitted changes, False when
+    clean, None when git can't answer."""
+    try:
+        out = subprocess.run(
+            ["git", "status", "--porcelain"],
+            cwd=root, capture_output=True, text=True, timeout=10,
+        )
+        if out.returncode == 0:
+            return bool(out.stdout.strip())
+    except (OSError, subprocess.SubprocessError):
+        pass
+    return None
 
 
 def _git_sha(root: str) -> str | None:

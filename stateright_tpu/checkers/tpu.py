@@ -109,6 +109,20 @@ def step_with_trunc(enc, rows, jnp):
     return succs, valid, jnp.zeros(rows.shape[0], dtype=bool)
 
 
+def _props_and_ebits(cond_raw, F, fval, ebits, n_props, evt_idx, jnp):
+    """The shared tail of both frontier_props variants: mask the
+    property bitmap to live rows (bfs.rs:223-268) and clear satisfied
+    eventually-bits (checker.rs:559-566) — one body so the row-major
+    and transposed entry points cannot drift."""
+    if n_props:
+        cond = cond_raw & fval[:, None]
+    else:
+        cond = jnp.zeros((F, 0), dtype=bool)
+    for i in evt_idx:
+        ebits = jnp.where(cond[:, i], ebits & ~jnp.uint32(1 << i), ebits)
+    return cond, ebits
+
+
 def frontier_props(enc, props, evt_idx, frontier, fval, ebits):
     """The step-free half of a wave: frontier fingerprints, the
     property bitmap, and eventually-bit clearing (shared between the
@@ -123,18 +137,43 @@ def frontier_props(enc, props, evt_idx, frontier, fval, ebits):
 
     F = frontier.shape[0]
     n_props = len(props)
-
     f_lo, f_hi = fingerprint_u32v(frontier, jnp)
+    cond_raw = (
+        jax.vmap(enc.property_conditions_vec)(frontier)
+        if n_props else None
+    )
+    cond, ebits = _props_and_ebits(
+        cond_raw, F, fval, ebits, n_props, evt_idx, jnp
+    )
+    return cond, ebits, f_lo, f_hi
 
-    # Property bitmap over the frontier (bfs.rs:223-268).
-    if n_props:
-        cond = jax.vmap(enc.property_conditions_vec)(frontier)
-        cond = cond & fval[:, None]
-    else:
-        cond = jnp.zeros((F, 0), dtype=bool)
-    # Clear satisfied eventually-bits (checker.rs:559-566).
-    for i in evt_idx:
-        ebits = jnp.where(cond[:, i], ebits & ~jnp.uint32(1 << i), ebits)
+
+def frontier_props_t(enc, props, evt_idx, frontier_t, fval, ebits):
+    """Transposed-resident variant of :func:`frontier_props`:
+    ``frontier_t`` is the column-major ``uint32[W, F]`` block the
+    sort-merge engines carry (PERF.md §layout). The fingerprint fold
+    runs lane-major (``fingerprint_u32v_t`` — the measured 1.65x
+    coalesced fold) and the property bitmap batches over axis 1, so
+    no transpose of the resident buffer is ever materialized here;
+    the mask/ebits tail is the SAME ``_props_and_ebits`` body.
+
+    Returns ``(cond[F, P], ebits[F], f_lo[F], f_hi[F])`` — identical
+    values to ``frontier_props(frontier_t.T, ...)``."""
+    import jax.numpy as jnp
+
+    from ..encoding import property_conditions_cols
+    from ..ops.fingerprint import fingerprint_u32v_t
+
+    F = frontier_t.shape[1]
+    n_props = len(props)
+    f_lo, f_hi = fingerprint_u32v_t(frontier_t, jnp)
+    cond_raw = (
+        property_conditions_cols(enc, frontier_t)
+        if n_props else None
+    )
+    cond, ebits = _props_and_ebits(
+        cond_raw, F, fval, ebits, n_props, evt_idx, jnp
+    )
     return cond, ebits, f_lo, f_hi
 
 

@@ -55,6 +55,33 @@ per chunk:
 Everything else — the device-resident multi-wave ``lax.while_loop``,
 packed-stats chunk sync, properties/EventuallyBits/discovery logic —
 is shared with :mod:`stateright_tpu.checkers.tpu`.
+
+**Transposed resident layout + collapsed ladder carries (round 9,
+PERF.md §layout).** Resident state lives COLUMN-major:
+
+* the frontier is ``uint32[W, F]`` (minor dim = rows, so the
+  T(8,128) tile tax on every elementwise/fold pass — and on every
+  carry copy the class-ladder switches materialize — vanishes; the
+  fingerprint fold measured 1.65x col-major on chip),
+* the visited keys are one SoA block ``vkeys: uint32[2, C_pad]``
+  (lane 0 = lo limb, lane 1 = hi limb),
+* the parent log carries PARENT limbs only, ``plog: uint32[2, L]`` —
+  the child keys are exactly the visited append, so the drain
+  derives them from ``vkeys`` instead of carrying them twice.
+
+Boundary transposes happen only at host upload/download and at the
+table-gather seams where row-major genuinely wins (PERF.md §gathers:
+payload gathers measured equal either way, so gather staging keeps
+``[N, W]`` rows; the per-wave ``frontier_t.T`` feeding the pair-step
+row gathers is the one sanctioned seam copy).
+
+The (f, v) class ladder no longer copies full carry tuples between
+branches: the v-class switch runs a merge CORE returning one shared
+SoA result (``nf_pos[NF]`` + ``new_count`` — a few KB regardless of
+class), a single fetch-class switch per wave updates the resident
+buffers with class-local ``dynamic_update_slice`` blocks, and the
+next carry is assembled outside any switch. The ``carry-copy-bytes``
+lint rule (now GATED, analysis/tables.py budgets) pins the collapse.
 """
 
 from __future__ import annotations
@@ -64,17 +91,18 @@ import numpy as np
 from ..encoding import (
     SparseEncodedModel,
     has_trivial_boundary,
-    normalize_step_slot_result,
+    pair_step_seam,
+    within_boundary_cols,
 )
 from ..model import Expectation
 from ..ops.bitmask import mask_words
-from ..ops.fingerprint import fingerprint_u32v
+from ..ops.fingerprint import fingerprint_u32v, fingerprint_u32v_t
 from ..ops.u64 import U64, u64_add
 from .tpu import (
     TpuBfsChecker,
     discovery_update,
     expand_frontier,
-    frontier_props,
+    frontier_props_t,
 )
 
 _SENT = 0xFFFFFFFF
@@ -134,15 +162,97 @@ def _divisor_at_least(n: int, want: int) -> int:
     return d
 
 
-def sparse_pair_candidates(enc, frontier_f, fval_f, expand, *, EV, B_p,
+def frontier_enabled_bits(enc, frontier_t, fval_f, expand, *,
+                          mask_budget_cells, n_rows=None, pv=None):
+    """The enabled-bitmap pass of :func:`sparse_pair_candidates` —
+    per-row packed ``uint32[F_f, L]`` words plus per-row enabled
+    counts over the transposed ``[W, F]`` block, tiled through a
+    ``fori_loop`` when ``F_f * K`` exceeds the mask-cell budget (so
+    the dense ``[F, K]`` bool never materializes at large F).
+
+    ONE home shared with tools/profile_stages.py's mask stage, the
+    same way ``encoding.pair_step_seam`` is the one home of the pair
+    gather seam: a mask-path change that lands here is the pipeline
+    the profiler times, by construction — no hand-synced mirror to
+    drift. ``pv`` marks loop-carry seeds shard-varying under
+    ``shard_map`` (identity otherwise)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..encoding import enabled_bits_cols, enabled_mask_cols
+    from ..ops.bitmask import mask_to_words, popcount_words
+
+    if pv is None:
+        pv = lambda x: x  # noqa: E731 — identity outside shard_map
+    W = frontier_t.shape[0]
+    F_f = int(n_rows) if n_rows is not None else frontier_t.shape[1]
+    K = enc.max_actions
+    L = mask_words(K)
+    bits_fn = getattr(enc, "enabled_bits_vec", None)
+
+    def mask_bits(tf_t, tfv):
+        if bits_fn is not None:
+            tb = enabled_bits_cols(enc, tf_t)
+            tb = jnp.where(expand, tb, jnp.uint32(0))
+            tb = jnp.where(tfv[:, None], tb, jnp.uint32(0))
+            return tb, popcount_words(jnp, tb)
+        m = enabled_mask_cols(enc, tf_t)
+        m = m & tfv[:, None] & expand
+        tc = jnp.sum(m, axis=1, dtype=jnp.uint32)
+        return mask_to_words(jnp, m), tc
+
+    if F_f * K > mask_budget_cells:
+        NTm = _divisor_at_least(F_f, -(-F_f * K // mask_budget_cells))
+        Tm = F_f // NTm
+
+        def mtile(ti, acc):
+            bits_a, cnt_a = acc
+            off = ti * Tm
+            tf_t = lax.dynamic_slice(frontier_t, (0, off), (W, Tm))
+            tfv = lax.dynamic_slice(fval_f, (off,), (Tm,))
+            tb, tc = mask_bits(tf_t, tfv)
+            bits_a = lax.dynamic_update_slice(bits_a, tb, (off, 0))
+            cnt_a = lax.dynamic_update_slice(cnt_a, tc, (off,))
+            return bits_a, cnt_a
+
+        return lax.fori_loop(
+            0,
+            NTm,
+            mtile,
+            (
+                pv(jnp.zeros((F_f, L), jnp.uint32)),
+                pv(jnp.zeros(F_f, jnp.uint32)),
+            ),
+        )
+    # untiled: the class view fuses into the elementwise mask pass
+    # (no loop operand, so no materialized copy)
+    return mask_bits(frontier_t[:, :F_f], fval_f)
+
+
+def sparse_pair_candidates(enc, frontier_t, fval_f, expand, *, EV, B_p,
                            NT, T, mask_budget_cells, Ba,
-                           axis_name=None):
+                           axis_name=None, n_rows=None):
     """The sparse-dispatch pair pipeline, shared by the single-chip and
     sharded sort-merge engines (PERF.md §sparse): per-slot enabled
     mask → per-row bitmaps (tiled so the [F, K] bool mask never
     materializes at large F) → lowest-set-bit peel into ≤EV slots per
     row → tiled 1-lane packed-append compaction into a [Ba] buffer of
     pair indices.
+
+    ``frontier_t`` is the TRANSPOSED resident block ``uint32[W, F_f]``
+    (PERF.md §layout): the enabled predicate batches over axis 1
+    (``enabled_bits_cols`` — per-state lane reads become contiguous
+    row slices of the [W, N] block) and everything downstream of the
+    bitmap is row-count-indexed exactly as before.
+
+    ``n_rows`` lets an engine pass its FULL resident ``[W, F]``
+    buffer with the class width F_f given explicitly: a column-prefix
+    slice of the transposed layout is STRIDED, and a strided slice
+    that becomes a ``fori_loop`` operand (the tiled mask loop below)
+    forces XLA to materialize a per-wave copy of the whole class
+    prefix — the full carry buffer aliases for free. Tile slices are
+    taken from the full buffer at class-bounded offsets; only the
+    untiled elementwise mask pass (which fuses) sees a sliced view.
 
     Encodings that build the packed words directly
     (``enabled_bits_vec`` — the compiled actor codegen) skip the dense
@@ -162,19 +272,14 @@ def sparse_pair_candidates(enc, frontier_f, fval_f, expand, *, EV, B_p,
     predicate, peel, and packed-append compaction are elementwise +
     sort only (stateright_tpu/analysis/).
     """
-    import jax
     import jax.numpy as jnp
     from jax import lax
 
-    from ..ops.bitmask import mask_to_words, popcount_words
-
-    F_f = frontier_f.shape[0]
-    W = frontier_f.shape[1]
+    F_f = int(n_rows) if n_rows is not None else frontier_t.shape[1]
     K = enc.max_actions
     L = mask_words(K)
     NPg = F_f * EV
     compaction = NPg > B_p
-    bits_fn = getattr(enc, "enabled_bits_vec", None)
 
     def pv(x):
         """Inside shard_map, fori_loop carries seeded from constants
@@ -185,42 +290,10 @@ def sparse_pair_candidates(enc, frontier_f, fval_f, expand, *, EV, B_p,
             return x
         return lax.pvary(x, axis_name)
 
-    def mask_bits(tf, tfv):
-        if bits_fn is not None:
-            tb = jax.vmap(bits_fn)(tf)
-            tb = jnp.where(expand, tb, jnp.uint32(0))
-            tb = jnp.where(tfv[:, None], tb, jnp.uint32(0))
-            return tb, popcount_words(jnp, tb)
-        m = jax.vmap(enc.enabled_mask_vec)(tf)
-        m = m & tfv[:, None] & expand
-        tc = jnp.sum(m, axis=1, dtype=jnp.uint32)
-        return mask_to_words(jnp, m), tc
-
-    if F_f * K > mask_budget_cells:
-        NTm = _divisor_at_least(F_f, -(-F_f * K // mask_budget_cells))
-        Tm = F_f // NTm
-
-        def mtile(ti, acc):
-            bits_a, cnt_a = acc
-            off = ti * Tm
-            tf = lax.dynamic_slice(frontier_f, (off, 0), (Tm, W))
-            tfv = lax.dynamic_slice(fval_f, (off,), (Tm,))
-            tb, tc = mask_bits(tf, tfv)
-            bits_a = lax.dynamic_update_slice(bits_a, tb, (off, 0))
-            cnt_a = lax.dynamic_update_slice(cnt_a, tc, (off,))
-            return bits_a, cnt_a
-
-        bits, cnt = lax.fori_loop(
-            0,
-            NTm,
-            mtile,
-            (
-                pv(jnp.zeros((F_f, L), jnp.uint32)),
-                pv(jnp.zeros(F_f, jnp.uint32)),
-            ),
-        )
-    else:
-        bits, cnt = mask_bits(frontier_f, fval_f)
+    bits, cnt = frontier_enabled_bits(
+        enc, frontier_t, fval_f, expand,
+        mask_budget_cells=mask_budget_cells, n_rows=n_rows, pv=pv,
+    )
     n_pairs = jnp.sum(cnt, dtype=jnp.uint32)
     pair_ovf = jnp.any(cnt > jnp.uint32(EV)) | (
         n_pairs > jnp.uint32(B_p)
@@ -719,13 +792,20 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         C_pad = C + F
 
         def seed(init_rows):
+            # Host upload boundary: init states arrive row-major and
+            # transpose ONCE into the [W, F] resident layout (PERF.md
+            # §layout — boundary transposes live here and at the
+            # gather seams only).
             lo0, hi0 = fingerprint_u32v(init_rows, jnp)
             lo0, hi0 = clamp_keys(lo0, hi0)
-            v_hi = jnp.full(C_pad, _SENT, jnp.uint32).at[:n0].set(hi0)
-            v_lo = jnp.full(C_pad, _SENT, jnp.uint32).at[:n0].set(lo0)
-            frontier = jnp.zeros((F, W), dtype=jnp.uint32).at[:n0].set(
-                init_rows
+            vkeys = (
+                jnp.full((2, C_pad), _SENT, jnp.uint32)
+                .at[0, :n0].set(lo0)
+                .at[1, :n0].set(hi0)
             )
+            frontier = jnp.zeros((W, F), dtype=jnp.uint32).at[
+                :, :n0
+            ].set(init_rows.T)
             fval = jnp.arange(F) < n0
             ebits = jnp.where(fval, jnp.uint32(ebits_init), jnp.uint32(0))
             extra = (
@@ -737,13 +817,9 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 else {}
             )
             return dict(
-                v_lo=v_lo,
+                vkeys=vkeys,
                 **extra,
-                v_hi=v_hi,
-                pl_child_lo=jnp.zeros(L, jnp.uint32),
-                pl_child_hi=jnp.zeros(L, jnp.uint32),
-                pl_par_lo=jnp.zeros(L, jnp.uint32),
-                pl_par_hi=jnp.zeros(L, jnp.uint32),
+                plog=jnp.zeros((2, L), jnp.uint32),
                 pl_n=jnp.uint32(0),
                 frontier=frontier,
                 fval=fval,
@@ -795,252 +871,240 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             return (F_f, FK, NT, T, Bt, B_eff, Ba, B_class, compaction,
                     full_flat)
 
-        def make_merge(c, vc, B_eff, ck_lo, ck_hi, fetch, n_cand,
-                       disc_found, disc_lo, disc_hi, c_overflow,
-                       e_overflow, max_tile_cand, max_rowen=None,
-                       wv_pairs=None):
-            """The merge stage for visited-prefix class vc: one stable
-            3-lane merge sort (visited-first ⇒ first-of-run wins and
-            intra-wave duplicates resolve for free), a 1-lane
-            frontier-compaction sort, and a sentinel-padded block
-            APPEND of the winners' keys (the unsorted-visited design —
-            see the C_pad notes above; the former 2-lane rebuild sort
-            is gone).
+        def merge_stage(c, v_class, B_eff, ck_lo, ck_hi, fetch, n_cand,
+                        disc_found, disc_lo, disc_hi, c_overflow,
+                        e_overflow, max_tile_cand, max_rowen=None,
+                        wv_pairs=None):
+            """The class-collapsed merge (round 9, PERF.md §layout).
 
-            ``fetch(nf_row)`` returns ``(state_rows, par_lo, par_hi,
-            row_ebits, key_lo, key_hi)`` — the winners' fingerprint
-            keys ride the SAME packed gather as their payload (round
-            5): a device trace showed 74% of chunk time in gather
-            fusions at ~12ns/row REGARDLESS of lane count, so each
-            same-index table must be one multi-lane gather, never N
-            scalar gathers (PERF.md §gathers)."""
-            V_v = v_ladder[vc]
-            M = V_v + B_eff
+            Round 6's shape nested THREE full-carry switch boundaries
+            per wave — a v-class merge switch inside every f-branch
+            and a fetch-class switch inside every merge branch, each
+            branch returning the WHOLE updated carry — so XLA
+            materialized the full carry tuple at every boundary (the
+            ~21-switch / 1.4 MB-per-wave term the carry-copy-bytes
+            lint priced on the 2pc fixture). Now:
 
-            def merge(_):
-                m_hi = jnp.concatenate([c["v_hi"][:V_v], ck_hi])
-                m_lo = jnp.concatenate([c["v_lo"][:V_v], ck_lo])
-                m_pos = jnp.concatenate(
-                    [
-                        jnp.zeros(V_v, jnp.uint32),
-                        jnp.arange(1, B_eff + 1, dtype=jnp.uint32),
-                    ]
-                )
-                m_hi, m_lo, m_pos = lax.sort(
-                    (m_hi, m_lo, m_pos), num_keys=2
-                )
-                real = ~(
-                    (m_hi == jnp.uint32(_SENT)) & (m_lo == jnp.uint32(_SENT))
-                )
-                prev_same = jnp.concatenate(
-                    [
-                        jnp.zeros(1, bool),
-                        (m_hi[1:] == m_hi[:-1]) & (m_lo[1:] == m_lo[:-1]),
-                    ]
-                )
-                is_new = real & ~prev_same & (m_pos > 0)
-                new_count = jnp.sum(is_new)
-                overflow = c["overflow"] | (
-                    c["new"] + new_count.astype(jnp.uint32)
-                    > jnp.uint32(C)
-                )
+            * the v-ladder switch runs a merge CORE that never touches
+              the carry: one stable 3-lane merge sort (visited-first ⇒
+              first-of-run wins, intra-wave duplicates resolve for
+              free) plus the 1-lane winner-position sort, returning
+              ONE shared SoA result — ``(nf_pos[NF], new_count)`` — a
+              few KB regardless of class; all M-sized tensors stay
+              branch-internal;
+            * ONE fetch-class switch per wave (the third ladder axis,
+              sized to this wave's new_count) gathers the winners and
+              updates the four resident buffers — frontier, ebits,
+              ``vkeys``, ``plog`` — with class-local
+              ``dynamic_update_slice`` blocks; rows past the block
+              keep stale values, which ``fval`` masks everywhere (the
+              invariant the sentinel tails already relied on);
+            * the next carry is assembled OUTSIDE any switch.
 
-                # Compact the new states' candidate positions into the
-                # next frontier (new rows first, in candidate order).
-                # Fetch width: the payload gather is the merge's
-                # costliest op at big shapes (paxos-5: a static
-                # min(F, B_eff)=1.57M-row gather cost ~62ms/wave while
-                # typical waves produced ~120k new states), so the
-                # fetch runs under its own class switch sized to THIS
-                # wave's new_count — the third ladder axis, after the
-                # frontier and visited classes.
-                NF = min(F, B_eff)
-                nf_pos = jnp.where(is_new, m_pos, jnp.uint32(_SENT))
-                (nf_pos,) = lax.sort((nf_pos,), num_keys=1)
-                # M = V_v + B_eff >= B_eff >= NF, so the slice always
-                # has enough rows.
-                nf_ladder = [n for n in f_ladder if n < NF] + [NF]
-                nf_class = jnp.int32(0)
-                for n in nf_ladder[:-1]:
-                    nf_class = nf_class + (
-                        new_count > n
-                    ).astype(jnp.int32)
-                f_overflow = c["f_overflow"] | (new_count > F)
+            ``fetch(nf_row)`` returns ``(state_cols[W, n], par_lo,
+            par_hi, row_ebits, key_lo, key_hi)`` — winner states come
+            back COLUMN-major, matching the ``[W, F]`` resident
+            frontier's block write (the recompute fetch produces this
+            natively; gather-seam fetches transpose their row-major
+            winner block once, the sanctioned seam copy). The keys
+            still ride the SAME packed gather as the payload (PERF.md
+            §gathers: one multi-lane gather, never N scalar
+            gathers)."""
+            NF = min(F, B_eff)
 
-                # Class-local carries (round 6, PERF.md §wave-wall):
-                # each fetch-class branch updates the CARRIED buffers
-                # in place with dynamic_update_slice blocks of its OWN
-                # class size NF_c — frontier rows, ebits, the visited
-                # key append, and the parent log all touch NF_c rows
-                # instead of reconstructing peak-shape tensors (the
-                # old branches padded every output to full F with
-                # concats, so a 2-row tail wave paid the same carry
-                # copies as the 686k-row peak wave). Rows past NF_c
-                # keep stale values; fval masks them everywhere (the
-                # same invariant the sentinel tails of the visited
-                # append already relied on).
-                def make_fetch(NF_c):
-                    def br(_):
-                        pos = nf_pos[:NF_c]
-                        valid = jnp.arange(NF_c) < new_count
-                        nf_row = jnp.where(
-                            valid, pos - 1, jnp.uint32(0)
-                        )
-                        (state_rows, par_lo, par_hi, row_ebits,
-                         key_lo, key_hi) = fetch(nf_row)
-                        z = jnp.uint32(0)
-                        frontier2 = lax.dynamic_update_slice(
-                            c["frontier"],
-                            jnp.where(valid[:, None], state_rows,
-                                      jnp.uint32(0)),
-                            (z, z),
-                        )
-                        ebits2 = lax.dynamic_update_slice(
-                            c["ebits"],
-                            jnp.where(valid, row_ebits, 0),
-                            (z,),
-                        )
-                        # Visited append: the winners' keys as one
-                        # contiguous sentinel-padded block at the
-                        # running unique-count offset (no sort, no
-                        # scatter; keys came packed with the payload
-                        # gather).
-                        v_lo2 = lax.dynamic_update_slice(
-                            c["v_lo"],
-                            jnp.where(valid, key_lo,
-                                      jnp.uint32(_SENT)),
-                            (c["new"],),
-                        )
-                        v_hi2 = lax.dynamic_update_slice(
-                            c["v_hi"],
-                            jnp.where(valid, key_hi,
-                                      jnp.uint32(_SENT)),
-                            (c["new"],),
-                        )
-                        # Parent-log append: contiguous block write at
-                        # the running offset (no scatter); rows past
-                        # new_count are garbage the next block
-                        # overwrites.
-                        if track_paths:
-                            off = (c["pl_n"],)
-                            pc_lo = lax.dynamic_update_slice(
-                                c["pl_child_lo"],
-                                jnp.where(valid, key_lo, 0), off,
-                            )
-                            pc_hi = lax.dynamic_update_slice(
-                                c["pl_child_hi"],
-                                jnp.where(valid, key_hi, 0), off,
-                            )
-                            pp_lo = lax.dynamic_update_slice(
-                                c["pl_par_lo"],
-                                jnp.where(valid, par_lo, 0), off,
-                            )
-                            pp_hi = lax.dynamic_update_slice(
-                                c["pl_par_hi"],
-                                jnp.where(valid, par_hi, 0), off,
-                            )
-                        else:
-                            pc_lo = c["pl_child_lo"]
-                            pc_hi = c["pl_child_hi"]
-                            pp_lo = c["pl_par_lo"]
-                            pp_hi = c["pl_par_hi"]
-                        return (frontier2, ebits2, v_lo2, v_hi2,
-                                pc_lo, pc_hi, pp_lo, pp_hi)
-                    return br
+            def merge_core(vc):
+                V_v = v_ladder[vc]
 
-                (next_frontier, next_ebits, v_lo_new, v_hi_new,
-                 pl_child_lo, pl_child_hi, pl_par_lo,
-                 pl_par_hi) = lax.switch(
-                    nf_class,
-                    [make_fetch(n) for n in nf_ladder],
-                    0,
-                )
-                nf_valid_f = jnp.arange(F) < new_count
-                if track_paths:
-                    # Clamp to the NF rows the largest block write can
-                    # hold: on an f_overflow wave new_count can exceed
-                    # it, and _run raises before reconstruction — but
-                    # the live-count invariant should hold regardless.
-                    pl_n = c["pl_n"] + jnp.minimum(
-                        new_count.astype(jnp.uint32), jnp.uint32(NF)
+                def br(_):
+                    m_hi = jnp.concatenate([c["vkeys"][1, :V_v], ck_hi])
+                    m_lo = jnp.concatenate([c["vkeys"][0, :V_v], ck_lo])
+                    m_pos = jnp.concatenate(
+                        [
+                            jnp.zeros(V_v, jnp.uint32),
+                            jnp.arange(1, B_eff + 1, dtype=jnp.uint32),
+                        ]
                     )
-                else:
-                    pl_n = c["pl_n"]
-
-                g = u64_add(
-                    U64(c["gen_lo"], c["gen_hi"]),
-                    U64(n_cand.astype(jnp.uint32), jnp.uint32(0)),
-                )
-                new = c["new"] + new_count.astype(jnp.uint32)
-                all_disc = (
-                    jnp.all(disc_found) if n_props else jnp.bool_(False)
-                )
-                if target_states is None:
-                    target_hit = jnp.bool_(False)
-                else:
-                    target_hit = new >= jnp.uint32(target_states)
-                cont = (
-                    (new_count > 0)
-                    & ~all_disc
-                    & ~target_hit
-                    & ~overflow
-                    & ~f_overflow
-                    & ~c_overflow
-                    & ~e_overflow
-                )
-                trace_extra = {}
-                if trace_log:
-                    # The wave log rides the carry untouched here; the
-                    # body wrapper writes this wave's row after the
-                    # switch returns. wv_pairs surfaces the wave's
-                    # enabled-pair popcount (sparse) / candidate count
-                    # (dense) to that wrapper.
-                    trace_extra = dict(
-                        wlog=c["wlog"],
-                        wv_pairs=(n_cand if wv_pairs is None
-                                  else wv_pairs).astype(jnp.uint32),
+                    m_hi, m_lo, m_pos = lax.sort(
+                        (m_hi, m_lo, m_pos), num_keys=2
                     )
-                return dict(
-                    **trace_extra,
-                    v_lo=v_lo_new,
-                    v_hi=v_hi_new,
-                    pl_child_lo=pl_child_lo,
-                    pl_child_hi=pl_child_hi,
-                    pl_par_lo=pl_par_lo,
-                    pl_par_hi=pl_par_hi,
-                    pl_n=pl_n,
-                    frontier=next_frontier,
-                    fval=nf_valid_f & cont,
-                    ebits=next_ebits,
-                    # The true row count even when the run stops (the
-                    # wave loop gates on done/fval, so this is safe) —
-                    # frontier rows past the class-local block are
-                    # STALE now, so tooling that reruns stages on a
-                    # captured carry (tools/profile_stages.py) reads
-                    # the live-row count here instead of scanning for
-                    # zero rows.
-                    n_frontier=new_count.astype(jnp.uint32),
-                    depth=jnp.where(cont, c["depth"] + 1, c["depth"]),
-                    wchunk=c["wchunk"] + 1,
-                    waves=c["waves"] + 1,
-                    gen_lo=g.lo,
-                    gen_hi=g.hi,
-                    new=new,
-                    disc_found=disc_found,
-                    disc_lo=disc_lo,
-                    disc_hi=disc_hi,
-                    overflow=overflow,
-                    f_overflow=f_overflow,
-                    c_overflow=c_overflow,
-                    e_overflow=e_overflow,
-                    max_cand=jnp.maximum(c["max_cand"], n_cand),
-                    max_tile_cand=max_tile_cand,
-                    max_rowen=(c["max_rowen"] if max_rowen is None
-                               else max_rowen),
-                    done=~cont,
-                )
+                    real = ~(
+                        (m_hi == jnp.uint32(_SENT))
+                        & (m_lo == jnp.uint32(_SENT))
+                    )
+                    prev_same = jnp.concatenate(
+                        [
+                            jnp.zeros(1, bool),
+                            (m_hi[1:] == m_hi[:-1])
+                            & (m_lo[1:] == m_lo[:-1]),
+                        ]
+                    )
+                    is_new = real & ~prev_same & (m_pos > 0)
+                    new_count = jnp.sum(is_new)
+                    nf_pos = jnp.where(is_new, m_pos, jnp.uint32(_SENT))
+                    (nf_pos,) = lax.sort((nf_pos,), num_keys=1)
+                    # M = V_v + B_eff >= B_eff >= NF, so the slice
+                    # always has enough rows.
+                    return nf_pos[:NF], new_count
 
-            return merge
+                return br
+
+            nf_pos, new_count = lax.switch(
+                v_class,
+                [merge_core(vc) for vc in range(len(v_ladder))],
+                0,
+            )
+
+            overflow = c["overflow"] | (
+                c["new"] + new_count.astype(jnp.uint32) > jnp.uint32(C)
+            )
+            f_overflow = c["f_overflow"] | (new_count > F)
+
+            # Fetch width: the payload gather is the merge's costliest
+            # op at big shapes (paxos-5: a static min(F, B_eff)=1.57M-
+            # row gather cost ~62ms/wave while typical waves produced
+            # ~120k new states), so the fetch runs under its own class
+            # switch sized to THIS wave's new_count.
+            nf_ladder = [n for n in f_ladder if n < NF] + [NF]
+            nf_class = jnp.int32(0)
+            for n in nf_ladder[:-1]:
+                nf_class = nf_class + (new_count > n).astype(jnp.int32)
+
+            def make_fetch(NF_c):
+                def br(_):
+                    pos = nf_pos[:NF_c]
+                    valid = jnp.arange(NF_c) < new_count
+                    nf_row = jnp.where(valid, pos - 1, jnp.uint32(0))
+                    (state_cols, par_lo, par_hi, row_ebits,
+                     key_lo, key_hi) = fetch(nf_row)
+                    z = jnp.uint32(0)
+                    frontier2 = lax.dynamic_update_slice(
+                        c["frontier"],
+                        jnp.where(valid[None, :], state_cols,
+                                  jnp.uint32(0)),
+                        (z, z),
+                    )
+                    ebits2 = lax.dynamic_update_slice(
+                        c["ebits"],
+                        jnp.where(valid, row_ebits, 0),
+                        (z,),
+                    )
+                    # Visited append: the winners' keys as one
+                    # [2, NF_c] sentinel-padded SoA block at the
+                    # running unique-count offset (no sort, no
+                    # scatter).
+                    vkeys2 = lax.dynamic_update_slice(
+                        c["vkeys"],
+                        jnp.stack([
+                            jnp.where(valid, key_lo, jnp.uint32(_SENT)),
+                            jnp.where(valid, key_hi, jnp.uint32(_SENT)),
+                        ]),
+                        (z, c["new"]),
+                    )
+                    # Parent-log append: PARENT limbs only — the child
+                    # keys of log entry i are exactly the visited
+                    # append above (vkeys[:, roots + i]), so the drain
+                    # derives them from vkeys instead of carrying two
+                    # more C-row lanes through every wave
+                    # (_build_generated).
+                    if track_paths:
+                        plog2 = lax.dynamic_update_slice(
+                            c["plog"],
+                            jnp.stack([
+                                jnp.where(valid, par_lo, 0),
+                                jnp.where(valid, par_hi, 0),
+                            ]),
+                            (z, c["pl_n"]),
+                        )
+                    else:
+                        plog2 = c["plog"]
+                    return frontier2, ebits2, vkeys2, plog2
+
+                return br
+
+            next_frontier, next_ebits, vkeys_new, plog_new = lax.switch(
+                nf_class,
+                [make_fetch(n) for n in nf_ladder],
+                0,
+            )
+
+            nf_valid_f = jnp.arange(F) < new_count
+            if track_paths:
+                # Clamp to the NF rows the largest block write can
+                # hold: on an f_overflow wave new_count can exceed
+                # it, and _run raises before reconstruction — but
+                # the live-count invariant should hold regardless.
+                pl_n = c["pl_n"] + jnp.minimum(
+                    new_count.astype(jnp.uint32), jnp.uint32(NF)
+                )
+            else:
+                pl_n = c["pl_n"]
+
+            g = u64_add(
+                U64(c["gen_lo"], c["gen_hi"]),
+                U64(n_cand.astype(jnp.uint32), jnp.uint32(0)),
+            )
+            new = c["new"] + new_count.astype(jnp.uint32)
+            all_disc = (
+                jnp.all(disc_found) if n_props else jnp.bool_(False)
+            )
+            if target_states is None:
+                target_hit = jnp.bool_(False)
+            else:
+                target_hit = new >= jnp.uint32(target_states)
+            cont = (
+                (new_count > 0)
+                & ~all_disc
+                & ~target_hit
+                & ~overflow
+                & ~f_overflow
+                & ~c_overflow
+                & ~e_overflow
+            )
+            trace_extra = {}
+            if trace_log:
+                # The wave log never crosses a switch boundary now —
+                # it rides only the assembled carry; the body wrapper
+                # writes this wave's row after the f-switch returns.
+                trace_extra = dict(
+                    wlog=c["wlog"],
+                    wv_pairs=(n_cand if wv_pairs is None
+                              else wv_pairs).astype(jnp.uint32),
+                )
+            return dict(
+                **trace_extra,
+                vkeys=vkeys_new,
+                plog=plog_new,
+                pl_n=pl_n,
+                frontier=next_frontier,
+                fval=nf_valid_f & cont,
+                ebits=next_ebits,
+                # The true row count even when the run stops (the
+                # wave loop gates on done/fval, so this is safe) —
+                # frontier rows past the class-local block are
+                # STALE now, so tooling that reruns stages on a
+                # captured carry (tools/profile_stages.py) reads
+                # the live-row count here instead of scanning for
+                # zero rows.
+                n_frontier=new_count.astype(jnp.uint32),
+                depth=jnp.where(cont, c["depth"] + 1, c["depth"]),
+                wchunk=c["wchunk"] + 1,
+                waves=c["waves"] + 1,
+                gen_lo=g.lo,
+                gen_hi=g.hi,
+                new=new,
+                disc_found=disc_found,
+                disc_lo=disc_lo,
+                disc_hi=disc_hi,
+                overflow=overflow,
+                f_overflow=f_overflow,
+                c_overflow=c_overflow,
+                e_overflow=e_overflow,
+                max_cand=jnp.maximum(c["max_cand"], n_cand),
+                max_tile_cand=max_tile_cand,
+                max_rowen=(c["max_rowen"] if max_rowen is None
+                           else max_rowen),
+                done=~cont,
+            )
 
         def make_wave(fc: int, v_class):
             (
@@ -1054,16 +1118,23 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 else:
                     expand = c["depth"] < target_depth
 
+                # Dense expansion runs step_vec on state ROWS; the
+                # resident frontier is [W, F], so the dense path pays
+                # one seam transpose of its class prefix per wave
+                # (the sparse path — the default for every registered
+                # encoding — stays transpose-free up to the pair-step
+                # gather seam).
+                frontier_rows = c["frontier"][:, :F_f].T
+                fval_f = c["fval"][:F_f]
+                ebits_f = c["ebits"][:F_f]
+
                 if full_flat:
                     # Expand the whole class prefix at once; the F_f*K
                     # successor tensor stays alive through the merge so
                     # only the ≤F winning rows are ever gathered.
-                    frontier_f = c["frontier"][:F_f]
-                    fval_f = c["fval"][:F_f]
-                    ebits_f = c["ebits"][:F_f]
                     ex = expand_frontier(
-                        enc, props, evt_idx, frontier_f, fval_f, ebits_f,
-                        expand, with_repeats=False,
+                        enc, props, evt_idx, frontier_rows, fval_f,
+                        ebits_f, expand, with_repeats=False,
                     )
                     e_overflow = c["e_overflow"] | jnp.any(ex["trunc"])
                     disc_found, disc_lo, disc_hi = discovery_update(
@@ -1148,7 +1219,10 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                         srow = m[:, 2]
                         q = fr_meta[srow // jnp.uint32(K)]
                         return (
-                            flat[srow],
+                            # gather seam: winners come off the row-
+                            # major flat tensor; one small [n, W]
+                            # transpose feeds the [W, F] block write.
+                            flat[srow].T,
                             q[:, 1] if track_paths else None,
                             q[:, 2] if track_paths else None,
                             q[:, 0],
@@ -1156,19 +1230,11 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                             m[:, 1],
                         )
 
-                    cand_B = Ba
-                    return lax.switch(
-                        v_class,
-                        [
-                            make_merge(
-                                c, vc, cand_B, ck_lo, ck_hi, fetch,
-                                n_cand, disc_found, disc_lo, disc_hi,
-                                c_overflow, e_overflow,
-                                jnp.maximum(c["max_tile_cand"], tile_max),
-                            )
-                            for vc in range(len(v_ladder))
-                        ],
-                        0,
+                    return merge_stage(
+                        c, v_class, Ba, ck_lo, ck_hi, fetch,
+                        n_cand, disc_found, disc_lo, disc_hi,
+                        c_overflow, e_overflow,
+                        jnp.maximum(c["max_tile_cand"], tile_max),
                     )
 
                 # Per-tile payload path (successor tensor too big to
@@ -1187,7 +1253,9 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                         dfound, dlo, dhi, n_cand, c_ovf, e_ovf, tmax,
                     ) = acc
                     off = t * T
-                    tf = lax.dynamic_slice(c["frontier"], (off, 0), (T, W))
+                    tf = lax.dynamic_slice(
+                        frontier_rows, (off, 0), (T, W)
+                    )
                     tfv = lax.dynamic_slice(c["fval"], (off,), (T,))
                     teb = lax.dynamic_slice(c["ebits"], (off,), (T,))
                     ex = expand_frontier(
@@ -1252,22 +1320,17 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 )
 
                 def fetch(nf_row):
-                    return payload_unpack(
-                        b_pay[nf_row], W, track_paths
+                    st, p_lo, p_hi, eb_w, k_lo_w, k_hi_w = (
+                        payload_unpack(b_pay[nf_row], W, track_paths)
                     )
+                    # gather seam: one [n, W] winner-block transpose.
+                    return st.T, p_lo, p_hi, eb_w, k_lo_w, k_hi_w
 
-                return lax.switch(
-                    v_class,
-                    [
-                        make_merge(
-                            c, vc, B_eff, ck_lo, ck_hi, fetch,
-                            n_cand, disc_found, disc_lo, disc_hi,
-                            c_overflow, e_overflow,
-                            jnp.maximum(c["max_tile_cand"], tile_max),
-                        )
-                        for vc in range(len(v_ladder))
-                    ],
-                    0,
+                return merge_stage(
+                    c, v_class, B_eff, ck_lo, ck_hi, fetch,
+                    n_cand, disc_found, disc_lo, disc_hi,
+                    c_overflow, e_overflow,
+                    jnp.maximum(c["max_tile_cand"], tile_max),
                 )
 
             return wave
@@ -1301,6 +1364,19 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 _jax.ShapeDtypeStruct((), jnp.uint32),
             )
             sparse_has_trunc = isinstance(_res_shape, tuple)
+            # The transposed pair step: COLUMN-major successor block
+            # out — the shape fingerprint_u32v_t folds coalesced and
+            # the [W, F] frontier's class-local DUS consumes without
+            # a transpose. The INPUT seam is backend-adaptive and
+            # lives in ONE place (encoding.pair_step_seam, PERF.md
+            # §layout): TPU row-gathers off a per-wave seam
+            # transpose; XLA:CPU column-gathers the resident buffer
+            # directly (measured at paxos-4 peak-wave shapes: seam-T
+            # + row gather 1.13s vs direct column gather 0.86s vs
+            # the old row-major 1.35s step+fp).
+            step_cols, make_pair_states = pair_step_seam(
+                enc, cpu_backend
+            )
         else:
             sparse_has_trunc = False
 
@@ -1366,63 +1442,71 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     expand = jnp.bool_(True)
                 else:
                     expand = c["depth"] < target_depth
-                frontier_f = c["frontier"][:F_f]
+                frontier_t = c["frontier"][:, :F_f]
                 fval_f = c["fval"][:F_f]
                 ebits_f = c["ebits"][:F_f]
-                cond, eb, f_lo, f_hi = frontier_props(
-                    enc, props, evt_idx, frontier_f, fval_f, ebits_f
+                cond, eb, f_lo, f_hi = frontier_props_t(
+                    enc, props, evt_idx, frontier_t, fval_f, ebits_f
                 )
 
                 pidx, live, pslot, cnt, n_pairs, pair_ovf, tile_max = (
                     sparse_pair_candidates(
-                        enc, frontier_f, fval_f, expand,
+                        # the FULL resident buffer + explicit class
+                        # width: a strided column-prefix slice as a
+                        # loop operand would materialize a per-wave
+                        # copy (see the n_rows note on the pipeline)
+                        enc, c["frontier"], fval_f, expand,
                         EV=EV, B_p=B_p, NT=NT, T=T,
                         mask_budget_cells=self.mask_budget_cells,
-                        Ba=Ba,
+                        Ba=Ba, n_rows=F_f,
                     )
                 )
+                # Pair-state gather seam: the shared backend policy
+                # (encoding.pair_step_seam) — pair rows are < F_f by
+                # construction, so the CPU column gather can read the
+                # full carry buffer (aliases for free).
+                pair_states = make_pair_states(c["frontier"],
+                                               frontier_t)
                 c_overflow = c["c_overflow"] | pair_ovf
                 e_overflow = c["e_overflow"]
                 needs_scan = sparse_boundary or sparse_has_trunc
 
-                def step_pairs(st, sl):
-                    """(succ, trunc|None, hard|None) for a pair block;
-                    trunc marks pairs pruned by an internal encoding
-                    bound (compiled envelope counts) — excluded from
-                    candidates and, when in-boundary, raised as
-                    e_overflow (the dense truncation contract); hard
-                    marks unrepresentable successors (un-harvested
-                    history transitions) — excluded and raised
-                    REGARDLESS of boundary, since the garbage successor
-                    can't faithfully evaluate it."""
-                    return normalize_step_slot_result(
-                        jax.vmap(enc.step_slot_vec)(st, sl)
-                    )
-
                 def eval_pairs(pidx_b, live_b, slot_b):
-                    """fingerprint keys + successors + validity (+ scan
-                    stats) for a block of compacted pairs."""
+                    """fingerprint keys + transposed successors +
+                    validity (+ scan stats) for a block of compacted
+                    pairs. ``step_cols`` returns ``(succ_t[W, n],
+                    trunc|None, hard|None)``: trunc marks pairs pruned
+                    by an internal encoding bound (compiled envelope
+                    counts) — excluded from candidates and, when
+                    in-boundary, raised as e_overflow (the dense
+                    truncation contract); hard marks unrepresentable
+                    successors (un-harvested history transitions) —
+                    excluded and raised REGARDLESS of boundary, since
+                    the garbage successor can't faithfully evaluate
+                    it. The fingerprint fold runs lane-major over the
+                    [W, n] block (fingerprint_u32v_t, the 1.65x
+                    coalesced fold)."""
                     prow_b = pidx_b // jnp.uint32(EV)
-                    succ_b, ptr_b, hard_b = step_pairs(
-                        frontier_f[prow_b], slot_b
+                    succ_t, ptr_b, hard_b = step_cols(
+                        pair_states(prow_b), slot_b
                     )
                     eov = jnp.bool_(False)
                     if hard_b is not None:
                         eov = jnp.any(live_b & hard_b)
                         live_b = live_b & ~hard_b
                     if sparse_boundary:
-                        inb = jax.vmap(enc.within_boundary_vec)(succ_b)
+                        inb = within_boundary_cols(enc, succ_t)
                         ok = live_b & inb
                     else:
                         ok = live_b
                     if ptr_b is not None:
                         eov = eov | jnp.any(ok & ptr_b)
                         ok = ok & ~ptr_b
-                    lo, hi = fingerprint_u32v(succ_b, jnp)
+                    lo, hi = fingerprint_u32v_t(succ_t, jnp)
                     lo, hi = clamp_keys(lo, hi)
                     lo = jnp.where(ok, lo, jnp.uint32(_SENT))
                     hi = jnp.where(ok, hi, jnp.uint32(_SENT))
-                    return lo, hi, ok, prow_b, eov, succ_b
+                    return lo, hi, ok, prow_b, eov, succ_t
 
                 if chunked:
                     # Chunked fingerprint pass: the [Ba, W] successor
@@ -1466,9 +1550,8 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                         has_succ = cnt > 0
                         n_cand = n_pairs
                 else:
-                    ck_lo, ck_hi, pair_ok, prow, eov, succ = eval_pairs(
-                        pidx, live, pslot
-                    )
+                    (ck_lo, ck_hi, pair_ok, prow, eov,
+                     succ_t) = eval_pairs(pidx, live, pslot)
                     if pay_fetch and not cpu_backend:
                         # Without this barrier XLA fuses the pair-step
                         # producer (frontier/params/sendtab gathers +
@@ -1478,10 +1561,10 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                         # gather twice per wave (seen in the round-5
                         # device trace as duplicate [Ba, *] gather
                         # fusions). Materialize once; the extra
-                        # [Ba, W] write is bandwidth-cheap.
-                        ck_lo, ck_hi, succ, prow = (
+                        # [W, Ba] write is bandwidth-cheap.
+                        ck_lo, ck_hi, succ_t, prow = (
                             lax.optimization_barrier(
-                                (ck_lo, ck_hi, succ, prow)
+                                (ck_lo, ck_hi, succ_t, prow)
                             )
                         )
                     e_overflow = e_overflow | eov
@@ -1514,21 +1597,27 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     # one [Ba, 1-3] gather) ride ONE multi-lane fetch
                     # gather — on TPU a gather costs ~12ns/row
                     # regardless of lane count (PERF.md §gathers).
+                    # Payload staging is the ONE place the successor
+                    # block transposes back to rows: gathers win
+                    # row-major (the sanctioned seam copy; payload
+                    # gathers measured equal either way).
                     fr_meta = jnp.stack(
                         [eb] + ([f_lo, f_hi] if track_paths else []),
                         axis=1,
                     )
                     pm = fr_meta[prow]
                     pay = payload_pack(
-                        jnp, succ, ck_lo, ck_hi, pm[:, 0],
+                        jnp, succ_t.T, ck_lo, ck_hi, pm[:, 0],
                         pm[:, 1] if track_paths else None,
                         pm[:, 2] if track_paths else None,
                     )
 
                     def fetch(nf_row):
-                        return payload_unpack(
-                            pay[nf_row], W, track_paths
+                        st, p_lo, p_hi, eb_w, k_lo_w, k_hi_w = (
+                            payload_unpack(pay[nf_row], W, track_paths)
                         )
+                        # seam transpose of the small winner block
+                        return st.T, p_lo, p_hi, eb_w, k_lo_w, k_hi_w
                 elif pay_fetch:
                     # XLA:CPU workaround (round 5): gathering a
                     # CONCATENATED [Ba, W+k] payload in this sparse
@@ -1538,11 +1627,16 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     # arrangement — the same packed fetch is fine in
                     # the dense wave, and fine on TPU). Same math,
                     # separate gathers: the successor tensor is still
-                    # reused (no transition recompute).
+                    # reused (no transition recompute), and the
+                    # column gather off [W, Ba] already returns the
+                    # [W, n] block the frontier write wants (measured
+                    # on CPU: cheaper than materializing a [Ba, W]
+                    # row view first — the fetch touches only the
+                    # winner columns).
                     def fetch(nf_row):
                         par_row = pidx[nf_row] // jnp.uint32(EV)
                         return (
-                            succ[nf_row],
+                            succ_t[:, nf_row],
                             f_lo[par_row] if track_paths else None,
                             f_hi[par_row] if track_paths else None,
                             eb[par_row],
@@ -1554,15 +1648,17 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     # winners' successors are recomputed from their
                     # (row, slot) pairs — exact by the
                     # SparseEncodedModel purity contract. Index-feeding
-                    # gathers stay 1-D (the XLA:CPU hazard above).
+                    # gathers stay 1-D (the XLA:CPU hazard above), and
+                    # step_cols hands back the [W, n] block directly —
+                    # this path is transpose-free end to end.
                     def fetch(nf_row):
                         pidx_w = pidx[nf_row]
                         par_row = pidx_w // jnp.uint32(EV)
-                        succ_w, _, _ = step_pairs(
-                            frontier_f[par_row], pslot[nf_row]
+                        succ_w_t, _, _ = step_cols(
+                            pair_states(par_row), pslot[nf_row]
                         )
                         return (
-                            succ_w,
+                            succ_w_t,
                             f_lo[par_row] if track_paths else None,
                             f_hi[par_row] if track_paths else None,
                             eb[par_row],
@@ -1570,20 +1666,13 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                             ck_hi[nf_row],
                         )
 
-                return lax.switch(
-                    v_class,
-                    [
-                        make_merge(
-                            c, vc, Ba, ck_lo, ck_hi, fetch,
-                            n_cand, disc_found, disc_lo, disc_hi,
-                            c_overflow, e_overflow,
-                            jnp.maximum(c["max_tile_cand"], tile_max),
-                            jnp.maximum(c["max_rowen"], jnp.max(cnt)),
-                            wv_pairs=n_pairs,
-                        )
-                        for vc in range(len(v_ladder))
-                    ],
-                    0,
+                return merge_stage(
+                    c, v_class, Ba, ck_lo, ck_hi, fetch,
+                    n_cand, disc_found, disc_lo, disc_hi,
+                    c_overflow, e_overflow,
+                    jnp.maximum(c["max_tile_cand"], tile_max),
+                    jnp.maximum(c["max_rowen"], jnp.max(cnt)),
+                    wv_pairs=n_pairs,
                 )
 
             return wave
@@ -1703,27 +1792,36 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
 
     def _capture_final(self, carry) -> None:
         self._final_tables = (
-            carry["pl_child_lo"],
-            carry["pl_child_hi"],
-            carry["pl_par_lo"],
-            carry["pl_par_hi"],
+            carry["vkeys"],
+            carry["plog"],
             carry["pl_n"],
+            carry["new"],
         )
 
     def _build_generated(self):
         """Materialize child→parent from the append-only device log
-        (the lazy download; roots are simply absent from the log)."""
+        (the lazy download; roots are simply absent from the log).
+
+        The log carries PARENT limbs only (round 9): log entry ``i``'s
+        child key IS the visited append at index ``roots + i`` —
+        ``pl_n`` advances in lockstep with ``new`` on every clean wave
+        (``pl_n == new - roots``), so the root count falls out of the
+        final counters and the children read straight out of
+        ``vkeys`` (rows [0, new) are dense real keys by the append
+        invariant)."""
         if self.generated is None:
-            c_lo, c_hi, p_lo, p_hi, pl_n = (
+            vkeys, plog, pl_n, new = (
                 np.asarray(a) for a in self._final_tables
             )
             n = int(pl_n)
+            roots = int(new) - n
             child = (
-                c_hi[:n].astype(np.uint64) << np.uint64(32)
-            ) | c_lo[:n].astype(np.uint64)
+                vkeys[1, roots:roots + n].astype(np.uint64)
+                << np.uint64(32)
+            ) | vkeys[0, roots:roots + n].astype(np.uint64)
             parent = (
-                p_hi[:n].astype(np.uint64) << np.uint64(32)
-            ) | p_lo[:n].astype(np.uint64)
+                plog[1, :n].astype(np.uint64) << np.uint64(32)
+            ) | plog[0, :n].astype(np.uint64)
             self.generated = {
                 int(c): (int(p) if p else None)
                 for c, p in zip(child.tolist(), parent.tolist())

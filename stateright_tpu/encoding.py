@@ -140,6 +140,132 @@ class SparseEncodedModel(Protocol):
         ...
 
 
+# -- transposed ([W, N]) invocation adapters (PERF.md §layout) -------------
+#
+# The sort-merge engines keep resident state column-major ``[W, N]``
+# (minor dim = rows, so TPU tile padding is negligible and every
+# elementwise/fold pass streams lane ROWS). Encodings stay written
+# per-state — ``vec[i]`` lane reads, 1-D guard math — and these
+# adapters give the engines the transposed batched view without any
+# data movement: ``jax.vmap`` over axis 1 turns each per-state lane
+# read into a contiguous row slice of the ``[W, N]`` block. Boundary
+# transposes (host upload/download, the table-gather seams where
+# row-major genuinely wins) stay in the engines; everything here is
+# pure batching.
+
+def enabled_bits_cols(enc, states_t: Any) -> Any:
+    """``uint32[W, N] -> uint32[N, L]`` — the word-native enabled
+    mask over a transposed frontier block (lane reads are row
+    slices; the word output stays row-major, it is L≤12 lanes)."""
+    import jax
+
+    return jax.vmap(enc.enabled_bits_vec, in_axes=1, out_axes=0)(
+        states_t
+    )
+
+
+def enabled_mask_cols(enc, states_t: Any) -> Any:
+    """``uint32[W, N] -> bool[N, K]`` — the dense-mask fallback for
+    encodings without ``enabled_bits_vec``, transposed invocation."""
+    import jax
+
+    return jax.vmap(enc.enabled_mask_vec, in_axes=1, out_axes=0)(
+        states_t
+    )
+
+
+def property_conditions_cols(enc, states_t: Any) -> Any:
+    """``uint32[W, N] -> bool[N, P]`` over a transposed block."""
+    import jax
+
+    return jax.vmap(
+        enc.property_conditions_vec, in_axes=1, out_axes=0
+    )(states_t)
+
+
+def within_boundary_cols(enc, succ_t: Any) -> Any:
+    """``uint32[W, N] -> bool[N]`` over a transposed successor
+    block."""
+    import jax
+
+    return jax.vmap(enc.within_boundary_vec, in_axes=1)(succ_t)
+
+
+def step_slot_cols_fn(enc, states_axis: int = 0):
+    """Build the transposed-successor pair step:
+    ``f(states, slots[N]) -> (succ_t uint32[W, N], trunc|None,
+    hard|None)``.
+
+    ``states_axis`` picks the INPUT layout: ``0`` takes row-major
+    ``[N, W]`` states (the TPU gather seam — on chip, row gathers
+    genuinely win and the class prefix transposes once per wave,
+    PERF.md §gathers); ``1`` takes column-major ``[W, N]`` states
+    (the XLA:CPU engines gather resident columns directly — measured
+    faster than the seam transpose + row gather at paxos-4 shapes,
+    PERF.md §layout). Either way the successor block assembles
+    lane-major, which is exactly the shape the ``[W, N]`` resident
+    frontier's class-local ``dynamic_update_slice`` writes and the
+    transposed fingerprint fold (``fingerprint_u32v_t``) consume.
+    The optional trunc/hard flags stay 1-D ``[N]`` (see
+    :class:`SparseEncodedModel`)."""
+    import jax
+    import jax.numpy as jnp
+
+    res = jax.eval_shape(
+        enc.step_slot_vec,
+        jax.ShapeDtypeStruct((enc.width,), jnp.uint32),
+        jax.ShapeDtypeStruct((), jnp.uint32),
+    )
+    if isinstance(res, tuple):
+        out_axes = (1,) + (0,) * (len(res) - 1)
+    else:
+        out_axes = 1
+    f = jax.vmap(
+        enc.step_slot_vec, in_axes=(states_axis, 0),
+        out_axes=out_axes,
+    )
+
+    def step_cols(states, slots):
+        return normalize_step_slot_result(f(states, slots))
+
+    return step_cols
+
+
+def pair_step_seam(enc, cpu_backend: bool):
+    """THE one home of the backend-adaptive pair-state gather-seam
+    policy (PERF.md §layout) — both sort-merge engines and
+    tools/profile_stages.py build their pair step from here, so the
+    policy cannot drift between the engines and the profiler that
+    claims to mirror them.
+
+    Returns ``(step_cols, make_pair_states)``:
+
+    * ``step_cols(states, slots)`` — the transposed-successor pair
+      step (:func:`step_slot_cols_fn`) in this backend's input
+      layout: row states on TPU (row gathers genuinely win there,
+      PERF.md §gathers), column states on XLA:CPU;
+    * ``make_pair_states(frontier_full, frontier_class_t)`` — builds
+      the per-wave ``pair_states(idx) -> uint32[W or n, ...]`` gather
+      feeding it. On XLA:CPU it gathers resident COLUMNS off the
+      FULL ``[W, F]`` carry buffer (measured faster than the seam
+      transpose + row gather at paxos-4 shapes, and the full buffer
+      aliases for free as a loop operand); on TPU it transposes the
+      CLASS view once per wave and gathers rows.
+    """
+    step_cols = step_slot_cols_fn(
+        enc, states_axis=1 if cpu_backend else 0
+    )
+
+    def make_pair_states(frontier_full, frontier_class_t):
+        if cpu_backend:
+            return lambda idx: frontier_full[:, idx]
+        frontier_rows = frontier_class_t.T  # the sanctioned seam copy
+
+        return lambda idx: frontier_rows[idx]
+
+    return step_cols, make_pair_states
+
+
 def normalize_step_slot_result(res) -> tuple:
     """``step_slot_vec`` results to canonical ``(succ, trunc|None,
     hard_trunc|None)`` (see :class:`SparseEncodedModel` for the three

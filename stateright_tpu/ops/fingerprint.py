@@ -34,6 +34,29 @@ def splitmix64(z: U64, xp=np) -> U64:
     return z
 
 
+def _fold_lanes(w: int, lane, zero, xp) -> Tuple[Any, Any]:
+    """THE fingerprint fold — one body for every lane layout.
+
+    ``lane(i)`` returns lane ``i`` of the batch (the only thing the
+    row-major and lane-major entry points differ in); the seed, the
+    per-lane GOLDEN offsets, the splitmix64 chain, and the NonZeroU64
+    zero-reservation live here exactly once, so a hash change cannot
+    silently fork the host-side digests (drain, seeds) from the
+    device-side ones (the transposed engines).
+    """
+    h = U64(zero + xp.uint32(_SEED & 0xFFFFFFFF), zero + xp.uint32(_SEED >> 32))
+    for i in range(w):
+        lane_i = u64_add(
+            U64(lane(i), zero),
+            u64_const(_GOLDEN * (i + 1) & 0xFFFFFFFFFFFFFFFF, xp),
+        )
+        h = splitmix64(u64_xor(h, lane_i), xp)
+    # Reserve 0 as "empty" (NonZeroU64 convention).
+    both_zero = (h.lo == 0) & (h.hi == 0)
+    lo = xp.where(both_zero, xp.uint32(1), h.lo)
+    return lo, h.hi
+
+
 def fingerprint_u32v(vec: Any, xp=np) -> Tuple[Any, Any]:
     """Digest uint32 state vectors along the last axis.
 
@@ -42,19 +65,29 @@ def fingerprint_u32v(vec: Any, xp=np) -> Tuple[Any, Any]:
     static; XLA unrolls it) and vectorized over every leading axis.
     """
     vec = xp.asarray(vec, dtype=xp.uint32)
-    w = vec.shape[-1]
     zero = xp.zeros(vec.shape[:-1], dtype=xp.uint32)
-    h = U64(zero + xp.uint32(_SEED & 0xFFFFFFFF), zero + xp.uint32(_SEED >> 32))
-    for i in range(w):
-        lane = u64_add(
-            U64(vec[..., i], zero),
-            u64_const(_GOLDEN * (i + 1) & 0xFFFFFFFFFFFFFFFF, xp),
-        )
-        h = splitmix64(u64_xor(h, lane), xp)
-    # Reserve 0 as "empty" (NonZeroU64 convention).
-    both_zero = (h.lo == 0) & (h.hi == 0)
-    lo = xp.where(both_zero, xp.uint32(1), h.lo)
-    return lo, h.hi
+    return _fold_lanes(
+        vec.shape[-1], lambda i: vec[..., i], zero, xp
+    )
+
+
+def fingerprint_u32v_t(vec_t: Any, xp=np) -> Tuple[Any, Any]:
+    """Digest TRANSPOSED (lane-major) state blocks along axis 0.
+
+    ``vec_t``: uint32[W, ...] → ``(lo, hi)``: uint32[...] each —
+    bit-identical to ``fingerprint_u32v(vec_t.T)`` (same
+    :func:`_fold_lanes` body, only the lane accessor differs). This
+    is the fold the engines run over the column-major ``[W, N]``
+    resident layout (PERF.md §layout): lane ``i`` is the contiguous
+    row ``vec_t[i]``, so the per-lane splitmix64 pass streams
+    coalesced instead of striding through T(8,128)-tiled rows (the
+    measured 1.65x fold, PERF.md §tile-padding).
+    """
+    vec_t = xp.asarray(vec_t, dtype=xp.uint32)
+    zero = xp.zeros(vec_t.shape[1:], dtype=xp.uint32)
+    return _fold_lanes(
+        vec_t.shape[0], lambda i: vec_t[i], zero, xp
+    )
 
 
 def fingerprint_u32v_int(vec: Any) -> Any:

@@ -88,7 +88,7 @@ def dest_tile_pack(jnp, state, par_lo, par_hi, ebits, key_lo, key_hi):
     """THE sharded routed-tile lane layout: ``[state 0:W | par_lo W |
     par_hi W+1 (paths only) | ebits E-1 | key_lo E | key_hi E+1]``
     with ``E = W+3`` (paths) or ``W+1`` — every ``dest_block`` variant
-    packs through this helper, and ``make_merge`` unpacks by the same
+    packs through this helper, and ``merge_stage`` unpacks by the same
     offsets (``recv[:, E]``/``recv[:, EB]``), so the tile layout can't
     drift between the three pack sites and the post-shuffle merge.
     NOT the single-chip payload layout: ``payload_pack``
@@ -220,13 +220,18 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
         except ImportError:  # pragma: no cover - older jax
             from jax.experimental.shard_map import shard_map
 
-        from ..checkers.tpu import frontier_props
+        from ..checkers.tpu import frontier_props_t
         from ..checkers.tpu_sortmerge import (
             _divisor_at_least,
             _ladder,
             sparse_pair_candidates,
         )
-        from ..encoding import has_trivial_boundary, normalize_step_slot_result
+        from ..encoding import (
+            has_trivial_boundary,
+            pair_step_seam,
+            within_boundary_cols,
+        )
+        from ..ops.fingerprint import fingerprint_u32v_t
 
         enc = self.encoded
         props = list(self.model.properties())
@@ -261,6 +266,13 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                 tuple,
             )
             sparse_boundary = not has_trivial_boundary(enc)
+            # Transposed pair step: [W, n] successor block out — the
+            # shape the lane-major fingerprint fold consumes. The
+            # input seam is the shared backend policy
+            # (encoding.pair_step_seam, PERF.md §layout).
+            step_cols, make_pair_states = pair_step_seam(
+                enc, cpu_backend
+            )
         if n0 > C:
             raise ValueError(
                 f"per-shard capacity {C} < {n0} init states"
@@ -343,15 +355,18 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
         C_pad = C + F
 
         def seed_local(init_rows):
+            # Host upload boundary: rows arrive row-major and
+            # transpose ONCE into the [W, F] resident layout
+            # (PERF.md §layout).
             me = lax.axis_index("shard").astype(jnp.uint32)
             lo0, hi0 = fingerprint_u32v(init_rows, jnp)
             lo0, hi0 = clamp_keys(lo0, hi0)
             mine = (lo0 % jnp.uint32(S)) == me
             pos = jnp.cumsum(mine) - 1
             sp = jnp.where(mine, pos, F)
-            frontier = jnp.zeros((F, W), dtype=jnp.uint32).at[sp].set(
-                init_rows, mode="drop"
-            )
+            frontier = jnp.zeros((W, F), dtype=jnp.uint32).at[
+                :, sp
+            ].set(init_rows.T, mode="drop")
             n_mine = jnp.sum(mine).astype(jnp.uint32)
             fval = jnp.arange(F) < n_mine
             ebits = jnp.where(fval, jnp.uint32(ebits_init), jnp.uint32(0))
@@ -367,24 +382,22 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             sk_lo = jnp.where(live_pref, sk_lo, jnp.uint32(_SENT))
             sk_hi = jnp.where(live_pref, sk_hi, jnp.uint32(_SENT))
             pad = C_pad - sk_lo.shape[0]
-            v_lo = jnp.concatenate(
-                [sk_lo, jnp.full(pad, _SENT, jnp.uint32)]
-            )
-            v_hi = jnp.concatenate(
-                [sk_hi, jnp.full(pad, _SENT, jnp.uint32)]
-            )
+            vkeys = jnp.stack([
+                jnp.concatenate(
+                    [sk_lo, jnp.full(pad, _SENT, jnp.uint32)]
+                ),
+                jnp.concatenate(
+                    [sk_hi, jnp.full(pad, _SENT, jnp.uint32)]
+                ),
+            ])
             return dict(
                 **(
                     dict(wlog=jnp.zeros((waves_per_sync, WL),
                                         jnp.uint32))
                     if trace_log else {}
                 ),
-                v_lo=v_lo,
-                v_hi=v_hi,
-                pl_child_lo=jnp.zeros(L, jnp.uint32),
-                pl_child_hi=jnp.zeros(L, jnp.uint32),
-                pl_par_lo=jnp.zeros(L, jnp.uint32),
-                pl_par_hi=jnp.zeros(L, jnp.uint32),
+                vkeys=vkeys,
+                plog=jnp.zeros((2, L), jnp.uint32),
                 pl_n=jnp.zeros(1, jnp.uint32),
                 frontier=frontier,
                 fval=fval,
@@ -410,188 +423,198 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                 done=jnp.bool_(n0 == 0),
             )
 
-        def make_merge(c, vc, R_c, recv, n_cand, sent, disc, ovf):
-            """Owner-local sort-merge dedup against visited-prefix
-            class vc (the DashMap-shard role, bfs.rs:28-29, on the
-            TPU-fast path): stable merge with the visited prefix
-            first, so first-of-run wins and intra-wave duplicates
-            resolve for free."""
-            V_v = v_ladder[vc]
-            M = V_v + R_c
+        def merge_stage(c, v_class, R_c, recv, n_cand, sent, disc, ovf):
+            """Owner-local sort-merge dedup (the DashMap-shard role,
+            bfs.rs:28-29, on the TPU-fast path): stable merge with the
+            visited prefix first, so first-of-run wins and intra-wave
+            duplicates resolve for free.
+
+            Class-collapsed (round 9, PERF.md §layout): the v-ladder
+            switch runs a merge CORE returning only the shared SoA
+            result ``(nf_pos[F], new_count)`` — the full per-shard
+            carry no longer crosses the merge switch boundary at all —
+            and the winner gather, resident-buffer writes (vkeys/plog
+            SoA appends via class-local ``dynamic_update_slice``), and
+            carry assembly happen ONCE at wave level. Collectives
+            (psum/pmax) also moved out of the branches: every shard
+            takes the same branch (the classes are pmax-agreed), but
+            uniform collectives outside the switch are the simpler
+            contract."""
             disc_found, disc_lo, disc_hi = disc
             overflow0, f_overflow0, c_overflow, e_overflow = ovf
 
-            def merge(_):
-                r_lo = recv[:, E]
-                r_hi = recv[:, E + 1]
-                r_val = (r_lo != 0) | (r_hi != 0)
-                ck_lo = jnp.where(r_val, r_lo, jnp.uint32(_SENT))
-                ck_hi = jnp.where(r_val, r_hi, jnp.uint32(_SENT))
+            r_lo = recv[:, E]
+            r_hi = recv[:, E + 1]
+            r_val = (r_lo != 0) | (r_hi != 0)
+            ck_lo = jnp.where(r_val, r_lo, jnp.uint32(_SENT))
+            ck_hi = jnp.where(r_val, r_hi, jnp.uint32(_SENT))
 
-                m_hi = jnp.concatenate([c["v_hi"][:V_v], ck_hi])
-                m_lo = jnp.concatenate([c["v_lo"][:V_v], ck_lo])
-                m_pos = jnp.concatenate(
-                    [
-                        jnp.zeros(V_v, jnp.uint32),
-                        jnp.arange(1, R_c + 1, dtype=jnp.uint32),
-                    ]
-                )
-                m_hi, m_lo, m_pos = lax.sort(
-                    (m_hi, m_lo, m_pos), num_keys=2
-                )
-                real = ~(
-                    (m_hi == jnp.uint32(_SENT))
-                    & (m_lo == jnp.uint32(_SENT))
-                )
-                prev_same = jnp.concatenate(
-                    [
-                        jnp.zeros(1, bool),
-                        (m_hi[1:] == m_hi[:-1])
-                        & (m_lo[1:] == m_lo[:-1]),
-                    ]
-                )
-                is_new = real & ~prev_same & (m_pos > 0)
-                new_count = jnp.sum(is_new)
-                overflow = overflow0 | bool_any(
-                    c["u_loc"][0] + new_count.astype(jnp.uint32)
-                    > jnp.uint32(C)
-                )
+            def merge_core(vc):
+                V_v = v_ladder[vc]
+                M = V_v + R_c
 
-                nf_pos = jnp.where(is_new, m_pos, jnp.uint32(_SENT))
-                (nf_pos,) = lax.sort((nf_pos,), num_keys=1)
-                if M >= F:
-                    nf_pos = nf_pos[:F]
-                else:
-                    nf_pos = jnp.concatenate(
-                        [nf_pos, jnp.full(F - M, _SENT, jnp.uint32)]
+                def br(_):
+                    m_hi = jnp.concatenate([c["vkeys"][1, :V_v], ck_hi])
+                    m_lo = jnp.concatenate([c["vkeys"][0, :V_v], ck_lo])
+                    m_pos = jnp.concatenate(
+                        [
+                            jnp.zeros(V_v, jnp.uint32),
+                            jnp.arange(1, R_c + 1, dtype=jnp.uint32),
+                        ]
                     )
-                nf_valid = jnp.arange(F) < new_count
-                f_overflow = f_overflow0 | bool_any(new_count > F)
-                nf_row = jnp.where(nf_valid, nf_pos - 1, jnp.uint32(0))
-                next_fe = recv[nf_row]
-                next_frontier = jnp.where(
-                    nf_valid[:, None], next_fe[:, :W], jnp.uint32(0)
-                )
-                next_ebits = jnp.where(nf_valid, next_fe[:, EB], 0)
-
-                # Visited append (unsorted visited design): winners'
-                # keys as one sentinel-padded block at this shard's
-                # running local-unique offset.
-                app_lo = jnp.where(
-                    nf_valid, next_fe[:, E], jnp.uint32(_SENT)
-                )
-                app_hi = jnp.where(
-                    nf_valid, next_fe[:, E + 1], jnp.uint32(_SENT)
-                )
-                v_lo_new = lax.dynamic_update_slice(
-                    c["v_lo"], app_lo, (c["u_loc"][0],)
-                )
-                v_hi_new = lax.dynamic_update_slice(
-                    c["v_hi"], app_hi, (c["u_loc"][0],)
-                )
-
-                if track_paths:
-                    nc_lo = jnp.where(nf_valid, next_fe[:, E], 0)
-                    nc_hi = jnp.where(nf_valid, next_fe[:, E + 1], 0)
-                    np_lo = jnp.where(nf_valid, next_fe[:, W], 0)
-                    np_hi = jnp.where(nf_valid, next_fe[:, W + 1], 0)
-                    off = (c["pl_n"][0],)
-                    pl_child_lo = lax.dynamic_update_slice(
-                        c["pl_child_lo"], nc_lo, off
+                    m_hi, m_lo, m_pos = lax.sort(
+                        (m_hi, m_lo, m_pos), num_keys=2
                     )
-                    pl_child_hi = lax.dynamic_update_slice(
-                        c["pl_child_hi"], nc_hi, off
+                    real = ~(
+                        (m_hi == jnp.uint32(_SENT))
+                        & (m_lo == jnp.uint32(_SENT))
                     )
-                    pl_par_lo = lax.dynamic_update_slice(
-                        c["pl_par_lo"], np_lo, off
+                    prev_same = jnp.concatenate(
+                        [
+                            jnp.zeros(1, bool),
+                            (m_hi[1:] == m_hi[:-1])
+                            & (m_lo[1:] == m_lo[:-1]),
+                        ]
                     )
-                    pl_par_hi = lax.dynamic_update_slice(
-                        c["pl_par_hi"], np_hi, off
+                    is_new = real & ~prev_same & (m_pos > 0)
+                    new_count = jnp.sum(is_new)
+                    nf_pos = jnp.where(
+                        is_new, m_pos, jnp.uint32(_SENT)
                     )
-                    # Clamp to the F rows the block write actually
-                    # wrote (on an f_overflow wave new_count can
-                    # exceed F; _run raises before reconstruction, but
-                    # the live-count invariant should hold regardless).
-                    pl_n = c["pl_n"] + jnp.minimum(
-                        new_count.astype(jnp.uint32), jnp.uint32(F)
-                    )
-                else:
-                    pl_child_lo = c["pl_child_lo"]
-                    pl_child_hi = c["pl_child_hi"]
-                    pl_par_lo = c["pl_par_lo"]
-                    pl_par_hi = c["pl_par_hi"]
-                    pl_n = c["pl_n"]
+                    (nf_pos,) = lax.sort((nf_pos,), num_keys=1)
+                    if M >= F:
+                        nf_pos = nf_pos[:F]
+                    else:
+                        nf_pos = jnp.concatenate(
+                            [nf_pos,
+                             jnp.full(F - M, _SENT, jnp.uint32)]
+                        )
+                    return nf_pos, new_count
 
-                g_new = lax.psum(new_count.astype(jnp.uint32), "shard")
-                g_cand = lax.psum(n_cand, "shard")
-                g = u64_add(
-                    U64(c["gen_lo"], c["gen_hi"]),
-                    U64(g_cand, jnp.uint32(0)),
-                )
-                new = c["new"] + g_new
-                max_cand = jnp.maximum(
-                    c["max_cand"], lax.pmax(n_cand, "shard")
-                )
+                return br
 
-                all_disc = (
-                    jnp.all(disc_found) if n_props else jnp.bool_(False)
-                )
-                if target_states is None:
-                    target_hit = jnp.bool_(False)
-                else:
-                    target_hit = new >= jnp.uint32(target_states)
-                cont = (
-                    (g_new > 0)
-                    & ~all_disc
-                    & ~target_hit
-                    & ~overflow
-                    & ~f_overflow
-                    & ~c_overflow
-                    & ~e_overflow
-                )
-                nc_u32 = new_count.astype(jnp.uint32)
-                return dict(
-                    v_lo=v_lo_new,
-                    v_hi=v_hi_new,
-                    pl_child_lo=pl_child_lo,
-                    pl_child_hi=pl_child_hi,
-                    pl_par_lo=pl_par_lo,
-                    pl_par_hi=pl_par_hi,
-                    pl_n=pl_n,
-                    frontier=next_frontier,
-                    fval=nf_valid & cont,
-                    ebits=next_ebits,
-                    n_loc=jnp.where(
-                        cont, nc_u32, jnp.uint32(0)
-                    ).reshape(1),
-                    u_loc=c["u_loc"] + nc_u32,
-                    depth=jnp.where(cont, c["depth"] + 1, c["depth"]),
-                    wchunk=c["wchunk"] + 1,
-                    waves=c["waves"] + 1,
-                    gen_lo=g.lo,
-                    gen_hi=g.hi,
-                    new=new,
-                    sent_lo=sent.lo,
-                    sent_hi=sent.hi,
-                    max_cand=max_cand,
-                    disc_found=disc_found,
-                    disc_lo=disc_lo,
-                    disc_hi=disc_hi,
-                    overflow=overflow,
-                    f_overflow=f_overflow,
-                    c_overflow=c_overflow,
-                    e_overflow=e_overflow,
-                    done=~cont,
-                )
+            nf_pos, new_count = lax.switch(
+                v_class,
+                [merge_core(vc) for vc in range(len(v_ladder))],
+                0,
+            )
 
-            return merge
+            overflow = overflow0 | bool_any(
+                c["u_loc"][0] + new_count.astype(jnp.uint32)
+                > jnp.uint32(C)
+            )
+            nf_valid = jnp.arange(F) < new_count
+            f_overflow = f_overflow0 | bool_any(new_count > F)
+            nf_row = jnp.where(nf_valid, nf_pos - 1, jnp.uint32(0))
+            next_fe = recv[nf_row]
+            # The winners come off the routed-tile row gather; one
+            # seam transpose feeds the [W, F] resident frontier.
+            next_frontier = jnp.where(
+                nf_valid[:, None], next_fe[:, :W], jnp.uint32(0)
+            ).T
+            next_ebits = jnp.where(nf_valid, next_fe[:, EB], 0)
+
+            # Visited append (unsorted visited design): winners' keys
+            # as one [2, F] sentinel-padded SoA block at this shard's
+            # running local-unique offset.
+            vkeys_new = lax.dynamic_update_slice(
+                c["vkeys"],
+                jnp.stack([
+                    jnp.where(nf_valid, next_fe[:, E],
+                              jnp.uint32(_SENT)),
+                    jnp.where(nf_valid, next_fe[:, E + 1],
+                              jnp.uint32(_SENT)),
+                ]),
+                (jnp.uint32(0), c["u_loc"][0]),
+            )
+
+            if track_paths:
+                # PARENT limbs only: log entry i's child key is the
+                # visited append at local index (roots + i) — derived
+                # from vkeys at drain time (_build_generated).
+                plog_new = lax.dynamic_update_slice(
+                    c["plog"],
+                    jnp.stack([
+                        jnp.where(nf_valid, next_fe[:, W], 0),
+                        jnp.where(nf_valid, next_fe[:, W + 1], 0),
+                    ]),
+                    (jnp.uint32(0), c["pl_n"][0]),
+                )
+                # Clamp to the F rows the block write actually wrote
+                # (on an f_overflow wave new_count can exceed F; _run
+                # raises before reconstruction, but the live-count
+                # invariant should hold regardless).
+                pl_n = c["pl_n"] + jnp.minimum(
+                    new_count.astype(jnp.uint32), jnp.uint32(F)
+                )
+            else:
+                plog_new = c["plog"]
+                pl_n = c["pl_n"]
+
+            g_new = lax.psum(new_count.astype(jnp.uint32), "shard")
+            g_cand = lax.psum(n_cand, "shard")
+            g = u64_add(
+                U64(c["gen_lo"], c["gen_hi"]),
+                U64(g_cand, jnp.uint32(0)),
+            )
+            new = c["new"] + g_new
+            max_cand = jnp.maximum(
+                c["max_cand"], lax.pmax(n_cand, "shard")
+            )
+
+            all_disc = (
+                jnp.all(disc_found) if n_props else jnp.bool_(False)
+            )
+            if target_states is None:
+                target_hit = jnp.bool_(False)
+            else:
+                target_hit = new >= jnp.uint32(target_states)
+            cont = (
+                (g_new > 0)
+                & ~all_disc
+                & ~target_hit
+                & ~overflow
+                & ~f_overflow
+                & ~c_overflow
+                & ~e_overflow
+            )
+            nc_u32 = new_count.astype(jnp.uint32)
+            return dict(
+                vkeys=vkeys_new,
+                plog=plog_new,
+                pl_n=pl_n,
+                frontier=next_frontier,
+                fval=nf_valid & cont,
+                ebits=next_ebits,
+                n_loc=jnp.where(
+                    cont, nc_u32, jnp.uint32(0)
+                ).reshape(1),
+                u_loc=c["u_loc"] + nc_u32,
+                depth=jnp.where(cont, c["depth"] + 1, c["depth"]),
+                wchunk=c["wchunk"] + 1,
+                waves=c["waves"] + 1,
+                gen_lo=g.lo,
+                gen_hi=g.hi,
+                new=new,
+                sent_lo=sent.lo,
+                sent_hi=sent.hi,
+                max_cand=max_cand,
+                disc_found=disc_found,
+                disc_lo=disc_lo,
+                disc_hi=disc_hi,
+                overflow=overflow,
+                f_overflow=f_overflow,
+                c_overflow=c_overflow,
+                e_overflow=e_overflow,
+                done=~cont,
+            )
 
         def make_wave(fc: int, v_class):
             F_c, NT, T, R_src, B_c, Bd_c = class_params(fc)
             R_c = S * Bd_c
 
             def wave(c):
-                frontier_c = c["frontier"][:F_c]
+                frontier_t = c["frontier"][:, :F_c]
                 fval_c = c["fval"][:F_c]
                 ebits_c = c["ebits"][:F_c]
                 me = lax.axis_index("shard").astype(jnp.uint32)
@@ -609,31 +632,34 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                     # pair pipeline (checkers/tpu_sortmerge.py), then
                     # per-pair transitions — only real candidates
                     # enter the routing sort and the shuffle.
-                    cond, eb, fp_lo, fp_hi = frontier_props(
-                        enc, props, evt_idx, frontier_c, fval_c,
+                    cond, eb, fp_lo, fp_hi = frontier_props_t(
+                        enc, props, evt_idx, frontier_t, fval_c,
                         ebits_c,
                     )
                     (
                         pidx, live, pslot, cnt, n_pairs, pair_ovf, _tm,
                     ) = sparse_pair_candidates(
-                        enc, frontier_c, fval_c, expand,
+                        # full resident buffer + explicit class width
+                        # (a strided column-prefix slice as a loop
+                        # operand would copy per wave — see the
+                        # n_rows note on the shared pipeline)
+                        enc, c["frontier"], fval_c, expand,
                         EV=EV, B_p=B_c, NT=NT, T=T,
                         mask_budget_cells=self.mask_budget_cells,
-                        Ba=R_src, axis_name="shard",
+                        Ba=R_src, axis_name="shard", n_rows=F_c,
                     )
+                    # Pair-state gather seam: the shared backend
+                    # policy (encoding.pair_step_seam).
+                    pair_states = make_pair_states(c["frontier"],
+                                                   frontier_t)
                     c_overflow = c_overflow | bool_any(pair_ovf)
                     prow = pidx // jnp.uint32(EV)
                     needs_scan = sparse_boundary or sparse_has_trunc
 
-                    def step_pairs(st, sl):
-                        return normalize_step_slot_result(
-                            jax.vmap(enc.step_slot_vec)(st, sl)
-                        )
-
                     def eval_pairs(pidx_b, live_b, slot_b):
                         prow_b = pidx_b // jnp.uint32(EV)
-                        succ_b, ptr_b, hard_b = step_pairs(
-                            frontier_c[prow_b], slot_b
+                        succ_t, ptr_b, hard_b = step_cols(
+                            pair_states(prow_b), slot_b
                         )
                         # hard trunc (unrepresentable successor, e.g.
                         # an un-harvested history transition) is raised
@@ -644,18 +670,16 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                             eov = jnp.any(live_b & hard_b)
                             live_b = live_b & ~hard_b
                         if sparse_boundary:
-                            inb = jax.vmap(enc.within_boundary_vec)(
-                                succ_b
-                            )
+                            inb = within_boundary_cols(enc, succ_t)
                             ok = live_b & inb
                         else:
                             ok = live_b
                         if ptr_b is not None:
                             eov = eov | jnp.any(ok & ptr_b)
                             ok = ok & ~ptr_b
-                        lo, hi = fingerprint_u32v(succ_b, jnp)
+                        lo, hi = fingerprint_u32v_t(succ_t, jnp)
                         lo, hi = clamp_keys(lo, hi)
-                        return succ_b, lo, hi, ok, prow_b, eov
+                        return succ_t, lo, hi, ok, prow_b, eov
 
                     # Memory-lean mode (mirrors the single-chip chunked
                     # path): when the [R_src, W] successor tensor would
@@ -737,9 +761,8 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                             n_cand = n_pairs
                         cand_state = None  # recomputed per dest_tile
                     else:
-                        succ, k_lo, k_hi, pair_ok, _, eov = eval_pairs(
-                            pidx, live, pslot
-                        )
+                        (succ_t, k_lo, k_hi, pair_ok, _,
+                         eov) = eval_pairs(pidx, live, pslot)
                         e_overflow = e_overflow | bool_any(eov)
                         if needs_scan:
                             row_ok = jnp.zeros(F_c, jnp.uint32).at[
@@ -752,7 +775,10 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                         else:
                             has_succ = cnt > 0
                             n_cand = n_pairs
-                        cand_state = succ
+                        # Routed-tile staging is a gather seam: the
+                        # successor block transposes back to rows once
+                        # (PERF.md §layout — row-major gathers win).
+                        cand_state = succ_t.T
                     terminal = fval_c & ~has_succ & expand
                     evt_cex = terminal & (eb != 0)
                     ex = dict(
@@ -762,8 +788,11 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                     cand_valid = pair_ok
                     cand_par = prow
                 else:
+                    # Dense expansion: one seam transpose of the class
+                    # prefix (step_vec is the row contract).
+                    frontier_rows = frontier_t.T
                     ex = expand_frontier(
-                        enc, props, evt_idx, frontier_c, fval_c,
+                        enc, props, evt_idx, frontier_rows, fval_c,
                         ebits_c, expand, with_repeats=False,
                     )
                     e_overflow = e_overflow | bool_any(
@@ -867,9 +896,10 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                         if cand_state is not None:
                             st = cand_state[srow]
                         else:
-                            st, _, _ = step_pairs(
-                                frontier_c[par], pslot[srow]
+                            st_t, _, _ = step_cols(
+                                pair_states(par), pslot[srow]
                             )
+                            st = st_t.T
                         return dest_tile_pack(
                             jnp, st,
                             ex["f_lo"][par] if track_paths else None,
@@ -915,11 +945,11 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                             skeys, (start, z), (Bd_c, 2)
                         )
                         par = m[:, 0] // jnp.uint32(EV)
-                        succ_t, _, _ = step_pairs(
-                            frontier_c[par], m[:, 1]
+                        succ_d_t, _, _ = step_cols(
+                            pair_states(par), m[:, 1]
                         )
                         return dest_tile_pack(
-                            jnp, succ_t,
+                            jnp, succ_d_t.T,
                             m[:, 3:4] if track_paths else None,
                             m[:, 4:5] if track_paths else None,
                             m[:, 2:3], kk[:, 0:1], kk[:, 1:2],
@@ -949,18 +979,11 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                     tiled=True,
                 )
 
-                return lax.switch(
-                    v_class,
-                    [
-                        make_merge(
-                            c, vc, R_c, recv, n_cand, sent,
-                            (disc_found, disc_lo, disc_hi),
-                            (c["overflow"], c["f_overflow"],
-                             c_overflow, e_overflow),
-                        )
-                        for vc in range(len(v_ladder))
-                    ],
-                    0,
+                return merge_stage(
+                    c, v_class, R_c, recv, n_cand, sent,
+                    (disc_found, disc_lo, disc_hi),
+                    (c["overflow"], c["f_overflow"],
+                     c_overflow, e_overflow),
                 )
 
             return wave
@@ -1052,14 +1075,12 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
         P_shard = P("shard")
         specs = dict(
             **(dict(wlog=P()) if trace_log else {}),
-            v_lo=P_shard,
-            v_hi=P_shard,
-            pl_child_lo=P_shard,
-            pl_child_hi=P_shard,
-            pl_par_lo=P_shard,
-            pl_par_hi=P_shard,
+            # SoA resident buffers shard along their ROW axis (axis 1
+            # of the [lanes, rows] layout).
+            vkeys=P(None, "shard"),
+            plog=P(None, "shard"),
             pl_n=P_shard,
-            frontier=P("shard", None),
+            frontier=P(None, "shard"),
             fval=P_shard,
             ebits=P_shard,
             n_loc=P_shard,
@@ -1101,33 +1122,40 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
 
     def _capture_final(self, carry) -> None:
         self._final_tables = (
-            carry["pl_child_lo"],
-            carry["pl_child_hi"],
-            carry["pl_par_lo"],
-            carry["pl_par_hi"],
+            carry["vkeys"],
+            carry["plog"],
             carry["pl_n"],
+            carry["u_loc"],
         )
 
     def _build_generated(self):
-        """Concatenate each shard's append-only (child, parent) log.
-        Per-shard arrays are laid out [S, L] after shard_map; pl_n[s]
-        rows of shard s are live."""
+        """Concatenate each shard's append-only parent log. The SoA
+        buffers come back concatenated along their sharded ROW axis
+        ([2, S*C_pad] / [2, S*L]); ``pl_n[s]`` entries of shard ``s``
+        are live. The log carries PARENT limbs only (round 9): shard
+        ``s``'s log entry ``i`` has its child key at the shard's
+        visited append index ``roots_s + i``, where the shard's root
+        count ``roots_s = u_loc[s] - pl_n[s]`` (the two counters
+        advance in lockstep on every clean wave)."""
         if self.generated is None:
-            c_lo, c_hi, p_lo, p_hi, pl_n = (
+            vkeys, plog, pl_n, u_loc = (
                 np.asarray(a) for a in self._final_tables
             )
             S = self.n_shards
-            L = c_lo.shape[0] // S
+            L = plog.shape[1] // S
+            C_pad = vkeys.shape[1] // S
             generated: dict = {}
             for s in range(S):
                 n = int(pl_n[s])
-                sl = slice(s * L, s * L + n)
+                roots = int(u_loc[s]) - n
+                vsl = slice(s * C_pad + roots, s * C_pad + roots + n)
+                psl = slice(s * L, s * L + n)
                 child = (
-                    c_hi[sl].astype(np.uint64) << np.uint64(32)
-                ) | c_lo[sl].astype(np.uint64)
+                    vkeys[1, vsl].astype(np.uint64) << np.uint64(32)
+                ) | vkeys[0, vsl].astype(np.uint64)
                 parent = (
-                    p_hi[sl].astype(np.uint64) << np.uint64(32)
-                ) | p_lo[sl].astype(np.uint64)
+                    plog[1, psl].astype(np.uint64) << np.uint64(32)
+                ) | plog[0, psl].astype(np.uint64)
                 for ch, pa in zip(child.tolist(), parent.tolist()):
                     generated[int(ch)] = int(pa) if pa else None
             self.generated = generated

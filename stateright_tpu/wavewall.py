@@ -151,8 +151,7 @@ def wave_wall_report(checker, reps: int = 8) -> dict:
                     fval=fval0,
                     ebits=c["ebits"],
                     n_frontier=base["n_frontier"],
-                    v_lo=c["v_lo"],
-                    v_hi=c["v_hi"],
+                    vkeys=c["vkeys"],
                     new=c["new"],
                     pl_n=c["pl_n"],
                     done=jnp.bool_(False),

@@ -706,8 +706,9 @@ def test_compiled_ordered_abd_3s_depth_differential():
 @pytest.mark.slow
 @pytest.mark.skipif(
     "STPU_EXHAUSTIVE" not in __import__("os").environ,
-    reason="~overnight-feasible host DFS (~1.2M states at host rates); "
-    "run with STPU_EXHAUSTIVE=1",
+    reason="~hour-scale host DFS (~1.2M states at host rates); "
+    "run with STPU_EXHAUSTIVE=1 (verified 2026-08-03: 1,212,979, "
+    "only 'value chosen' — PERF.md §counts)",
 )
 def test_abd_ordered_2c3s_exhaustive_host_pin():
     """Independent exhaustive verification of the ordered BENCH lane's

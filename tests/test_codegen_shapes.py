@@ -212,15 +212,17 @@ def _audit_engine_pair_pipeline(enc):
     EV = engine_pair_width(enc)  # the lint traces the same pipeline
     assert EV < K, "audit needs a real sparse pair width"
 
-    def pipe(frontier, fval):
+    def pipe(frontier_t, fval):
         return sparse_pair_candidates(
-            enc, frontier, fval, jnp.bool_(True),
+            enc, frontier_t, fval, jnp.bool_(True),
             EV=EV, B_p=N * EV, NT=1, T=N,
             mask_budget_cells=1 << 30, Ba=N * EV,
         )
 
+    # The [W, N] resident layout (round 9, PERF.md §layout) — the
+    # engines pass the transposed frontier block.
     jx = jax.make_jaxpr(pipe)(
-        jnp.zeros((N, enc.width), jnp.uint32),
+        jnp.zeros((enc.width, N), jnp.uint32),
         jnp.zeros((N,), bool),
     )
     return audit_jaxpr(jx, n=N, k=K)

@@ -1,0 +1,212 @@
+"""The transposed [W, N] resident layout (round 9, PERF.md §layout).
+
+Two halves:
+
+* **transpose-boundary round-trips** — the transposed invocation
+  adapters (encoding.py ``*_cols``, ops/fingerprint.py
+  ``fingerprint_u32v_t``) must be BIT-identical to the row-major
+  contract views on real encoded states, at the shapes the bench
+  lanes run (paxos 2c/3s: W=13 multi-word masks; 2pc rm=4 and the
+  rm=7 width class: W=2, L=1 scalar-word lane). Any divergence here
+  means the engines' [W, N] path explores a different space than the
+  row-major contract the encodings are pinned by.
+* **count parity** — the transposed engine reproduces the pinned
+  counts end-to-end: paxos 2c/3s = 16,668 and 2pc rm=7 = 296,448
+  (the rm=4 space rides tier-1 via test_sortmerge's sparse-vs-dense
+  parity), with discovery sets intact.
+
+Marked ``layout``; rides tier-1's ``-m 'not slow'`` run.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from stateright_tpu.encoding import (  # noqa: E402
+    enabled_bits_cols,
+    enabled_mask_cols,
+    property_conditions_cols,
+    step_slot_cols_fn,
+    within_boundary_cols,
+)
+from stateright_tpu.ops.fingerprint import (  # noqa: E402
+    fingerprint_u32v,
+    fingerprint_u32v_t,
+)
+
+pytestmark = pytest.mark.layout
+
+
+def _bfs_prefix_vecs(enc, limit=256):
+    """Real encoded states: init vecs + a host-BFS prefix, so the
+    adapter round-trips run on reachable field values, not random
+    bit patterns."""
+    from collections import deque
+
+    m = enc.host_model
+    seen = {}
+    q = deque(m.init_states())
+    for s in list(q):
+        seen[tuple(enc.encode(s).tolist())] = True
+    while q and len(seen) < limit:
+        s = q.popleft()
+        for t in m.next_states(s):
+            k = tuple(enc.encode(t).tolist())
+            if k not in seen:
+                seen[k] = True
+                q.append(t)
+    return jnp.asarray(np.array(sorted(seen), dtype=np.uint32))
+
+
+def _encodings():
+    from stateright_tpu.models.paxos import PaxosModelCfg
+    from stateright_tpu.models.paxos_tpu import PaxosEncoded
+    from stateright_tpu.models.two_phase_commit_tpu import (
+        TwoPhaseSysEncoded,
+    )
+
+    return [
+        PaxosEncoded(PaxosModelCfg(client_count=2, server_count=3)),
+        TwoPhaseSysEncoded(4),
+        # the rm=7 bench-lane width class (same W=2/L=1 layout at a
+        # wider slot range)
+        TwoPhaseSysEncoded(7),
+    ]
+
+
+def test_fingerprint_fold_transposed_bit_identical():
+    """fingerprint_u32v_t(x.T) == fingerprint_u32v(x), on numpy AND
+    under jit, across widths including the engines' real W."""
+    rng = np.random.default_rng(11)
+    for w in (1, 2, 13, 19, 32):
+        x = rng.integers(0, 2**32, size=(257, w), dtype=np.uint32)
+        lo_r, hi_r = fingerprint_u32v(x, np)
+        lo_t, hi_t = fingerprint_u32v_t(x.T, np)
+        assert (lo_r == lo_t).all() and (hi_r == hi_t).all()
+        lo_j, hi_j = jax.jit(
+            lambda v: fingerprint_u32v_t(v, jnp)
+        )(jnp.asarray(x.T))
+        assert (np.asarray(lo_j) == lo_r).all()
+        assert (np.asarray(hi_j) == hi_r).all()
+    # the transposed fold traces gather-free (it is row slices)
+    jx = jax.make_jaxpr(lambda v: fingerprint_u32v_t(v, jnp))(
+        jnp.zeros((13, 64), jnp.uint32)
+    )
+    assert not any(
+        "gather" in e.primitive.name for e in jx.jaxpr.eqns
+    )
+
+
+def test_transposed_adapters_round_trip():
+    """Every transposed adapter equals its row-major contract view on
+    real reachable states: bits, mask, properties, boundary, and the
+    step over every enabled (row, slot) pair."""
+    for enc in _encodings():
+        vecs = _bfs_prefix_vecs(enc)
+        vecs_t = vecs.T
+        bits_r = np.asarray(
+            jax.jit(jax.vmap(enc.enabled_bits_vec))(vecs)
+        )
+        bits_t = np.asarray(
+            jax.jit(lambda v, e=enc: enabled_bits_cols(e, v))(vecs_t)
+        )
+        assert (bits_r == bits_t).all(), type(enc).__name__
+        mask_r = np.asarray(
+            jax.jit(jax.vmap(enc.enabled_mask_vec))(vecs)
+        )
+        mask_t = np.asarray(
+            jax.jit(lambda v, e=enc: enabled_mask_cols(e, v))(vecs_t)
+        )
+        assert (mask_r == mask_t).all(), type(enc).__name__
+        props_r = np.asarray(
+            jax.jit(jax.vmap(enc.property_conditions_vec))(vecs)
+        )
+        props_t = np.asarray(
+            jax.jit(
+                lambda v, e=enc: property_conditions_cols(e, v)
+            )(vecs_t)
+        )
+        assert (props_r == props_t).all(), type(enc).__name__
+        wb_r = np.asarray(
+            jax.jit(jax.vmap(enc.within_boundary_vec))(vecs)
+        )
+        wb_t = np.asarray(
+            jax.jit(lambda v, e=enc: within_boundary_cols(e, v))(
+                vecs_t
+            )
+        )
+        assert wb_t.shape in ((), (vecs.shape[0],))
+        # value equality too, not just shape — a trivial boundary may
+        # come back as a broadcastable scalar on either view
+        n = vecs.shape[0]
+        assert (
+            np.broadcast_to(wb_r, (n,)) == np.broadcast_to(wb_t, (n,))
+        ).all(), type(enc).__name__
+
+        rows, slots = np.nonzero(mask_r)
+        step_r = np.asarray(
+            jax.jit(jax.vmap(enc.step_slot_vec))(
+                vecs[jnp.asarray(rows)],
+                jnp.asarray(slots.astype(np.uint32)),
+            )
+        )
+        succ_t, _, _ = jax.jit(step_slot_cols_fn(enc))(
+            vecs[jnp.asarray(rows)],
+            jnp.asarray(slots.astype(np.uint32)),
+        )
+        succ_t = np.asarray(succ_t)
+        assert succ_t.shape == (enc.width, rows.shape[0])
+        assert (succ_t.T == step_r).all(), type(enc).__name__
+        # and the transposed fold agrees on the successors
+        lo_r, hi_r = fingerprint_u32v(step_r, np)
+        lo_t, hi_t = fingerprint_u32v_t(succ_t, np)
+        assert (lo_r == lo_t).all() and (hi_r == hi_t).all()
+
+
+def test_layout_count_parity_paxos_2c3s():
+    """The transposed engine reproduces the pinned paxos 2c/3s count
+    (16,668) with the host discovery set, paths on (exercises the
+    derived-children parent log end to end)."""
+    from stateright_tpu.models.paxos import PaxosModelCfg, paxos_model
+
+    sm = (
+        paxos_model(PaxosModelCfg(client_count=2, server_count=3))
+        .checker()
+        .spawn_tpu_sortmerge(
+            capacity=1 << 15,
+            frontier_capacity=1 << 12,
+            cand_capacity=1 << 14,
+        )
+        .join()
+    )
+    assert sm.unique_state_count() == 16668
+    assert sorted(sm.discoveries()) == ["value chosen"]
+    for name, path in sm.discoveries().items():
+        prop = sm.model.property_by_name(name)
+        assert prop.condition(sm.model, path.last_state())
+
+
+def test_layout_count_parity_2pc_rm7():
+    """The transposed engine reproduces the pinned 2pc rm=7 bench-lane
+    count (296,448) — the largest CPU-feasible lane, exercising the
+    production compaction branches at real ladder depth."""
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    sm = (
+        TwoPhaseSys(rm_count=7)
+        .checker()
+        .spawn_tpu_sortmerge(
+            capacity=1 << 19,
+            frontier_capacity=1 << 16,
+            cand_capacity=1 << 19,
+            track_paths=False,
+        )
+        .join()
+    )
+    assert sm.unique_state_count() == 296448
+    sm.assert_properties()
+    assert sm.discovered_property_names() == {
+        "abort agreement", "commit agreement",
+    }

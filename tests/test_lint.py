@@ -68,9 +68,13 @@ def test_lint_clean_all_registered():
     # actually run was previously never audited).
     covered = {(p["encoding"], p["path"]) for p in report["paths"]}
     for spec in ENCODINGS:
-        for path in ("bits", "mask", "step",
+        for path in ("bits", "bits[t]", "mask", "step",
+                     "step[t]", "step[t1]",
                      "engine:single", "engine:single+compact",
                      "engine:sharded", "engine:sharded+compact"):
+            # bits[t]/step[t] are the transposed [W, N] invocations
+            # (round 9, registry.TRANSPOSED_PATHS) — every encoding
+            # must be audited in both invocation styles.
             assert (spec.name, path) in covered, (spec.name, path)
     assert any(p["path"] == "wave-body" for p in report["paths"])
 
@@ -84,18 +88,69 @@ def test_lint_registry_names_all_rules():
     }
 
 
-def test_wave_body_estimator_emits():
+def test_wave_body_estimator_emits_and_meets_budget():
     """The carry-copy-bytes estimator prices the class-ladder switch
-    on the wave-body fixture (informational — the number is the
-    static handle on ROADMAP's switch-carry-movement lever)."""
+    on the wave-body fixture, and since round 9 the fixture is GATED:
+    the measured switch-carry total must sit under its byte budget
+    (tables.CARRY_COPY_BYTE_BUDGETS — the static pin on the round-9
+    class collapse, PERF.md §layout)."""
+    from stateright_tpu.analysis.tables import CARRY_COPY_BYTE_BUDGETS
+
     findings, stats = lint_wave_body()
     assert not _errors(findings)
-    est = [f for f in findings if f.rule == "carry-copy-bytes"]
+    est = [f for f in findings
+           if f.rule == "carry-copy-bytes" and f.severity == "info"]
     assert len(est) == 1
     data = est[0].data
     assert data["switches"] > 0
     assert data["switch_carry_bytes"] > 0
     assert est[0].source  # attributed to the engine source line
+    # The fixture is budgeted, and the budget has teeth: the measured
+    # value is under it, but NOT by an order of magnitude (a budget
+    # 10x above the measurement would let the collapse regress half
+    # way back before failing).
+    budget = CARRY_COPY_BYTE_BUDGETS[est[0].encoding]
+    assert data["budget_bytes"] == budget
+    assert data["switch_carry_bytes"] <= budget
+    assert budget < 2 * data["switch_carry_bytes"]
+
+
+def test_lint_catches_carry_copy_budget_regression():
+    """Deliberate regression: a wave body whose switches carry more
+    bytes than the fixture budget must fail the gated rule with an
+    error naming both numbers (the pre-round-9 pattern — full carry
+    tuples crossing every class-ladder boundary)."""
+    from jax import lax
+
+    from stateright_tpu.analysis.tables import CARRY_COPY_BYTE_BUDGETS
+
+    fixture = "engine-fixture(2pc-rm3)"
+    budget = CARRY_COPY_BYTE_BUDGETS[fixture]
+    # One switch whose branches return a carry fatter than the whole
+    # budget (the estimator sums cond outvar bytes).
+    rows = (budget // 4) + 1024
+
+    def fat_switch(i, carry):
+        def br(c):
+            return dict(c, buf=c["buf"] + jnp.uint32(1))
+
+        return lax.switch(i, [br, br], carry)
+
+    ctx = TraceCtx(
+        path="wave-body", encoding=fixture, n=64, k=0,
+        sparse=False, allow_gathers=None, check_lane_alu=False,
+        check_branches=True,
+    )
+    jx = jax.make_jaxpr(fat_switch)(
+        jnp.int32(0), dict(buf=jnp.zeros(rows, jnp.uint32))
+    )
+    hits = [
+        f for f in _errors(run_rules(ctx, jx))
+        if f.rule == "carry-copy-bytes"
+    ]
+    assert hits, "over-budget switch carry not gated"
+    assert hits[0].data["switch_carry_bytes"] > budget
+    assert str(budget) in hits[0].message.replace(",", "")
 
 
 # -- the teeth -------------------------------------------------------------

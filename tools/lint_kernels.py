@@ -49,7 +49,11 @@ def _hlo_pricing(encodings) -> dict:
         HLO_WALL_CATEGORIES,
         parse_hlo_categories,
     )
-    from stateright_tpu.analysis.lint import LINT_N, engine_pipe_params
+    from stateright_tpu.analysis.lint import (
+        LINT_N,
+        engine_pipe_params,
+        engine_trace_operands,
+    )
     from stateright_tpu.checkers.tpu_sortmerge import (
         sparse_pair_candidates,
     )
@@ -64,18 +68,19 @@ def _hlo_pricing(encodings) -> dict:
         # variant.
         for compact in (False, True):
             params = engine_pipe_params(enc, n, compact)
+            # the [W, N] resident layout (registry.ENGINE_LAYOUT):
+            # full carry buffer + n_rows, same as the jaxpr traces
+            frontier, fval, n_rows = engine_trace_operands(enc, n)
 
-            def pipe(frontier, fval):
+            def pipe(frontier_t, fval):
                 return sparse_pair_candidates(
-                    enc, frontier, fval, jnp.bool_(True), **params
+                    enc, frontier_t, fval, jnp.bool_(True),
+                    n_rows=n_rows, **params,
                 )
 
             hlo = (
                 jax.jit(pipe)
-                .lower(
-                    jnp.zeros((n, enc.width), jnp.uint32),
-                    jnp.zeros((n,), bool),
-                )
+                .lower(frontier, fval)
                 .compile()
                 .as_text()
             )

@@ -104,8 +104,9 @@ def stage_profile(kind, n, caps, target):
         _ladder,
         sparse_pair_candidates,
     )
-    from stateright_tpu.checkers.tpu import frontier_props
-    from stateright_tpu.ops.fingerprint import fingerprint_u32v
+    from stateright_tpu.checkers.tpu import frontier_props_t
+    from stateright_tpu.encoding import pair_step_seam
+    from stateright_tpu.ops.fingerprint import fingerprint_u32v_t
 
     print(f"\n## stage profile: {kind} {n} (target={target})")
     c = _spawn(kind, n, caps, target=target)
@@ -113,6 +114,10 @@ def stage_profile(kind, n, caps, target):
     c.join()
     carry = c._final_carry
     enc = c.encoded
+    # The resident frontier is the transposed [W, F] block (round 9,
+    # PERF.md §layout); every stage below mirrors the engine's
+    # transposed invocation, including the one row-major seam
+    # transpose feeding the pair-step gathers.
     frontier = carry["frontier"]
     # Frontier rows past the last wave's class-local block are STALE
     # (round 6 carry rework) — the carried n_frontier is the live-row
@@ -155,7 +160,7 @@ def stage_profile(kind, n, caps, target):
     print(f"class: F_f={F_f} V_v={V_v} K={K} W={W} EV={EV} "
           f"B_p={B_p} NT={NT} Ba={Ba} chunked={chunked}")
 
-    frontier_f = frontier[:F_f]
+    frontier_f = frontier[:, :F_f]
     fval_f = jnp.arange(F_f) < n_rows
     ebits_f = carry["ebits"][:F_f]
     props = list(c.model.properties())
@@ -171,7 +176,7 @@ def stage_profile(kind, n, caps, target):
     def s_props(i, a):
         fr, acc = a
         fr = fr.at[0, 0].set(fr[0, 0] ^ i.astype(jnp.uint32))
-        cond, eb, f_lo, f_hi = frontier_props(
+        cond, eb, f_lo, f_hi = frontier_props_t(
             enc, props, evt_idx, fr, fval_f, ebits_f
         )
         acc = acc.at[0].add(
@@ -182,53 +187,21 @@ def stage_profile(kind, n, caps, target):
     results["props(frontier)"] = _timed(s_props, (frontier_f, acc0))
 
     # -- stage: enabled mask only (the [F,K] predicate pass) ------------
-    from stateright_tpu.ops.bitmask import mask_words
+    from stateright_tpu.checkers.tpu_sortmerge import (
+        frontier_enabled_bits,
+    )
 
-    L = mask_words(K)
     mb = c.mask_budget_cells
 
     def mask_only(fr):
-        # Mirror the engine (sparse_pair_candidates): packed bitmap
-        # words straight from the encoding when it provides them, the
-        # dense-mask packing fallback otherwise.
-        bits_fn = getattr(enc, "enabled_bits_vec", None)
-
-        def mask_bits(tf, tfv):
-            from stateright_tpu.ops.bitmask import (
-                mask_to_words,
-                popcount_words,
-            )
-
-            if bits_fn is not None:
-                tb = jax.vmap(bits_fn)(tf)
-                tb = jnp.where(tfv[:, None], tb, jnp.uint32(0))
-                return tb, popcount_words(jnp, tb)
-            m = jax.vmap(enc.enabled_mask_vec)(tf)
-            m = m & tfv[:, None]
-            tc = jnp.sum(m, axis=1, dtype=jnp.uint32)
-            return mask_to_words(jnp, m), tc
-
-        if F_f * K > mb:
-            NTm = _divisor_at_least(F_f, -(-F_f * K // mb))
-            Tm = F_f // NTm
-
-            def mtile(ti, acc):
-                bits_a, cnt_a = acc
-                off = ti * Tm
-                tf = lax.dynamic_slice(fr, (off, 0), (Tm, W))
-                tfv = lax.dynamic_slice(fval_f, (off,), (Tm,))
-                tb, tc = mask_bits(tf, tfv)
-                return (
-                    lax.dynamic_update_slice(bits_a, tb, (off, 0)),
-                    lax.dynamic_update_slice(cnt_a, tc, (off,)),
-                )
-
-            return lax.fori_loop(
-                0, NTm, mtile,
-                (jnp.zeros((F_f, L), jnp.uint32),
-                 jnp.zeros(F_f, jnp.uint32)),
-            )
-        return mask_bits(fr, fval_f)
+        # THE engine's mask pass (one shared home, the way
+        # encoding.pair_step_seam is the one pair-seam home): the
+        # profiler times the exact pipeline sparse_pair_candidates
+        # runs, transposed invocation, tiling and all.
+        return frontier_enabled_bits(
+            enc, fr, fval_f, jnp.bool_(True),
+            mask_budget_cells=c.mask_budget_cells,
+        )
 
     def s_mask(i, a):
         fr, acc = a
@@ -269,28 +242,34 @@ def stage_profile(kind, n, caps, target):
     n_pairs_i = int(np.asarray(n_pairs))
     print(f"real pairs this wave: {n_pairs_i} (Ba={Ba})")
 
-    from stateright_tpu.encoding import normalize_step_slot_result
+    # The engine's backend-adaptive pair-state seam, from its ONE
+    # home (encoding.pair_step_seam) — the profiler times exactly the
+    # policy the engines run.
+    cpu_backend = jax.default_backend() == "cpu"
+    step_cols, make_pair_states = pair_step_seam(enc, cpu_backend)
 
-    def step_pairs(st, sl):
-        return normalize_step_slot_result(
-            jax.vmap(enc.step_slot_vec)(st, sl)
-        )
+    def pair_states(fr, idx):
+        return make_pair_states(fr, fr)(idx)
 
     has_boundary = not getattr(enc, "trivial_boundary", False)
 
     # -- stage: step + fingerprint over Ba pairs ------------------------
     def eval_block(fr, pidx_b, live_b, slot_b):
+        from stateright_tpu.encoding import within_boundary_cols
+
         prow_b = pidx_b // jnp.uint32(EV)
-        succ_b, ptr_b, hard_b = step_pairs(fr[prow_b], slot_b)
+        succ_t, ptr_b, hard_b = step_cols(
+            pair_states(fr, prow_b), slot_b
+        )
         ok = live_b
         if hard_b is not None:
             ok = ok & ~hard_b
         if has_boundary:
-            inb = jax.vmap(enc.within_boundary_vec)(succ_b)
+            inb = within_boundary_cols(enc, succ_t)
             ok = ok & inb
         if ptr_b is not None:
             ok = ok & ~ptr_b
-        lo, hi = fingerprint_u32v(succ_b, jnp)
+        lo, hi = fingerprint_u32v_t(succ_t, jnp)
         lo = jnp.where(ok, lo, jnp.uint32(_SENT))
         hi = jnp.where(ok, hi, jnp.uint32(_SENT))
         return lo, hi
@@ -338,7 +317,7 @@ def stage_profile(kind, n, caps, target):
         lambda fr: eval_block(fr, pidx, live, pslot)
     )(frontier_f)
 
-    v_lo_full, v_hi_full = carry["v_lo"], carry["v_hi"]
+    v_lo_full, v_hi_full = carry["vkeys"][0], carry["vkeys"][1]
     M = V_v + Ba
 
     # -- stage: 3-lane merge sort --------------------------------------
@@ -390,13 +369,14 @@ def stage_profile(kind, n, caps, target):
     ebits_dummy = jnp.zeros(F_f, jnp.uint32)
 
     if pay_fetch:
-        # Mirror the engine's packed payload (succ ++ keys ++ meta);
+        # Mirror the engine's packed payload (succ ++ keys ++ meta —
+        # the one seam transpose back to rows at the gather staging);
         # profile the fetch at BOTH the max width and a typical
         # NF-class width (the engine's third ladder axis).
         succ_all = jax.jit(
-            lambda fr: step_pairs(
-                fr[pidx // jnp.uint32(EV)], pslot
-            )[0]
+            lambda fr: step_cols(
+                pair_states(fr, pidx // jnp.uint32(EV)), pslot
+            )[0].T
         )(frontier_f)
         pay = jnp.concatenate(
             [succ_all, ck_lo[:, None], ck_hi[:, None],
@@ -422,9 +402,11 @@ def stage_profile(kind, n, caps, target):
             fr, nf, acc = a
             nf = (nf + i.astype(jnp.uint32)) % jnp.uint32(Ba)
             par_row = pidx[nf] // jnp.uint32(EV)
-            succ_w, _, _ = step_pairs(fr[par_row], pslot[nf])
+            succ_w_t, _, _ = step_cols(
+                pair_states(fr, par_row), pslot[nf]
+            )
             q = ebits_dummy[par_row]
-            acc = acc.at[0].add(_fold(succ_w) + _fold(q))
+            acc = acc.at[0].add(_fold(succ_w_t) + _fold(q))
             return fr, nf, acc
 
         nf_row = jnp.arange(min(F, Ba), dtype=jnp.uint32) % jnp.uint32(Ba)

@@ -548,6 +548,40 @@ def main():
             f"tpu  {name}: unique={unique} sec={sec:.3f} "
             f"states/sec={sps:,.0f}"
         )
+        if hasattr(checker, "merge_impl"):
+            # merge_impl + merge-stage share (round 10): which
+            # visited-dedup implementation this lane ran (pallas |
+            # xla fallback) and an isolated re-timing of the dedup
+            # stage at the lane's converged class shapes
+            # (wavewall.merge_stage_estimate — synthetic keys, same
+            # program), so the pending BENCH_r06 chip run can A/B
+            # the kernel against these rows with trace_diff. The
+            # retired rebuild-sort path is re-timed alongside as the
+            # denominator; share_est = dedup_ms x waves / wall.
+            from stateright_tpu.wavewall import merge_stage_estimate
+
+            est = merge_stage_estimate(checker)
+            waves = checker.metrics.get("waves")
+            detail[name]["merge_impl"] = est["impl"]
+            detail[name]["merge_stage"] = {
+                **est,
+                "waves": waves,
+                "share_est": (
+                    round(est["dedup_ms"] * (waves or 0) / 1000.0
+                          / sec, 4)
+                    if sec else None
+                ),
+            }
+            _stderr(
+                f"     merge[{est['impl']}]: dedup "
+                f"{est['dedup_ms']:.2f} ms/wave (sort "
+                f"{est['cand_sort_ms']:.2f} + member "
+                f"{est['member_ms']:.2f} + wcompact "
+                f"{est['winner_compact_ms']:.2f} + append "
+                f"{est['append_ms']:.2f}) vs retired rebuild "
+                f"{est['rebuild_sort_ms']:.2f}; share~"
+                f"{detail[name]['merge_stage']['share_est']}"
+            )
         if hybrid_spawn is not None:
             # Sub-100k lanes finish in ~one axon RTT on the wave
             # engine, so their states/sec row reads as hundreds where
@@ -599,6 +633,14 @@ def main():
                 "provenance": provenance(
                     lane={
                         "headline": headline_name,
+                        **({
+                            "merge_impl":
+                                detail[headline_name]["merge_impl"],
+                            "merge_stage":
+                                detail[headline_name]["merge_stage"],
+                        } if headline_name in detail
+                            and "merge_impl" in detail[headline_name]
+                            else {}),
                         **({"lint": lint_ref}
                            if lint_ref is not None else {}),
                     }

@@ -211,13 +211,18 @@ def trace_engine_pipeline(enc, engine: str = "single",
     return jax.make_jaxpr(sm)(frontier, fval)
 
 
-def trace_wave_body_fixture(track_paths: bool = True):
+def trace_wave_body_fixture(track_paths: bool = True,
+                            merge_impl: str = "xla"):
     """``(name, ClosedJaxpr)`` of the single-chip sort-merge engine's
     full wave body — class ladders, merge switches, fetch-class
     branches — built (never run) on a small 2pc model with short
     ladders so the switch structure is multi-class. Abstract-traced
     via ``eval_shape`` on the seed program, so no device buffers are
-    allocated."""
+    allocated. ``merge_impl`` selects the visited-dedup invocation
+    style (round 10): the gate traces the wave body once per
+    implementation so the branch rules and the carry-copy budget
+    price both the XLA-fallback and the Pallas-kernel wave programs
+    (tables.CARRY_COPY_BYTE_BUDGETS keys both names)."""
     import jax
     import jax.numpy as jnp
 
@@ -231,14 +236,85 @@ def trace_wave_body_fixture(track_paths: bool = True):
         v_min=256,
         track_paths=track_paths,
         waves_per_sync=4,
+        merge_impl=merge_impl,
     )
     init = jnp.asarray(checker.encoded.init_vecs())
     seed_fn, _chunk_fn = checker._build_programs(init.shape[0])
     carry_shapes = jax.eval_shape(seed_fn, init)
+    tag = "" if merge_impl == "xla" else f",merge={merge_impl}"
     return (
-        "engine-fixture(2pc-rm3)",
+        f"engine-fixture(2pc-rm3{tag})",
         jax.make_jaxpr(checker._wave_body)(carry_shapes),
     )
+
+
+def trace_merge_kernels(n: int = LINT_N) -> dict:
+    """``{label: ClosedJaxpr}`` of the streaming-merge dedup ops
+    (registry.MERGE_KERNEL_PATHS): membership and visited append,
+    each in both implementations, at a production-shaped fixture —
+    a sorted 8n-row visited prefix, 4n sorted candidates, an n-row
+    winner block (jaxprs are shape-relative, so any fixed multiple
+    works; these mirror the engines' V ≫ B ≫ NF ordering). Pallas
+    paths trace on CPU too (``pallas_call`` abstract-evals without
+    running), so the CPU CI audits the kernel invocation the chip
+    will run."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.merge import member_sorted, merge_sorted, pallas_available
+
+    V, B, NF = 8 * n, 4 * n, n
+    a = (jnp.zeros(V, jnp.uint32), jnp.zeros(V, jnp.uint32))
+    q = (jnp.zeros(B, jnp.uint32), jnp.zeros(B, jnp.uint32))
+    w = (jnp.zeros(NF, jnp.uint32), jnp.zeros(NF, jnp.uint32))
+    impls = ("xla",) + (("pallas",) if pallas_available() else ())
+    out = {}
+    for impl in impls:
+        out[f"merge:member:{impl}"] = jax.make_jaxpr(
+            lambda al, ah, ql, qh, _i=impl: member_sorted(
+                al, ah, ql, qh, impl=_i
+            )
+        )(*a, *q)
+        out[f"merge:append:{impl}"] = jax.make_jaxpr(
+            lambda al, ah, bl, bh, _i=impl: merge_sorted(
+                al, ah, bl, bh, impl=_i
+            )
+        )(*a, *w)
+    return out
+
+
+def lint_merge_kernels(n: int = LINT_N) -> tuple:
+    """Run the rule registry over the merge-kernel invocations.
+    Gathers are unaudited by design on these paths — the XLA
+    fallback's vectorized binary search IS gathers, and the Pallas
+    partition search is too; what the rules pin is the absence of
+    dense masks and (on the XLA fallback) the 1-D lane discipline.
+    The in-kernel [block, block] rank temporaries are the kernel's
+    own idiom, so the lane-ALU rule stays off the pallas paths."""
+    findings: list = []
+    stats: list = []
+    for label, closed in trace_merge_kernels(n).items():
+        ctx = TraceCtx(
+            path=label,
+            encoding="ops/merge",
+            n=n,
+            k=0,
+            sparse=False,
+            allow_gathers=None,
+            check_lane_alu=label.endswith(":xla"),
+            check_branches=False,
+        )
+        fs, n_eqns = run_rules_with_stats(ctx, closed)
+        findings.extend(fs)
+        stats.append(
+            dict(
+                encoding="ops/merge",
+                path=label,
+                eqns=n_eqns,
+                errors=sum(1 for f in fs if f.severity == "error"),
+            )
+        )
+    return findings, stats
 
 
 def _ctx_for_path(spec: EncodingSpec, enc, label: str,
@@ -329,10 +405,11 @@ def lint_encoding(spec: EncodingSpec,
     return findings, stats
 
 
-def lint_wave_body() -> tuple:
+def lint_wave_body(merge_impl: str = "xla") -> tuple:
     """Run the branch-shape rule and the carry-copy-bytes estimator
-    over the engine wave-body fixture."""
-    name, closed = trace_wave_body_fixture()
+    over the engine wave-body fixture (once per merge
+    implementation; see trace_wave_body_fixture)."""
+    name, closed = trace_wave_body_fixture(merge_impl=merge_impl)
     ctx = TraceCtx(
         path="wave-body",
         encoding=name,
@@ -379,10 +456,19 @@ def run_lint(encodings: Optional[tuple] = None,
         fs, st = lint_encoding(spec, engines, n)
         all_findings.extend(fs)
         all_stats.extend(st)
+    fs, st = lint_merge_kernels(n)
+    all_findings.extend(fs)
+    all_stats.extend(st)
     if wave_body:
-        fs, st = lint_wave_body()
-        all_findings.extend(fs)
-        all_stats.extend(st)
+        from ..ops.merge import pallas_available
+
+        impls = ("xla",) + (
+            ("pallas",) if pallas_available() else ()
+        )
+        for impl in impls:
+            fs, st = lint_wave_body(merge_impl=impl)
+            all_findings.extend(fs)
+            all_stats.extend(st)
     errors = [f for f in all_findings if f.severity == "error"]
     return dict(
         clean=not errors,

@@ -47,6 +47,21 @@ ENGINE_LAYOUT = "[W,N]"
 #: mask and step kernels (enabled_bits_cols / step_slot_cols_fn).
 TRANSPOSED_PATHS = ("bits[t]", "step[t]", "step[t1]")
 
+#: the streaming-merge dedup invocations (round 10, ops/merge.py)
+#: the lint traces alongside the encodings: both ops (membership,
+#: visited append) × both implementations, at production-shaped
+#: sorted fixtures. Encoding-independent — the kernels see only
+#: 2-limb key lanes — so they trace once, not per encoding; the
+#: engines' use of them is additionally covered by the wave-body
+#: fixture, which run_lint traces once per implementation so the
+#: five gated rules AND the carry-copy-bytes budget price the full
+#: wave body in BOTH merge invocation styles
+#: (tables.CARRY_COPY_BYTE_BUDGETS keys both fixture names).
+MERGE_KERNEL_PATHS = (
+    "merge:member:xla", "merge:append:xla",
+    "merge:member:pallas", "merge:append:pallas",
+)
+
 
 @dataclass(frozen=True)
 class EncodingSpec:

@@ -12,13 +12,18 @@ engine architects the wave around sorts, the classic vector-machine
 model-checking layout:
 
 * The visited set is a **sorted fingerprint array** (two uint32 limb
-  lanes, all-ones sentinel padding), not a hash table.
+  lanes, all-ones sentinel padding), not a hash table — and since
+  round 10 it is kept **incrementally sorted**: every wave merges its
+  winners into the sorted prefix with a streaming linear merge
+  (``ops/merge.py`` — a Pallas kernel on chip, a sort-free XLA
+  fallback elsewhere), so no per-wave pass ever sorts O(C) rows.
 * Per wave: vmap-expand the frontier → fingerprint candidates →
-  compact the valid candidates (tiled top-B sorts) → one stable merge
-  sort against the visited prefix (visited first, so first-of-run
-  marks the winner and intra-wave duplicates resolve for free) →
-  rebuild the deduplicated visited array → compact the new states
-  into the next frontier.
+  compact the valid candidates (tiled top-B sorts) → ONE B-row
+  candidate order sort + a streaming membership pass against the
+  sorted visited prefix (visited wins ties; intra-wave duplicates
+  resolve on the adjacent-equal check, first-of-buffer-order wins) →
+  linear-merge the ≤F winner keys into the visited prefix → compact
+  the new states into the next frontier.
 * The parent forest is an **append-only device log** of
   (child, parent) fingerprint pairs written with
   ``dynamic_update_slice`` — contiguous writes, no scatter — drained
@@ -64,10 +69,42 @@ PERF.md §layout).** Resident state lives COLUMN-major:
   carry copy the class-ladder switches materialize — vanishes; the
   fingerprint fold measured 1.65x col-major on chip),
 * the visited keys are one SoA block ``vkeys: uint32[2, C_pad]``
-  (lane 0 = lo limb, lane 1 = hi limb),
-* the parent log carries PARENT limbs only, ``plog: uint32[2, L]`` —
-  the child keys are exactly the visited append, so the drain
-  derives them from ``vkeys`` instead of carrying them twice.
+  (lane 0 = lo limb, lane 1 = hi limb), rows ``[0, new)`` a dense
+  SORTED prefix of real keys (round 10),
+* the parent log is ``plog: uint32[4, L]`` — parent limbs in lanes
+  0-1, child limbs in lanes 2-3. (Round 9 derived the children from
+  ``vkeys`` at drain — the visited append then WAS the insertion
+  order; the round-10 sorted merge re-orders the visited rows every
+  wave, so the log carries the child keys again. The two extra lanes
+  exist only when ``track_paths`` allocates the log at all; the
+  headline perf lanes run paths-off with ``L = 0``.)
+
+**Incrementally sorted visited + streaming merge (round 10, PERF.md
+§merge-kernel).** Rounds 5-9 kept the visited array append-only and
+unsorted, paying a from-scratch ``(V_v + B)``-row stable 3-lane
+``lax.sort`` every wave to dedup — the irreducible b·V floor the
+wave-wall work kept exposing. The sorted invariant replaces that
+rebuild with B-scale sorts plus two O(V + B) streaming passes:
+
+* ONE ``B_eff``-row stable sort orders the wave's candidates (the
+  only place candidate keys are sorted; the tiled compaction's
+  per-tile sorts remain, but nothing re-sorts visited rows),
+* membership rides a streaming pass over the sorted visited prefix
+  (``ops.merge.member_sorted`` — Pallas linear merge on chip, 2-limb
+  binary search on the XLA fallback), and intra-wave duplicates
+  resolve on the adjacent-equal check of the sorted candidates
+  (stable sort ⇒ lowest buffer position wins, matching the old
+  stable-concat winner exactly),
+* the visited append is a linear merge of the ≤F sorted winner keys
+  into the prefix (``ops.merge.merge_sorted``), written back as one
+  class-local ``dynamic_update_slice`` block under the v-class
+  switch.
+
+The ``merge_impl`` knob (None = auto: Pallas on TPU, XLA fallback on
+CPU/old JAX; ``"pallas_interpret"`` runs the same kernel under the
+Pallas interpreter so tier-1 pins it on CPU) selects the
+implementation; it is cache-keyed and recorded in lane configs and
+bench provenance.
 
 Boundary transposes happen only at host upload/download and at the
 table-gather seams where row-major genuinely wins (PERF.md §gathers:
@@ -76,12 +113,14 @@ payload gathers measured equal either way, so gather staging keeps
 row gathers is the one sanctioned seam copy).
 
 The (f, v) class ladder no longer copies full carry tuples between
-branches: the v-class switch runs a merge CORE returning one shared
-SoA result (``nf_pos[NF]`` + ``new_count`` — a few KB regardless of
-class), a single fetch-class switch per wave updates the resident
-buffers with class-local ``dynamic_update_slice`` blocks, and the
-next carry is assembled outside any switch. The ``carry-copy-bytes``
-lint rule (now GATED, analysis/tables.py budgets) pins the collapse.
+branches: the v-class switch runs the B-scale membership pass (its
+only branch output is a ``bool[B_eff]`` mask), ONE fetch-class switch
+per wave updates frontier/ebits/plog with class-local
+``dynamic_update_slice`` blocks, a second v-class switch merges the
+winner keys into ``vkeys`` (its only branch output is the updated
+``vkeys`` buffer), and the next carry is assembled outside any
+switch. The ``carry-copy-bytes`` lint rule (GATED,
+analysis/tables.py budgets) pins the collapse.
 """
 
 from __future__ import annotations
@@ -97,6 +136,12 @@ from ..encoding import (
 from ..model import Expectation
 from ..ops.bitmask import mask_words
 from ..ops.fingerprint import fingerprint_u32v, fingerprint_u32v_t
+from ..ops.merge import (
+    compact_winners,
+    member_sorted,
+    merge_sorted,
+    resolve_impl,
+)
 from ..ops.u64 import U64, u64_add
 from .tpu import (
     TpuBfsChecker,
@@ -404,16 +449,21 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         f_min: int = 1 << 15,
         v_min: int = 1 << 19,
         ladder_step: int = 2,
-        # Round 6: the visited ladder default tightened 4 -> 2 (the
-        # wave-wall profile showed class-quantization waste as a
-        # leading out-of-stage term, and every hand-tuned big-lane
-        # config had already overridden to 2; the persistent XLA cache
-        # absorbs the extra merge variants' compile time).
+        # Round 10 re-derivation: the v-ladder now prices LINEARLY —
+        # the streaming passes cost a·V_v + b·B, so a class step of s
+        # wastes at most (s-1)x of the V-term on the worst wave
+        # (round 6's superlinear-sort argument priced the same waste
+        # at (s-1)·log extra compare passes). At step 2 the bound is
+        # 2x on a term that is now ~5x cheaper per row (PERF.md
+        # §merge-kernel CPU A/B); step 4 would re-expose up to 4x of
+        # it for ~half the compiled merge variants — the compile time
+        # the persistent XLA cache already absorbs. 2 stays optimal.
         v_ladder_step: int = 2,
         flat_budget_bytes: int = 1 << 30,
         sparse: bool | None = None,
         pair_width: int | None = None,
         mask_budget_cells: int = 1 << 23,
+        merge_impl: str | None = None,
         **kwargs,
     ):
         #: ``cand_capacity="auto"`` (VERDICT r4 item 7): size the
@@ -444,6 +494,11 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         self.sparse = sparse
         self.pair_width = pair_width
         self.mask_budget_cells = mask_budget_cells
+        #: visited-dedup implementation (ops/merge.py): None = auto
+        #: (Pallas kernel on TPU, sort-free XLA fallback on CPU/old
+        #: JAX); "pallas_interpret" runs the kernel under the Pallas
+        #: interpreter — the tier-1 CPU gate for the kernel itself.
+        self.merge_impl = resolve_impl(merge_impl)
         if tiles > 1 and self.frontier_capacity % tiles:
             raise ValueError(
                 f"frontier_capacity {self.frontier_capacity} not divisible "
@@ -532,7 +587,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             except (OSError, ValueError):
                 pass
             data[self._budget_key()] = {
-                "cand_capacity": self.cand_capacity,
+                "cand_capacity": self._shrunk_cand_capacity(),
                 "pair_width": (
                     self._pair_width() if self._use_sparse() else None
                 ),
@@ -543,9 +598,48 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 json.dump(data, fh, indent=1, sort_keys=True)
             os.replace(tmp, path)
 
+    #: shrink target: persisted budget heads toward observed_peak *
+    #: this margin on clean runs; shrink fires only past 2x headroom
+    #: so a near-peak budget isn't thrashed by wave-to-wave noise.
+    _SHRINK_MARGIN = 1.25
+
+    def _shrunk_cand_capacity(self):
+        """The cand_capacity to PERSIST (VERDICT/ROADMAP carried item):
+        the budget store only ever grew, so a lane whose growth
+        heuristic overshot kept its headroom forever — paxos-4
+        converged at 2,097,152 against an observed peak of 660,492,
+        3.2x slack that silently pushed the padded-residency gate into
+        CHUNKED memory-lean mode and paid recompute fetch every wave.
+        On a CLEAN run (no overflow retry this process — a just-grown
+        budget is geometric, not measured, and must survive to the
+        next run) with more than 2x headroom over the measured peak,
+        persist ``observed_peak * margin`` instead; the running
+        checker keeps its budget (programs are compiled), the next
+        process picks up the shrunk one. Emits ``auto_budget_shrink``
+        so TRACE artifacts show the resize."""
+        cap = self.cand_capacity
+        peak = self.metrics.get("max_wave_candidates")
+        if (
+            not cap
+            or not peak
+            or getattr(self, "_budget_grew", False)
+        ):
+            return cap
+        want = max(int(peak * self._SHRINK_MARGIN), 1024)
+        if cap <= 2 * want:
+            return cap
+        from .. import telemetry
+
+        telemetry.emit(
+            "auto_budget_shrink", kind="cand_capacity", old=cap,
+            new=want, observed_peak=int(peak),
+        )
+        return want
+
     def _run(self, reporter=None) -> None:
         if not self.auto_budget:
             return super()._run(reporter)
+        self._budget_grew = False
         last_exc = None
         for _attempt in range(6):
             if last_exc is not None:
@@ -606,6 +700,11 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
 
         from .. import telemetry
 
+        # An overflow-grown budget is a geometric guess, not a
+        # measurement: the clean-run shrink must not fire on it
+        # (_shrunk_cand_capacity).
+        self._budget_grew = True
+
         warnings.warn(
             f"auto-budget: {kind} {old} -> {new} after a buffer "
             f"overflow (retry {attempt + 1}); the resized wave "
@@ -655,6 +754,9 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             self._use_sparse(),
             self._pair_width(),
             self.mask_budget_cells,
+            # the visited-dedup implementation changes the compiled
+            # wave program (Pallas kernel vs XLA fallback).
+            self.merge_impl,
             # traced runs carry the wave log: a different program.
             self._wave_log_enabled(),
         )
@@ -693,6 +795,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             v_ladder_step=self.v_ladder_step,
             flat_budget_bytes=self.flat_budget_bytes,
             mask_budget_cells=self.mask_budget_cells,
+            merge_impl=self.merge_impl,
         )
         return lane
 
@@ -777,18 +880,15 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             both = (lo == jnp.uint32(_SENT)) & (hi == jnp.uint32(_SENT))
             return lo, jnp.where(both, jnp.uint32(_SENT - 1), hi)
 
-        # The visited array is APPEND-ONLY and UNSORTED (round 5): the
-        # stable merge sort that detects duplicates sorts the
-        # concatenation of visited prefix and candidates, so it never
-        # required the visited rows to be internally ordered — only to
-        # PRECEDE the candidates in the concat (stability makes
-        # first-of-run the visited copy). Each wave appends its
-        # winners' keys as a sentinel-padded F-row block at the running
-        # unique-count offset, replacing the former 2-lane
-        # (V_v + B)-row rebuild sort — the per-wave b·V term VERDICT r4
-        # item 2 names. Rows [0, u) are dense real keys; [u, u+F) may
-        # hold sentinel tails of earlier blocks (harmless: the merge
-        # treats sentinel rows as padding), hence the F-row headroom.
+        # The visited array is INCREMENTALLY SORTED (round 10): rows
+        # [0, u) are a dense sorted run of real keys, [u, C_pad) all-
+        # ones sentinels. Each wave's merge_stage linear-merges the
+        # ≤F sorted winner keys into the prefix (ops/merge.py) — the
+        # invariant every streaming pass (membership, append) depends
+        # on, and the one the module docstring's "sorted fingerprint
+        # array" line has described since round 2. The F rows of
+        # headroom let the class-local [0, V_v + NF) merged-block
+        # write land inside the buffer even at V_v == C.
         C_pad = C + F
 
         def seed(init_rows):
@@ -798,6 +898,10 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             # gather seams only).
             lo0, hi0 = fingerprint_u32v(init_rows, jnp)
             lo0, hi0 = clamp_keys(lo0, hi0)
+            # Seed the SORTED invariant: the init keys are the first
+            # visited prefix, so they go in (hi, lo)-ordered (an
+            # n0-row sort, once, at upload).
+            hi0, lo0 = lax.sort((hi0, lo0), num_keys=2)
             vkeys = (
                 jnp.full((2, C_pad), _SENT, jnp.uint32)
                 .at[0, :n0].set(lo0)
@@ -819,7 +923,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             return dict(
                 vkeys=vkeys,
                 **extra,
-                plog=jnp.zeros((2, L), jnp.uint32),
+                plog=jnp.zeros((4, L), jnp.uint32),
                 pl_n=jnp.uint32(0),
                 frontier=frontier,
                 fval=fval,
@@ -875,30 +979,38 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                         disc_found, disc_lo, disc_hi, c_overflow,
                         e_overflow, max_tile_cand, max_rowen=None,
                         wv_pairs=None):
-            """The class-collapsed merge (round 9, PERF.md §layout).
+            """The streaming-merge dedup (round 10, PERF.md
+            §merge-kernel), class-collapsed per round 9: no switch
+            branch ever returns more than one resident buffer.
 
-            Round 6's shape nested THREE full-carry switch boundaries
-            per wave — a v-class merge switch inside every f-branch
-            and a fetch-class switch inside every merge branch, each
-            branch returning the WHOLE updated carry — so XLA
-            materialized the full carry tuple at every boundary (the
-            ~21-switch / 1.4 MB-per-wave term the carry-copy-bytes
-            lint priced on the 2pc fixture). Now:
-
-            * the v-ladder switch runs a merge CORE that never touches
-              the carry: one stable 3-lane merge sort (visited-first ⇒
-              first-of-run wins, intra-wave duplicates resolve for
-              free) plus the 1-lane winner-position sort, returning
-              ONE shared SoA result — ``(nf_pos[NF], new_count)`` — a
-              few KB regardless of class; all M-sized tensors stay
-              branch-internal;
+            * ONE stable 3-lane ``B_eff``-row sort orders the wave's
+              candidates by key with the buffer position as the value
+              lane — the only per-wave sort whose row count exceeds
+              the winner block, and it is B-scale: the ``(V_v +
+              B)``-row concat sort this stage ran through round 9 is
+              gone (the b·V term). Stability keeps equal keys in
+              buffer order, so the adjacent-equal check makes the
+              lowest-position candidate the intra-wave winner —
+              exactly the old stable-concat-sort winner;
+            * the v-ladder switch runs the MEMBERSHIP pass against
+              the sorted visited prefix (``ops.merge.member_sorted``:
+              the Pallas streaming kernel or the binary-search XLA
+              fallback, per ``merge_impl``); its only branch output
+              is the ``bool[B_eff]`` mask;
+            * winners — in KEY order, which IS their order in the
+              sorted candidate array — come out of one order-
+              preserving 4-lane compaction sort (B-scale), yielding
+              ``nf_pos`` (buffer positions, for the fetch gather) and
+              the sorted winner keys the visited merge consumes;
             * ONE fetch-class switch per wave (the third ladder axis,
               sized to this wave's new_count) gathers the winners and
-              updates the four resident buffers — frontier, ebits,
-              ``vkeys``, ``plog`` — with class-local
+              updates frontier, ebits, and ``plog`` with class-local
               ``dynamic_update_slice`` blocks; rows past the block
-              keep stale values, which ``fval`` masks everywhere (the
-              invariant the sentinel tails already relied on);
+              keep stale values, which ``fval`` masks everywhere;
+            * a second v-class switch linear-merges the winner keys
+              into ``vkeys`` (``ops.merge.merge_sorted`` + one
+              class-local block write — no O(C)-row sort); its only
+              branch output is the updated ``vkeys``;
             * the next carry is assembled OUTSIDE any switch.
 
             ``fetch(nf_row)`` returns ``(state_cols[W, n], par_lo,
@@ -909,49 +1021,53 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             winner block once, the sanctioned seam copy). The keys
             still ride the SAME packed gather as the payload (PERF.md
             §gathers: one multi-lane gather, never N scalar
-            gathers)."""
+            gathers); with the fetch order now key-sorted they land
+            in ``plog``'s child lanes ascending, same values the
+            visited merge gets from the compaction sort."""
             NF = min(F, B_eff)
 
-            def merge_core(vc):
+            cpos = jnp.arange(1, B_eff + 1, dtype=jnp.uint32)
+            s_hi, s_lo, s_pos = lax.sort(
+                (ck_hi, ck_lo, cpos), num_keys=2
+            )
+            real = ~(
+                (s_hi == jnp.uint32(_SENT))
+                & (s_lo == jnp.uint32(_SENT))
+            )
+            prev_same = jnp.concatenate(
+                [
+                    jnp.zeros(1, bool),
+                    (s_hi[1:] == s_hi[:-1])
+                    & (s_lo[1:] == s_lo[:-1]),
+                ]
+            )
+            fresh = real & ~prev_same
+
+            def member_core(vc):
                 V_v = v_ladder[vc]
 
                 def br(_):
-                    m_hi = jnp.concatenate([c["vkeys"][1, :V_v], ck_hi])
-                    m_lo = jnp.concatenate([c["vkeys"][0, :V_v], ck_lo])
-                    m_pos = jnp.concatenate(
-                        [
-                            jnp.zeros(V_v, jnp.uint32),
-                            jnp.arange(1, B_eff + 1, dtype=jnp.uint32),
-                        ]
+                    return member_sorted(
+                        c["vkeys"][0, :V_v], c["vkeys"][1, :V_v],
+                        s_lo, s_hi, impl=self.merge_impl,
                     )
-                    m_hi, m_lo, m_pos = lax.sort(
-                        (m_hi, m_lo, m_pos), num_keys=2
-                    )
-                    real = ~(
-                        (m_hi == jnp.uint32(_SENT))
-                        & (m_lo == jnp.uint32(_SENT))
-                    )
-                    prev_same = jnp.concatenate(
-                        [
-                            jnp.zeros(1, bool),
-                            (m_hi[1:] == m_hi[:-1])
-                            & (m_lo[1:] == m_lo[:-1]),
-                        ]
-                    )
-                    is_new = real & ~prev_same & (m_pos > 0)
-                    new_count = jnp.sum(is_new)
-                    nf_pos = jnp.where(is_new, m_pos, jnp.uint32(_SENT))
-                    (nf_pos,) = lax.sort((nf_pos,), num_keys=1)
-                    # M = V_v + B_eff >= B_eff >= NF, so the slice
-                    # always has enough rows.
-                    return nf_pos[:NF], new_count
 
                 return br
 
-            nf_pos, new_count = lax.switch(
+            in_visited = lax.switch(
                 v_class,
-                [merge_core(vc) for vc in range(len(v_ladder))],
+                [member_core(vc) for vc in range(len(v_ladder))],
                 0,
+            )
+            is_new = fresh & ~in_visited
+            new_count = jnp.sum(is_new)
+            # Order-preserving winner compaction (ops/merge.py,
+            # impl-adaptive: O(B) rank scatter on the XLA fallback,
+            # one 4-lane B-scale sort on the Pallas/TPU path):
+            # winners lead in key order, the order every consumer now
+            # shares (fetch block, plog append, visited merge).
+            nf_pos, w_lo, w_hi = compact_winners(
+                is_new, s_pos, s_lo, s_hi, NF, impl=self.merge_impl
             )
 
             overflow = c["overflow"] | (
@@ -988,42 +1104,61 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                         jnp.where(valid, row_ebits, 0),
                         (z,),
                     )
-                    # Visited append: the winners' keys as one
-                    # [2, NF_c] sentinel-padded SoA block at the
-                    # running unique-count offset (no sort, no
-                    # scatter).
-                    vkeys2 = lax.dynamic_update_slice(
-                        c["vkeys"],
-                        jnp.stack([
-                            jnp.where(valid, key_lo, jnp.uint32(_SENT)),
-                            jnp.where(valid, key_hi, jnp.uint32(_SENT)),
-                        ]),
-                        (z, c["new"]),
-                    )
-                    # Parent-log append: PARENT limbs only — the child
-                    # keys of log entry i are exactly the visited
-                    # append above (vkeys[:, roots + i]), so the drain
-                    # derives them from vkeys instead of carrying two
-                    # more C-row lanes through every wave
-                    # (_build_generated).
+                    # Parent-log append: parent AND child limbs —
+                    # the sorted visited merge re-orders vkeys rows
+                    # every wave, so the round-9 derive-children-
+                    # from-vkeys drain no longer has an insertion
+                    # order to read; the log carries the child keys
+                    # again (lanes 2-3), in the same key-sorted
+                    # fetch order as the parents (_build_generated).
                     if track_paths:
                         plog2 = lax.dynamic_update_slice(
                             c["plog"],
                             jnp.stack([
                                 jnp.where(valid, par_lo, 0),
                                 jnp.where(valid, par_hi, 0),
+                                jnp.where(valid, key_lo, 0),
+                                jnp.where(valid, key_hi, 0),
                             ]),
                             (z, c["pl_n"]),
                         )
                     else:
                         plog2 = c["plog"]
-                    return frontier2, ebits2, vkeys2, plog2
+                    return frontier2, ebits2, plog2
 
                 return br
 
-            next_frontier, next_ebits, vkeys_new, plog_new = lax.switch(
+            next_frontier, next_ebits, plog_new = lax.switch(
                 nf_class,
                 [make_fetch(n) for n in nf_ladder],
+                0,
+            )
+
+            # Visited append: linear-merge the sorted winner block
+            # into the sorted prefix and write the merged run back as
+            # ONE class-local block at offset 0 (rows past V_v + NF
+            # are untouched sentinels by the C_pad headroom). The
+            # branch output is vkeys alone — the same single-resident-
+            # buffer switch discipline as the fetch switch above.
+            def append_core(vc):
+                V_v = v_ladder[vc]
+
+                def br(_):
+                    m_lo, m_hi = merge_sorted(
+                        c["vkeys"][0, :V_v], c["vkeys"][1, :V_v],
+                        w_lo, w_hi, impl=self.merge_impl,
+                    )
+                    return lax.dynamic_update_slice(
+                        c["vkeys"],
+                        jnp.stack([m_lo, m_hi]),
+                        (jnp.uint32(0), jnp.uint32(0)),
+                    )
+
+                return br
+
+            vkeys_new = lax.switch(
+                v_class,
+                [append_core(vc) for vc in range(len(v_ladder))],
                 0,
             )
 
@@ -1802,23 +1937,20 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         """Materialize child→parent from the append-only device log
         (the lazy download; roots are simply absent from the log).
 
-        The log carries PARENT limbs only (round 9): log entry ``i``'s
-        child key IS the visited append at index ``roots + i`` —
-        ``pl_n`` advances in lockstep with ``new`` on every clean wave
-        (``pl_n == new - roots``), so the root count falls out of the
-        final counters and the children read straight out of
-        ``vkeys`` (rows [0, new) are dense real keys by the append
-        invariant)."""
+        The log carries BOTH key pairs (round 10): parent limbs in
+        lanes 0-1, child limbs in lanes 2-3. Round 9 derived the
+        children positionally from ``vkeys`` (the visited append WAS
+        the insertion order); the incrementally-sorted visited array
+        re-orders its rows every wave, so the log is the insertion-
+        order record again."""
         if self.generated is None:
-            vkeys, plog, pl_n, new = (
+            _vkeys, plog, pl_n, _new = (
                 np.asarray(a) for a in self._final_tables
             )
             n = int(pl_n)
-            roots = int(new) - n
             child = (
-                vkeys[1, roots:roots + n].astype(np.uint64)
-                << np.uint64(32)
-            ) | vkeys[0, roots:roots + n].astype(np.uint64)
+                plog[3, :n].astype(np.uint64) << np.uint64(32)
+            ) | plog[2, :n].astype(np.uint64)
             parent = (
                 plog[1, :n].astype(np.uint64) << np.uint64(32)
             ) | plog[0, :n].astype(np.uint64)

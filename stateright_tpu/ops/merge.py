@@ -1,0 +1,468 @@
+"""Streaming sorted-set kernels: the visited-dedup merge family.
+
+Round 10 (PERF.md §merge-kernel) makes the engines' visited set
+INCREMENTALLY SORTED, which turns the per-wave dedup from a
+from-scratch ``(V + B)``-row stable 3-lane ``lax.sort`` — the
+irreducible b·V term the round-5..9 work left standing (~3-20ms at
+C=2²¹ on chip) — into two O(V + B) streaming passes over sorted runs:
+
+* :func:`member_sorted` — for each of B sorted query keys, is it
+  present in the sorted visited prefix (the dedup membership test);
+* :func:`merge_sorted` — merge the ≤F sorted winner keys into the
+  sorted visited prefix (the visited append).
+
+Keys are 2-limb SoA ``uint32`` pairs ordered lexicographically by
+``(hi, lo)`` with the all-ones pair as the trailing padding sentinel
+(the engines' ``clamp_keys`` convention keeps real fingerprints off
+it). Both inputs must be sorted ascending; ties order A-first (the
+"visited wins" rule the old stable concat-sort implemented). A may
+contain duplicates and sentinel tails; semantics are exact multiset
+membership, so callers mask sentinel queries themselves (the engines
+already gate on ``real``).
+
+Each op ships two implementations, selected by the engines'
+``merge_impl`` knob (auto: Pallas on TPU, XLA fallback elsewhere):
+
+* ``impl="pallas"`` / ``"pallas_interpret"`` — a hand-written Pallas
+  kernel: the merged output is partitioned into ``block``-row tiles by
+  a Merge Path diagonal search (:func:`merge_path_starts`, computed in
+  plain XLA — G+1 binary searches, negligible), and each grid step
+  loads one bounded window of each input and produces its tile with a
+  rank-based block merge (broadcast compare + one-hot reduce — all
+  VPU-shaped work, no sort, no data-dependent control flow). Grid
+  iteration order is the sequential TPU/interpreter order; the member
+  kernel's overlapping window writes rely on it (last writer owns the
+  tile's true query range). ``pallas_interpret`` runs the SAME kernel
+  through the Pallas interpreter, which is what lets a CPU-only CI
+  pin the kernel's semantics in tier-1 (tests/test_merge.py).
+  The windows staged per grid step are ``block``-bounded; the backing
+  refs are whole-array (fine under the interpreter and at the ≤C_pad
+  VMEM-resident sizes the ladder classes produce today — chip-scale
+  HBM staging via ``pltpu.ANY`` + double-buffered DMA is the
+  BENCH_r06 follow-up, same as every chip-gated verdict).
+
+* ``impl="xla"`` — a pure-XLA O(B log V + M) fallback for CPU and
+  old-JAX paths: membership is a vectorized 2-limb binary search
+  (log₂ V unrolled gather steps — fast on CPU where the sequential
+  gathers are cache-friendly, catastrophic on TPU per the
+  tools/profile_sortmerge.py microbenchmarks, which is exactly why
+  the Pallas path exists); the merge computes winner destinations by
+  binary search, scatters the ≤F winner flags, and assembles the
+  merged array with one cumsum + two gathers — no sort anywhere.
+
+Neither implementation contains an O(V)-row ``lax.sort``; the
+codegen-shape audit (tests/test_merge.py::test_no_visited_scale_sort)
+pins that for the whole steady-state wave body.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+_SENT = 0xFFFFFFFF
+
+#: merged rows per Pallas grid step. 512 keeps the block-merge's
+#: [block, block] compare/one-hot temporaries at 1 MB (uint32) — VPU
+#: lane-aligned and far under VMEM — while amortizing the per-step
+#: window loads.
+DEFAULT_BLOCK = 512
+
+#: the merge_impl vocabulary the engines accept (None = auto).
+IMPLS = ("xla", "pallas", "pallas_interpret")
+
+
+def pallas_available() -> bool:
+    try:  # gated: old-JAX paths fall back to the XLA impl
+        from jax.experimental import pallas as _  # noqa: F401
+    except Exception:
+        return False
+    return True
+
+
+def default_impl() -> str:
+    """Auto policy: the Pallas kernel where it wins (TPU), the XLA
+    fallback everywhere else (CPU binary search beats interpreting
+    the kernel by orders of magnitude)."""
+    import jax
+
+    if jax.default_backend() == "tpu" and pallas_available():
+        return "pallas"
+    return "xla"
+
+
+def resolve_impl(impl):
+    if impl is None:
+        return default_impl()
+    if impl not in IMPLS:
+        raise ValueError(
+            f"merge_impl must be one of {IMPLS} or None (auto), "
+            f"got {impl!r}"
+        )
+    if impl.startswith("pallas") and not pallas_available():
+        raise ValueError(
+            f"merge_impl={impl!r} requires jax.experimental.pallas; "
+            "this jax build lacks it — use merge_impl='xla'"
+        )
+    return impl
+
+
+# -- 2-limb key compares ---------------------------------------------------
+
+
+def _lt(ah, al, bh, bl):
+    """(ah, al) < (bh, bl) lexicographic."""
+    return (ah < bh) | ((ah == bh) & (al < bl))
+
+
+def _le(ah, al, bh, bl):
+    return (ah < bh) | ((ah == bh) & (al <= bl))
+
+
+# -- XLA fallback ----------------------------------------------------------
+
+
+def _count_in_sorted(a_lo, a_hi, q_lo, q_hi, strict: bool):
+    """Per query, how many A keys compare {<, <=} it — a vectorized
+    2-limb binary search (the log₂ V unrolled gather ladder; 1-D lane
+    ops only, pinned by the lint's merge:xla trace)."""
+    import jax.numpy as jnp
+
+    Na = a_lo.shape[0]
+    nq = q_lo.shape[0]
+    lo = jnp.zeros(nq, jnp.uint32)
+    hi = jnp.full(nq, Na, jnp.uint32)
+    for _ in range(max(1, int(Na).bit_length())):
+        mid = (lo + hi) >> 1
+        am_lo = a_lo[mid]
+        am_hi = a_hi[mid]
+        if strict:
+            go_right = _lt(am_hi, am_lo, q_hi, q_lo)
+        else:
+            go_right = _le(am_hi, am_lo, q_hi, q_lo)
+        upd = lo < hi
+        lo = jnp.where(upd & go_right, mid + jnp.uint32(1), lo)
+        hi = jnp.where(upd & ~go_right, mid, hi)
+    return lo
+
+
+def _member_xla(a_lo, a_hi, q_lo, q_hi):
+    import jax.numpy as jnp
+
+    Na = a_lo.shape[0]
+    if Na == 0:
+        return jnp.zeros(q_lo.shape[0], bool)
+    cnt = _count_in_sorted(a_lo, a_hi, q_lo, q_hi, strict=True)
+    idx = jnp.minimum(cnt, jnp.uint32(Na - 1))
+    return (
+        (a_lo[idx] == q_lo) & (a_hi[idx] == q_hi)
+        & (cnt < jnp.uint32(Na))
+    )
+
+
+def _merge_xla(a_lo, a_hi, b_lo, b_hi):
+    """Sorted merge with NO sort: B-side destinations by binary
+    search (B is the small side — the ≤F winner block), then one
+    M-row flag scatter + cumsum + two gathers assemble the output."""
+    import jax.numpy as jnp
+
+    Na, Nb = a_lo.shape[0], b_lo.shape[0]
+    if Nb == 0:
+        return a_lo, a_hi
+    if Na == 0:
+        return b_lo, b_hi
+    M = Na + Nb
+    cnt_le = _count_in_sorted(a_lo, a_hi, b_lo, b_hi, strict=False)
+    # strictly increasing (j + #A<=b_j), so the scatter is collision-
+    # free and every destination is < M.
+    dest_b = jnp.arange(Nb, dtype=jnp.uint32) + cnt_le
+    from_b = (
+        jnp.zeros(M, jnp.uint32)
+        .at[dest_b]
+        .set(jnp.uint32(1), unique_indices=True)
+    )
+    k = jnp.cumsum(from_b, dtype=jnp.uint32)  # inclusive B-rank
+    is_b = from_b != 0
+    bi = jnp.minimum(
+        jnp.maximum(k, jnp.uint32(1)) - jnp.uint32(1),
+        jnp.uint32(Nb - 1),
+    )
+    ai = jnp.minimum(
+        jnp.arange(M, dtype=jnp.uint32) - k, jnp.uint32(Na - 1)
+    )
+    return (
+        jnp.where(is_b, b_lo[bi], a_lo[ai]),
+        jnp.where(is_b, b_hi[bi], a_hi[ai]),
+    )
+
+
+# -- Merge Path partition (shared by both Pallas kernels) ------------------
+
+
+def merge_path_starts(a_lo, a_hi, b_lo, b_hi, block: int):
+    """``int32[G + 1]`` A-side splits of the merged sequence at every
+    ``block``-row output boundary (G = ceil((Na+Nb)/block)): output
+    tile ``g`` is the merge of ``A[starts[g]:starts[g+1]]`` with
+    ``B[g*block - starts[g] : (g+1)*block - starts[g+1]]``, each range
+    at most ``block`` wide. Ties split A-first (the stable "visited
+    wins" order). Plain XLA — G+1 parallel diagonal binary searches."""
+    import jax.numpy as jnp
+
+    Na, Nb = int(a_lo.shape[0]), int(b_lo.shape[0])
+    M = Na + Nb
+    G = max(1, -(-M // block))
+    d = jnp.minimum(jnp.arange(G + 1, dtype=jnp.int32) * block, M)
+    lo = jnp.maximum(d - Nb, 0)
+    hi = jnp.minimum(d, Na)
+    for _ in range(max(1, int(Na).bit_length() + 1)):
+        mid = (lo + hi) >> 1
+        ai = jnp.clip(mid, 0, max(Na - 1, 0))
+        bj = jnp.clip(d - mid - 1, 0, max(Nb - 1, 0))
+        # split <= mid  <=>  b[d-mid-1] merges before a[mid]
+        p = _lt(b_hi[bj], b_lo[bj], a_hi[ai], a_lo[ai])
+        upd = lo < hi
+        hi = jnp.where(upd & p, mid, hi)
+        lo = jnp.where(upd & ~p, mid + 1, lo)
+    return lo
+
+
+# -- Pallas kernels --------------------------------------------------------
+
+
+def _merge_kernel(starts_ref, a_ref, b_ref, out_ref, *, block, M):
+    """One output tile of the streaming merge: rank-based block merge
+    of the tile's A/B windows. Validity masks (not sentinel rewrites)
+    keep out-of-range window rows from counting; their computed ranks
+    land >= the tile's real row count by the Merge Path bounds, so the
+    one-hot assembly never aliases a live output row."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    g = pl.program_id(0)
+    d0 = g * block
+    a_s = starts_ref[g]
+    a_e = starts_ref[g + 1]
+    b_s = d0 - a_s
+    a_cnt = a_e - a_s
+    rows = jnp.minimum(M - d0, block)
+    b_cnt = rows - a_cnt
+    aw = a_ref[:, pl.ds(a_s, block)]
+    bw = b_ref[:, pl.ds(b_s, block)]
+    iot = jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0)[:, 0]
+    a_ok = iot < a_cnt
+    b_ok = iot < b_cnt
+    # ranks: A-first on ties (strict compare counts B before A,
+    # inclusive compare counts A before-or-at B)
+    b_lt_a = _lt(bw[1][None, :], bw[0][None, :],
+                 aw[1][:, None], aw[0][:, None]) & b_ok[None, :]
+    a_le_b = _le(aw[1][:, None], aw[0][:, None],
+                 bw[1][None, :], bw[0][None, :]) & a_ok[:, None]
+    pos_a = iot + jnp.sum(b_lt_a, axis=1, dtype=jnp.int32)
+    pos_b = iot + jnp.sum(a_le_b, axis=0, dtype=jnp.int32)
+    oh_a = (pos_a[:, None] == iot[None, :]) & a_ok[:, None]
+    oh_b = (pos_b[:, None] == iot[None, :]) & b_ok[:, None]
+    z = jnp.uint32(0)
+    for lane in range(2):
+        merged = jnp.sum(
+            jnp.where(oh_a, aw[lane][:, None], z), axis=0,
+            dtype=jnp.uint32,
+        ) + jnp.sum(
+            jnp.where(oh_b, bw[lane][:, None], z), axis=0,
+            dtype=jnp.uint32,
+        )
+        covered = jnp.sum(
+            oh_a.astype(jnp.uint32), axis=0, dtype=jnp.uint32
+        ) + jnp.sum(oh_b.astype(jnp.uint32), axis=0, dtype=jnp.uint32)
+        out_ref[lane, :] = jnp.where(
+            covered > 0, merged, jnp.uint32(_SENT)
+        )
+
+
+def _member_kernel(starts_ref, a_ref, q_ref, out_ref, *, block, M):
+    """One merged tile's membership bits: a query matches iff an equal
+    A key sits in the tile's A window or is the window's immediate
+    predecessor ``A[a_s - 1]`` (ties order A-first, so the equal A key
+    — A is sorted — is the nearest A at or before the query's merge
+    position; Merge Path puts it no earlier than one element left of
+    the window). The ``block``-wide store past the tile's true query
+    range is overwritten by the later tiles that own those queries —
+    correct under the sequential grid order."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    g = pl.program_id(0)
+    d0 = g * block
+    a_s = starts_ref[g]
+    a_e = starts_ref[g + 1]
+    q_s = d0 - a_s
+    a_cnt = a_e - a_s
+    aw = a_ref[:, pl.ds(a_s, block)]
+    qw = q_ref[:, pl.ds(q_s, block)]
+    halo = jnp.maximum(a_s - 1, 0)
+    h_lo = a_ref[0, halo]
+    h_hi = a_ref[1, halo]
+    iot = jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0)[:, 0]
+    a_ok = iot < a_cnt
+    eq = (
+        (aw[0][:, None] == qw[0][None, :])
+        & (aw[1][:, None] == qw[1][None, :])
+        & a_ok[:, None]
+    )
+    mem = jnp.any(eq, axis=0) | (
+        (a_s > 0) & (h_lo == qw[0]) & (h_hi == qw[1])
+    )
+    out_ref[0, pl.ds(q_s, block)] = mem.astype(jnp.uint32)
+
+
+def _pad_soa(lo, hi, pad_to: int):
+    import jax.numpy as jnp
+
+    n = lo.shape[0]
+    out = jnp.full((2, pad_to), _SENT, jnp.uint32)
+    out = out.at[0, :n].set(lo).at[1, :n].set(hi)
+    return out
+
+
+def _merge_pallas(a_lo, a_hi, b_lo, b_hi, block, interpret):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    Na, Nb = int(a_lo.shape[0]), int(b_lo.shape[0])
+    M = Na + Nb
+    if Nb == 0:
+        return a_lo, a_hi
+    if Na == 0:
+        return b_lo, b_hi
+    G = max(1, -(-M // block))
+    starts = merge_path_starts(a_lo, a_hi, b_lo, b_hi, block)
+    a = _pad_soa(a_lo, a_hi, Na + block)
+    b = _pad_soa(b_lo, b_hi, Nb + block)
+    out = pl.pallas_call(
+        partial(_merge_kernel, block=block, M=M),
+        grid=(G,),
+        in_specs=[
+            pl.BlockSpec(starts.shape, lambda g: (0,)),
+            pl.BlockSpec(a.shape, lambda g: (0, 0)),
+            pl.BlockSpec(b.shape, lambda g: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((2, block), lambda g: (0, g)),
+        out_shape=jax.ShapeDtypeStruct((2, G * block), jnp.uint32),
+        interpret=interpret,
+    )(starts, a, b)
+    return out[0, :M], out[1, :M]
+
+
+def _member_pallas(a_lo, a_hi, q_lo, q_hi, block, interpret):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    Na, Nq = int(a_lo.shape[0]), int(q_lo.shape[0])
+    if Nq == 0:
+        return jnp.zeros(0, bool)
+    if Na == 0:
+        return jnp.zeros(Nq, bool)
+    M = Na + Nq
+    G = max(1, -(-M // block))
+    starts = merge_path_starts(a_lo, a_hi, q_lo, q_hi, block)
+    a = _pad_soa(a_lo, a_hi, Na + block)
+    q = _pad_soa(q_lo, q_hi, Nq + block)
+    out = pl.pallas_call(
+        partial(_member_kernel, block=block, M=M),
+        grid=(G,),
+        in_specs=[
+            pl.BlockSpec(starts.shape, lambda g: (0,)),
+            pl.BlockSpec(a.shape, lambda g: (0, 0)),
+            pl.BlockSpec(q.shape, lambda g: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Nq + block), lambda g: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, Nq + block), jnp.uint32),
+        interpret=interpret,
+    )(starts, a, q)
+    return out[0, :Nq] != 0
+
+
+# -- public entry points ---------------------------------------------------
+
+
+def member_sorted(a_lo, a_hi, q_lo, q_hi, *, impl: str = "xla",
+                  block: int = DEFAULT_BLOCK):
+    """``bool[Nq]``: for each sorted query key, whether it occurs in
+    the sorted array A. Exact multiset semantics (sentinel queries
+    match A's sentinel tail; callers mask)."""
+    if impl == "xla":
+        return _member_xla(a_lo, a_hi, q_lo, q_hi)
+    return _member_pallas(
+        a_lo, a_hi, q_lo, q_hi, block,
+        interpret=(impl == "pallas_interpret"),
+    )
+
+
+def merge_sorted(a_lo, a_hi, b_lo, b_hi, *, impl: str = "xla",
+                 block: int = DEFAULT_BLOCK):
+    """``(lo[Na+Nb], hi[Na+Nb])``: the sorted merge of two sorted
+    2-limb key arrays, A-first on ties; sentinel tails merge to the
+    tail. No O(Na)-row sort on either implementation."""
+    if impl == "xla":
+        return _merge_xla(a_lo, a_hi, b_lo, b_hi)
+    return _merge_pallas(
+        a_lo, a_hi, b_lo, b_hi, block,
+        interpret=(impl == "pallas_interpret"),
+    )
+
+
+def compact_winners(is_new, pos, lo, hi, nf: int, *, impl: str):
+    """``(nf_pos[nf], w_lo[nf], w_hi[nf])``: the winner rows
+    (``is_new``) of the key-sorted candidate arrays, compacted
+    order-preserving to the first ``nf`` rows and sentinel-padded —
+    winners stay in KEY order, the order the engines' fetch gather,
+    parent-log append, and visited merge all share.
+
+    Implementation-adaptive like the streaming passes: ``xla`` (the
+    CPU fallback) uses an O(B) rank scatter — collision-free
+    destinations from an inclusive-rank cumsum, non-winners routed
+    past the output and dropped — which on CPU replaces the 4-lane
+    B-row compaction sort that was the fallback path's single
+    costliest dedup stage (736 ms/wave at paxos-4 shapes, PERF.md
+    §merge-kernel). The ``pallas`` impls keep the 4-lane stable sort:
+    TPU scatters serialize, B-scale sorts do not."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    B = pos.shape[0]
+    if impl == "xla":
+        rank = jnp.cumsum(is_new.astype(jnp.uint32))
+        # winner dests rank-1 are unique in [0, B); non-winners are
+        # routed to unique slots in [B, 2B). Both tails land past the
+        # nf-row output and drop (nf <= B), so indices stay globally
+        # unique — the contract `unique_indices` asserts.
+        dest = jnp.where(
+            is_new,
+            rank - jnp.uint32(1),
+            jnp.uint32(B) + jnp.arange(B, dtype=jnp.uint32),
+        )
+        out = jnp.full((3, nf), _SENT, jnp.uint32)
+        out = out.at[:, dest].set(
+            jnp.stack([pos, lo, hi]),
+            mode="drop", unique_indices=True,
+        )
+        return out[0], out[1], out[2]
+    okey = jnp.where(
+        is_new,
+        jnp.arange(B, dtype=jnp.uint32),
+        jnp.uint32(_SENT),
+    )
+    _, nf_pos, w_lo, w_hi = lax.sort((okey, pos, lo, hi), num_keys=1)
+    # rows past the winner count carry arbitrary non-winner lanes
+    # after the sort; sentinel them like the scatter path does.
+    valid = jnp.arange(nf, dtype=jnp.uint32) < jnp.sum(
+        is_new, dtype=jnp.uint32
+    )
+    s = jnp.uint32(_SENT)
+    return (
+        jnp.where(valid, nf_pos[:nf], s),
+        jnp.where(valid, w_lo[:nf], s),
+        jnp.where(valid, w_hi[:nf], s),
+    )

@@ -21,12 +21,15 @@ Per wave, inside one ``shard_map``-wrapped ``lax.while_loop``:
    contiguous copies, never scatters) and one ``lax.all_to_all`` swaps
    tiles so every candidate lands on the shard owning
    ``fp_lo % n_shards``,
-4. owner-local dedup is the sort-merge: one stable merge sort against
-   the shard's sorted visited array (visited-first ⇒ first-of-run
-   wins; intra-wave duplicates resolve for free), a rebuild sort, and
-   a frontier-compaction sort — the role DashMap sharding plays in the
-   reference BFS (bfs.rs:28-29) with zero cross-shard contention by
-   construction,
+4. owner-local dedup is the streaming sort-merge (round 10, shared
+   with the single-chip engine): each shard's visited array is kept
+   INCREMENTALLY SORTED, one B-scale sort orders the received
+   candidates, membership + the visited append are O(V + B) streaming
+   passes (``ops/merge.py`` — the Pallas kernel or the sort-free XLA
+   fallback, per the inherited ``merge_impl``) — the role DashMap
+   sharding plays in the reference BFS (bfs.rs:28-29) with zero
+   cross-shard contention by construction, and no per-wave O(C)-row
+   sort anywhere,
 5. the parent forest is a per-shard append-only (child, parent) log
    written with ``dynamic_update_slice`` — no scatters — drained
    lazily on the host only when a counterexample path is
@@ -72,6 +75,7 @@ from ..checker import CheckerBuilder
 from ..encoding import EncodedModel
 from ..model import Expectation
 from ..ops.fingerprint import fingerprint_u32v
+from ..ops.merge import compact_winners, member_sorted, merge_sorted
 from ..ops.u64 import U64, u64_add
 from ..checkers.tpu import expand_frontier, wave_hits
 from ..checkers.tpu_sortmerge import SortMergeTpuBfsChecker
@@ -347,11 +351,11 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             both = (lo == jnp.uint32(_SENT)) & (hi == jnp.uint32(_SENT))
             return lo, jnp.where(both, jnp.uint32(_SENT - 1), hi)
 
-        # Unsorted append-only visited arrays (see the C_pad notes in
-        # checkers/tpu_sortmerge.py): the dedup merge sorts the
-        # concatenation anyway, so each shard just appends its wave
-        # winners' keys as a sentinel-padded F-row block at its
-        # running local-unique offset — no per-wave rebuild sort.
+        # INCREMENTALLY SORTED per-shard visited arrays (round 10,
+        # see the C_pad notes in checkers/tpu_sortmerge.py): rows
+        # [0, u_loc) are a dense sorted run; each wave linear-merges
+        # the shard's winner keys into the prefix. F rows of headroom
+        # cover the [0, V_v + NF) merged-block write at V_v == C.
         C_pad = C + F
 
         def seed_local(init_rows):
@@ -370,12 +374,12 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             n_mine = jnp.sum(mine).astype(jnp.uint32)
             fval = jnp.arange(F) < n_mine
             ebits = jnp.where(fval, jnp.uint32(ebits_init), jnp.uint32(0))
-            # Compact this shard's init keys to a dense prefix (the
-            # append invariant: rows [0, u_loc) are real keys) — a
-            # stable 1-key sort on the validity bit, NOT on a limb (a
-            # real key may equal the sentinel in one limb).
+            # Compact this shard's init keys to a dense SORTED prefix
+            # (the round-10 invariant: rows [0, u_loc) are a sorted
+            # run) — validity bit leads the key so dropped rows sort
+            # last, then (hi, lo) orders the kept prefix.
             mk = jnp.where(mine, jnp.uint32(0), jnp.uint32(1))
-            _, sk_lo, sk_hi = lax.sort((mk, lo0, hi0), num_keys=1)
+            _, sk_hi, sk_lo = lax.sort((mk, hi0, lo0), num_keys=3)
             live_pref = n_mine > jnp.arange(
                 sk_lo.shape[0], dtype=jnp.uint32
             )
@@ -397,7 +401,7 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                     if trace_log else {}
                 ),
                 vkeys=vkeys,
-                plog=jnp.zeros((2, L), jnp.uint32),
+                plog=jnp.zeros((4, L), jnp.uint32),
                 pl_n=jnp.zeros(1, jnp.uint32),
                 frontier=frontier,
                 fval=fval,
@@ -424,22 +428,27 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             )
 
         def merge_stage(c, v_class, R_c, recv, n_cand, sent, disc, ovf):
-            """Owner-local sort-merge dedup (the DashMap-shard role,
-            bfs.rs:28-29, on the TPU-fast path): stable merge with the
-            visited prefix first, so first-of-run wins and intra-wave
-            duplicates resolve for free.
+            """Owner-local streaming-merge dedup (the DashMap-shard
+            role, bfs.rs:28-29, on the TPU-fast path), round 10: the
+            shard's visited array is incrementally sorted, so dedup is
+            ONE R_c-row candidate order sort (B-scale; the old
+            ``(V_v + R_c)``-row concat sort is gone) + a streaming
+            membership pass, and the visited append is a linear merge
+            of the ≤F winner keys (``ops/merge.py``, the inherited
+            ``merge_impl``). Intra-wave duplicates resolve on the
+            adjacent-equal check of the sorted candidates (stable
+            sort ⇒ lowest received position wins — the old
+            stable-concat winner).
 
             Class-collapsed (round 9, PERF.md §layout): the v-ladder
-            switch runs a merge CORE returning only the shared SoA
-            result ``(nf_pos[F], new_count)`` — the full per-shard
-            carry no longer crosses the merge switch boundary at all —
-            and the winner gather, resident-buffer writes (vkeys/plog
-            SoA appends via class-local ``dynamic_update_slice``), and
-            carry assembly happen ONCE at wave level. Collectives
-            (psum/pmax) also moved out of the branches: every shard
-            takes the same branch (the classes are pmax-agreed), but
-            uniform collectives outside the switch are the simpler
-            contract."""
+            switches' branch outputs stay small/single-buffer — the
+            membership switch returns ``bool[R_c]``, the append
+            switch returns ``vkeys`` alone — and the winner gather,
+            frontier/ebits/plog writes, and carry assembly happen
+            ONCE at wave level. Collectives (psum/pmax) stay out of
+            the branches: every shard takes the same branch (the
+            classes are pmax-agreed), but uniform collectives outside
+            the switch are the simpler contract."""
             disc_found, disc_lo, disc_hi = disc
             overflow0, f_overflow0, c_overflow, e_overflow = ovf
 
@@ -449,55 +458,54 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             ck_lo = jnp.where(r_val, r_lo, jnp.uint32(_SENT))
             ck_hi = jnp.where(r_val, r_hi, jnp.uint32(_SENT))
 
-            def merge_core(vc):
+            NFs = min(F, R_c)
+            cpos = jnp.arange(1, R_c + 1, dtype=jnp.uint32)
+            s_hi, s_lo, s_pos = lax.sort(
+                (ck_hi, ck_lo, cpos), num_keys=2
+            )
+            real = ~(
+                (s_hi == jnp.uint32(_SENT))
+                & (s_lo == jnp.uint32(_SENT))
+            )
+            prev_same = jnp.concatenate(
+                [
+                    jnp.zeros(1, bool),
+                    (s_hi[1:] == s_hi[:-1])
+                    & (s_lo[1:] == s_lo[:-1]),
+                ]
+            )
+            fresh = real & ~prev_same
+
+            def member_core(vc):
                 V_v = v_ladder[vc]
-                M = V_v + R_c
 
                 def br(_):
-                    m_hi = jnp.concatenate([c["vkeys"][1, :V_v], ck_hi])
-                    m_lo = jnp.concatenate([c["vkeys"][0, :V_v], ck_lo])
-                    m_pos = jnp.concatenate(
-                        [
-                            jnp.zeros(V_v, jnp.uint32),
-                            jnp.arange(1, R_c + 1, dtype=jnp.uint32),
-                        ]
+                    return member_sorted(
+                        c["vkeys"][0, :V_v], c["vkeys"][1, :V_v],
+                        s_lo, s_hi, impl=self.merge_impl,
                     )
-                    m_hi, m_lo, m_pos = lax.sort(
-                        (m_hi, m_lo, m_pos), num_keys=2
-                    )
-                    real = ~(
-                        (m_hi == jnp.uint32(_SENT))
-                        & (m_lo == jnp.uint32(_SENT))
-                    )
-                    prev_same = jnp.concatenate(
-                        [
-                            jnp.zeros(1, bool),
-                            (m_hi[1:] == m_hi[:-1])
-                            & (m_lo[1:] == m_lo[:-1]),
-                        ]
-                    )
-                    is_new = real & ~prev_same & (m_pos > 0)
-                    new_count = jnp.sum(is_new)
-                    nf_pos = jnp.where(
-                        is_new, m_pos, jnp.uint32(_SENT)
-                    )
-                    (nf_pos,) = lax.sort((nf_pos,), num_keys=1)
-                    if M >= F:
-                        nf_pos = nf_pos[:F]
-                    else:
-                        nf_pos = jnp.concatenate(
-                            [nf_pos,
-                             jnp.full(F - M, _SENT, jnp.uint32)]
-                        )
-                    return nf_pos, new_count
 
                 return br
 
-            nf_pos, new_count = lax.switch(
+            in_visited = lax.switch(
                 v_class,
-                [merge_core(vc) for vc in range(len(v_ladder))],
+                [member_core(vc) for vc in range(len(v_ladder))],
                 0,
             )
+            is_new = fresh & ~in_visited
+            new_count = jnp.sum(is_new)
+            # Order-preserving winner compaction (ops/merge.py,
+            # impl-adaptive: O(R_c) rank scatter on the XLA fallback,
+            # one 4-lane R_c-scale sort on the Pallas/TPU path):
+            # winners lead in KEY order, the order the routed-tile
+            # gather, plog append, and visited merge all share.
+            nf_pos, w_lo, w_hi = compact_winners(
+                is_new, s_pos, s_lo, s_hi, NFs, impl=self.merge_impl
+            )
+            if R_c < F:
+                nf_pos = jnp.concatenate(
+                    [nf_pos, jnp.full(F - R_c, _SENT, jnp.uint32)]
+                )
 
             overflow = overflow0 | bool_any(
                 c["u_loc"][0] + new_count.astype(jnp.uint32)
@@ -514,29 +522,45 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             ).T
             next_ebits = jnp.where(nf_valid, next_fe[:, EB], 0)
 
-            # Visited append (unsorted visited design): winners' keys
-            # as one [2, F] sentinel-padded SoA block at this shard's
-            # running local-unique offset.
-            vkeys_new = lax.dynamic_update_slice(
-                c["vkeys"],
-                jnp.stack([
-                    jnp.where(nf_valid, next_fe[:, E],
-                              jnp.uint32(_SENT)),
-                    jnp.where(nf_valid, next_fe[:, E + 1],
-                              jnp.uint32(_SENT)),
-                ]),
-                (jnp.uint32(0), c["u_loc"][0]),
+            # Visited append (sorted invariant): linear-merge the
+            # sorted winner block into the shard's sorted prefix and
+            # write it back as one class-local block at offset 0
+            # (rows past V_v + NFs stay sentinel by the C_pad
+            # headroom). vkeys is the branch's only output.
+            def append_core(vc):
+                V_v = v_ladder[vc]
+
+                def br(_):
+                    m_lo, m_hi = merge_sorted(
+                        c["vkeys"][0, :V_v], c["vkeys"][1, :V_v],
+                        w_lo, w_hi, impl=self.merge_impl,
+                    )
+                    return lax.dynamic_update_slice(
+                        c["vkeys"],
+                        jnp.stack([m_lo, m_hi]),
+                        (jnp.uint32(0), jnp.uint32(0)),
+                    )
+
+                return br
+
+            vkeys_new = lax.switch(
+                v_class,
+                [append_core(vc) for vc in range(len(v_ladder))],
+                0,
             )
 
             if track_paths:
-                # PARENT limbs only: log entry i's child key is the
-                # visited append at local index (roots + i) — derived
-                # from vkeys at drain time (_build_generated).
+                # Parent AND child limbs (round 10): the sorted merge
+                # re-orders vkeys rows every wave, so the round-9
+                # positional child derivation is gone — the log is
+                # the insertion-order record again (_build_generated).
                 plog_new = lax.dynamic_update_slice(
                     c["plog"],
                     jnp.stack([
                         jnp.where(nf_valid, next_fe[:, W], 0),
                         jnp.where(nf_valid, next_fe[:, W + 1], 0),
+                        jnp.where(nf_valid, next_fe[:, E], 0),
+                        jnp.where(nf_valid, next_fe[:, E + 1], 0),
                     ]),
                     (jnp.uint32(0), c["pl_n"][0]),
                 )
@@ -1131,28 +1155,24 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
     def _build_generated(self):
         """Concatenate each shard's append-only parent log. The SoA
         buffers come back concatenated along their sharded ROW axis
-        ([2, S*C_pad] / [2, S*L]); ``pl_n[s]`` entries of shard ``s``
-        are live. The log carries PARENT limbs only (round 9): shard
-        ``s``'s log entry ``i`` has its child key at the shard's
-        visited append index ``roots_s + i``, where the shard's root
-        count ``roots_s = u_loc[s] - pl_n[s]`` (the two counters
-        advance in lockstep on every clean wave)."""
+        ([2, S*C_pad] / [4, S*L]); ``pl_n[s]`` entries of shard ``s``
+        are live. The log carries BOTH key pairs (round 10): parent
+        limbs in lanes 0-1, child limbs in lanes 2-3 — the
+        incrementally-sorted visited array re-orders its rows every
+        wave, so the round-9 positional child derivation is gone."""
         if self.generated is None:
-            vkeys, plog, pl_n, u_loc = (
+            _vkeys, plog, pl_n, _u_loc = (
                 np.asarray(a) for a in self._final_tables
             )
             S = self.n_shards
             L = plog.shape[1] // S
-            C_pad = vkeys.shape[1] // S
             generated: dict = {}
             for s in range(S):
                 n = int(pl_n[s])
-                roots = int(u_loc[s]) - n
-                vsl = slice(s * C_pad + roots, s * C_pad + roots + n)
                 psl = slice(s * L, s * L + n)
                 child = (
-                    vkeys[1, vsl].astype(np.uint64) << np.uint64(32)
-                ) | vkeys[0, vsl].astype(np.uint64)
+                    plog[3, psl].astype(np.uint64) << np.uint64(32)
+                ) | plog[2, psl].astype(np.uint64)
                 parent = (
                     plog[1, psl].astype(np.uint64) << np.uint64(32)
                 ) | plog[0, psl].astype(np.uint64)

@@ -210,6 +210,133 @@ def wave_wall_report(checker, reps: int = 8) -> dict:
     )
 
 
+def merge_stage_estimate(checker, reps: int = 4,
+                         unique: int | None = None) -> dict:
+    """``merge_kernel`` stage attribution (round 10): time the
+    visited-dedup stage in isolation at the checker's converged class
+    shapes on synthetic key data — the B-row candidate order sort,
+    the streaming membership pass, the winner merge append — next to
+    the RETIRED rebuild path (the ``(V_v + B)``-row 3-lane concat
+    sort + the ``(V_v + B)``-row winner-position sort) as the A/B
+    denominator. Consumed by bench.py, which records each lane's
+    ``merge_impl`` and merge-stage share next to its states/sec so
+    the pending BENCH_r06 chip run can A/B the kernel with
+    trace_diff. (``tools/profile_stages.py`` times the same stage
+    set with a different method — REPS-amortized in-jit loops over a
+    REAL captured mid-run carry; this estimator trades that fidelity
+    for needing nothing but the checker object, which is what lets
+    bench attribute every lane cheaply.)
+
+    Synthetic sorted uint32 keys at the real (V_v, B, NF) shapes: the
+    dedup stage is key-value-oblivious, so shape-correct random keys
+    time the same program the engine runs — no captured carry
+    needed, which is what lets bench attribute every lane cheaply.
+    ``unique`` overrides the visited fill (defaults to the checker's
+    final unique count)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from .ops.merge import compact_winners, member_sorted, merge_sorted
+
+    SENT = 0xFFFFFFFF
+    u = unique if unique is not None else checker.unique_state_count()
+    _, v_ladder = _ladder_classes(checker)
+    V_v = next(v for v in v_ladder if v >= min(u, checker.capacity))
+    F = checker.frontier_capacity
+    K = checker.encoded.max_actions
+    B = min(checker.cand_capacity or F * K, F * K)
+    NF = min(F, B)
+    impl = checker.merge_impl
+
+    rng = np.random.default_rng(0)
+
+    def synth(n_real, n_total, sort=False):
+        v = rng.integers(0, 1 << 62, size=n_real, dtype=np.uint64)
+        if sort:
+            v = np.sort(v)
+        lo = np.full(n_total, SENT, np.uint32)
+        hi = np.full(n_total, SENT, np.uint32)
+        lo[:n_real] = (v & 0xFFFFFFFF).astype(np.uint32)
+        hi[:n_real] = (v >> 32).astype(np.uint32)
+        return jnp.asarray(lo), jnp.asarray(hi)
+
+    v_lo, v_hi = synth(min(u, V_v), V_v, sort=True)
+    c_lo, c_hi = synth(int(B * 0.7), B)
+    w_lo, w_hi = synth(min(int(B * 0.2), NF), NF, sort=True)
+
+    def timed(fn, args):
+        def run(*a):
+            def rep(i, acc):
+                # perturb one input element per rep (loop-invariant
+                # bodies hoist) and fold EVERY output (partially
+                # consumed stages DCE) — the profile_stages.py
+                # discipline.
+                a0 = a[0].at[0].set(a[0][0] ^ i.astype(jnp.uint32))
+                out = fn(a0, *a[1:])
+                return acc + sum(
+                    jnp.sum(o.astype(jnp.uint32)) for o in out
+                )
+
+            return lax.fori_loop(0, reps, rep, jnp.uint32(0))
+
+        return _timed_loop(jax.jit(run), args) / reps * 1000.0
+
+    def s_sort(cl, ch):
+        pos = jnp.arange(1, B + 1, dtype=jnp.uint32)
+        return lax.sort((ch, cl, pos), num_keys=2)
+
+    sh, sl, _ = jax.jit(s_sort)(c_lo, c_hi)
+
+    def s_member(vl, vh, ql, qh):
+        return (member_sorted(vl, vh, ql, qh, impl=impl),)
+
+    def s_wcompact(sp, nw, sl2, sh2):
+        # the order-preserving winner compaction (ops/merge.py,
+        # impl-adaptive: O(B) rank scatter on the XLA fallback, one
+        # 4-lane B-row sort on Pallas/TPU) — part of the new path's
+        # per-wave bill, so the A/B counts it
+        return compact_winners(nw, sp, sl2, sh2, NF, impl=impl)
+
+    def s_append(vl, vh, bl, bh):
+        return merge_sorted(vl, vh, bl, bh, impl=impl)
+
+    def s_rebuild(vl, vh, cl, ch):
+        m_hi = jnp.concatenate([vh, ch])
+        m_lo = jnp.concatenate([vl, cl])
+        m_pos = jnp.concatenate([
+            jnp.zeros(V_v, jnp.uint32),
+            jnp.arange(1, B + 1, dtype=jnp.uint32),
+        ])
+        m_hi, m_lo, m_pos = lax.sort((m_hi, m_lo, m_pos), num_keys=2)
+        (nf_pos,) = lax.sort((m_pos,), num_keys=1)
+        return m_hi, nf_pos
+
+    isnew = jnp.arange(B, dtype=jnp.uint32) % 5 != 0
+    spos = jnp.arange(1, B + 1, dtype=jnp.uint32)
+
+    sort_ms = timed(s_sort, (c_lo, c_hi))
+    member_ms = timed(s_member, (v_lo, v_hi, sl, sh))
+    wcompact_ms = timed(s_wcompact, (spos, isnew, sl, sh))
+    append_ms = timed(s_append, (v_lo, v_hi, w_lo, w_hi))
+    rebuild_ms = timed(s_rebuild, (v_lo, v_hi, c_lo, c_hi))
+    return dict(
+        impl=impl,
+        V_v=V_v,
+        B=B,
+        NF=NF,
+        cand_sort_ms=round(sort_ms, 3),
+        member_ms=round(member_ms, 3),
+        winner_compact_ms=round(wcompact_ms, 3),
+        append_ms=round(append_ms, 3),
+        dedup_ms=round(
+            sort_ms + member_ms + wcompact_ms + append_ms, 3
+        ),
+        rebuild_sort_ms=round(rebuild_ms, 3),
+    )
+
+
 def format_report(rep: dict, stage_sum_ms: float | None = None) -> str:
     """Human-readable wave-wall report (the tools/ CLI prints this)."""
     lines = [

@@ -454,3 +454,137 @@ def test_twopc_sparse_engine_matches_dense():
     for name, path in sp.discoveries().items():
         prop = sp.model.property_by_name(name)
         assert prop.condition(sp.model, path.last_state())
+
+
+def test_auto_budget_shrinks_oversized_on_clean_run(
+    tmp_path, monkeypatch
+):
+    """Auto-budget shrink (ROADMAP carried item): the store only ever
+    GREW, so a lane whose growth heuristic overshot kept its headroom
+    forever — the paxos-4 lane converged at 2,097,152 against an
+    observed peak of 660,492 (3.2x), silently flipping the
+    padded-residency gate into CHUNKED memory-lean mode. A clean run
+    with > 2x headroom must persist ``observed_peak * margin``
+    instead (the running checker keeps its compiled budget; the next
+    process adopts the shrunk one) and emit an ``auto_budget_shrink``
+    telemetry event."""
+    import json
+
+    from stateright_tpu.checkers import tpu_sortmerge as sm
+    from stateright_tpu.telemetry import RunTracer
+
+    store = tmp_path / "budgets.json"
+    monkeypatch.setattr(
+        sm.SortMergeTpuBfsChecker,
+        "_budget_store",
+        lambda self: str(store),
+    )
+
+    def spawn():
+        return (
+            TwoPhaseSys(rm_count=5)
+            .checker()
+            .spawn_tpu_sortmerge(
+                capacity=1 << 14,
+                frontier_capacity=1 << 11,
+                cand_capacity="auto",
+                track_paths=False,
+            )
+        )
+
+    # Pre-seed an absurdly oversized budget: no overflow, huge slack.
+    c0 = spawn()
+    oversized = 1 << 20
+    store.write_text(json.dumps({
+        c0._budget_key(): {"cand_capacity": oversized,
+                           "pair_width": None},
+    }))
+    tr = RunTracer()
+    c = spawn()
+    assert c.cand_capacity == oversized
+    with tr.activate():
+        c.join()
+    assert c.unique_state_count() == 8832
+    peak = c.metrics["max_wave_candidates"]
+    want = max(
+        int(peak * sm.SortMergeTpuBfsChecker._SHRINK_MARGIN), 1024
+    )
+    saved = json.loads(store.read_text())[c._budget_key()]
+    assert saved["cand_capacity"] == want
+    assert saved["cand_capacity"] < oversized
+    assert saved["cand_capacity"] >= peak
+    # the running checker keeps its compiled budget
+    assert c.cand_capacity == oversized
+    evs = [e for e in tr.events if e["ev"] == "auto_budget_shrink"]
+    assert evs and evs[0]["old"] == oversized
+    assert evs[0]["new"] == want
+    assert evs[0]["observed_peak"] == peak
+    # the next process starts from the shrunk budget and stays clean
+    c2 = spawn()
+    assert c2.cand_capacity == want
+    c2.join()
+    assert c2.unique_state_count() == 8832
+    # near-peak budget: the 2x guard keeps the store stable now
+    assert (
+        json.loads(store.read_text())[c2._budget_key()][
+            "cand_capacity"
+        ]
+        == want
+    )
+
+
+def test_auto_budget_no_shrink_after_overflow(tmp_path, monkeypatch):
+    """The no-shrink-after-overflow contract: a budget grown on THIS
+    run is a geometric guess, not a measurement — persisting a shrunk
+    value right after the growth would thrash the store (grow 4x,
+    shrink, overflow again next process). The grown value must
+    survive even when it exceeds the shrink threshold."""
+    import json
+
+    from stateright_tpu.checkers import tpu_sortmerge as sm
+
+    store = tmp_path / "budgets.json"
+    monkeypatch.setattr(
+        sm.SortMergeTpuBfsChecker,
+        "_budget_store",
+        lambda self: str(store),
+    )
+
+    def spawn():
+        return (
+            TwoPhaseSys(rm_count=5)
+            .checker()
+            .spawn_tpu_sortmerge(
+                capacity=1 << 14,
+                frontier_capacity=1 << 11,
+                cand_capacity="auto",
+                track_paths=False,
+            )
+        )
+
+    # learn the true peak from one clean run
+    probe = spawn()
+    probe.join()
+    peak = probe.metrics["max_wave_candidates"]
+    # seed just under the peak: overflow -> geometric growth to
+    # ~3.2x peak, which is PAST the 2x-headroom shrink threshold
+    seeded = max(int(peak * 0.8), 16)
+    store.write_text(json.dumps({
+        probe._budget_key(): {"cand_capacity": seeded,
+                              "pair_width": None},
+    }))
+    c = spawn()
+    with pytest.warns(RuntimeWarning, match="auto-budget"):
+        c.join()
+    assert c.unique_state_count() == 8832
+    saved = json.loads(store.read_text())[c._budget_key()]
+    # grown, converged, and NOT shrunk on the same run
+    assert saved["cand_capacity"] == c.cand_capacity
+    assert saved["cand_capacity"] > seeded
+    want = max(
+        int(peak * sm.SortMergeTpuBfsChecker._SHRINK_MARGIN), 1024
+    )
+    assert saved["cand_capacity"] > 2 * want, (
+        "fixture lost its point: the grown budget must exceed the "
+        "shrink threshold for this test to prove suppression"
+    )

@@ -85,3 +85,33 @@ def test_wave_wall_report_on_cpu():
     assert "scatter" not in cats
     text = format_report(rep, stage_sum_ms=1.0)
     assert "hlo category" in text and "out-of-stage" in text
+
+
+def test_merge_stage_estimate_smoke():
+    """The bench-facing merge-stage attribution (round 10): runs off
+    nothing but a finished checker, reports every stage key positive
+    and the impl the checker ran — pinned here so a metrics/ladder/
+    ops rename can't keep tier-1 green while crashing bench.py at
+    the pending BENCH_r06 chip run."""
+    from stateright_tpu.wavewall import merge_stage_estimate
+
+    c = (
+        TwoPhaseSys(rm_count=3)
+        .checker()
+        .spawn_tpu_sortmerge(
+            capacity=1 << 10,
+            frontier_capacity=1 << 8,
+            cand_capacity=1 << 10,
+            track_paths=False,
+        )
+    )
+    est = merge_stage_estimate(c, reps=2)
+    assert est["impl"] == c.merge_impl
+    assert est["V_v"] > 0 and est["B"] > 0 and est["NF"] > 0
+    for k in ("cand_sort_ms", "member_ms", "winner_compact_ms",
+              "append_ms", "rebuild_sort_ms"):
+        assert est[k] >= 0.0, k
+    assert est["dedup_ms"] == pytest.approx(
+        est["cand_sort_ms"] + est["member_ms"]
+        + est["winner_compact_ms"] + est["append_ms"], abs=0.01,
+    )

@@ -320,7 +320,82 @@ def stage_profile(kind, n, caps, target):
     v_lo_full, v_hi_full = carry["vkeys"][0], carry["vkeys"][1]
     M = V_v + Ba
 
-    # -- stage: 3-lane merge sort --------------------------------------
+    # -- merge_kernel stages (round 10, PERF.md §merge-kernel): the
+    # streaming visited-dedup path the engine actually runs — B-row
+    # candidate order sort, membership pass, winner merge append —
+    # with the RETIRED (V_v + B)-row rebuild path re-timed below as
+    # the per-wave A/B denominator.
+    from stateright_tpu.ops.merge import (
+        compact_winners, member_sorted, merge_sorted,
+    )
+
+    mi = c.merge_impl
+    NF = min(F, Ba)
+    print(f"merge_impl: {mi}")
+
+    def s_csort(i, a):
+        kh, kl, acc = a
+        kh = kh.at[0].set(kh[0] ^ (i.astype(jnp.uint32) & 1))
+        pos = jnp.arange(1, Ba + 1, dtype=jnp.uint32)
+        s_hi, s_lo, s_pos = lax.sort((kh, kl, pos), num_keys=2)
+        acc = acc.at[0].add(_fold(s_hi) + _fold(s_lo) + _fold(s_pos))
+        return kh, kl, acc
+
+    results[f"merge_kernel: cand-sort3 ({Ba})"] = _timed(
+        s_csort, (ck_hi, ck_lo, acc0)
+    )
+
+    s_hi_d, s_lo_d = jax.jit(
+        lambda kh, kl: lax.sort((kh, kl), num_keys=2)
+    )(ck_hi, ck_lo)
+
+    def s_member(i, a):
+        vh, vl, qh, ql, acc = a
+        vl = vl.at[0].set(vl[0] ^ (i.astype(jnp.uint32) & 1))
+        m = member_sorted(vl[:V_v], vh[:V_v], ql, qh, impl=mi)
+        acc = acc.at[0].add(_fold(m))
+        return vh, vl, qh, ql, acc
+
+    results[f"merge_kernel: member ({V_v} | {Ba})"] = _timed(
+        s_member, (v_hi_full, v_lo_full, s_hi_d, s_lo_d, acc0)
+    )
+
+    def s_wcompact(i, a):
+        # the order-preserving winner compaction (ops/merge.py,
+        # impl-adaptive: O(B) rank scatter on the XLA fallback, one
+        # 4-lane B-row sort on Pallas/TPU): part of the streaming
+        # path's per-wave bill
+        nw, sp, sl, sh, acc = a
+        nw = nw.at[0].set(nw[0] ^ (i & 1).astype(bool))
+        np_, wl, wh = compact_winners(nw, sp, sl, sh, NF, impl=mi)
+        acc = acc.at[0].add(_fold(np_) + _fold(wl) + _fold(wh))
+        return nw, sp, sl, sh, acc
+
+    isnew_d = jnp.arange(Ba, dtype=jnp.uint32) % 5 != 0
+    spos_d = jnp.arange(1, Ba + 1, dtype=jnp.uint32)
+    results[f"merge_kernel: winner-compact ({Ba})"] = _timed(
+        s_wcompact, (isnew_d, spos_d, s_lo_d, s_hi_d, acc0)
+    )
+
+    w_hi_d = s_hi_d[:NF]
+    w_lo_d = s_lo_d[:NF]
+
+    def s_append(i, a):
+        vh, vl, wh, wl, acc = a
+        vl = vl.at[0].set(vl[0] ^ (i.astype(jnp.uint32) & 1))
+        m_lo, m_hi = merge_sorted(
+            vl[:V_v], vh[:V_v], wl, wh, impl=mi
+        )
+        acc = acc.at[0].add(_fold(m_lo) + _fold(m_hi))
+        return vh, vl, wh, wl, acc
+
+    results[f"merge_kernel: append ({V_v}+{NF})"] = _timed(
+        s_append, (v_hi_full, v_lo_full, w_hi_d, w_lo_d, acc0)
+    )
+
+    # -- RETIRED rebuild path (rounds 5-9), kept as the A/B record:
+    # the (V_v + B)-row stable 3-lane concat sort + the (V_v + B)-row
+    # winner-position sort the streaming path replaced ------------------
     def s_merge3(i, a):
         vh, vl, kh, kl, acc = a
         kh = kh.at[0].set(kh[0] ^ (i.astype(jnp.uint32) & 1))
@@ -334,24 +409,11 @@ def stage_profile(kind, n, caps, target):
         acc = acc.at[0].add(_fold(m_hi) + _fold(m_lo) + _fold(m_pos))
         return vh, vl, kh, kl, acc
 
-    results[f"merge3 ({V_v}+{Ba})"] = _timed(
+    results[f"retired: merge3 ({V_v}+{Ba})"] = _timed(
         s_merge3, (v_hi_full, v_lo_full, ck_hi, ck_lo, acc0)
     )
 
-    # -- stage: 2-lane rebuild sort (the cost the round-5 unsorted-
-    # visited append removed; kept for the ablation record) ------------
-    def s_rebuild(i, a):
-        uh, ul, acc = a
-        uh = uh.at[0].set(uh[0] ^ (i.astype(jnp.uint32) & 1))
-        uh2, ul2 = lax.sort((uh, ul), num_keys=2)
-        acc = acc.at[0].add(_fold(uh2) + _fold(ul2))
-        return uh, ul, acc
-
-    u_hi = jnp.concatenate([v_hi_full[:V_v], ck_hi])
-    u_lo = jnp.concatenate([v_lo_full[:V_v], ck_lo])
-    results[f"rebuild2 ({M})"] = _timed(s_rebuild, (u_hi, u_lo, acc0))
-
-    # -- stage: 1-lane frontier compaction sort ------------------------
+    # -- stage: 1-lane winner-position sort (retired with the merge) ---
     def s_nfpos(i, a):
         pos, acc = a
         pos = pos.at[0].set(pos[0] ^ (i.astype(jnp.uint32) & 1))
@@ -360,7 +422,7 @@ def stage_profile(kind, n, caps, target):
         return pos, acc
 
     nf_pos = jnp.arange(M, dtype=jnp.uint32)
-    results[f"nfpos1 ({M})"] = _timed(s_nfpos, (nf_pos, acc0))
+    results[f"retired: nfpos1 ({M})"] = _timed(s_nfpos, (nf_pos, acc0))
 
     # -- stage: fetch winners (round 5: packed gathers — payload mode
     # when the padded [Ba, W+3] fits the flat budget, else a packed
@@ -418,7 +480,11 @@ def stage_profile(kind, n, caps, target):
     total = 0.0
     for k, v in results.items():
         print(f"  {k:40s} {v:9.2f}")
-        total += v
+        if not k.startswith("retired:"):
+            # the retired rebuild-path rows are the A/B record, not
+            # part of the running wave — keep them out of the
+            # out-of-stage wall arithmetic
+            total += v
     print(f"  {'SUM (stage compute)':40s} {total:9.2f}")
     return c, total
 

@@ -19,7 +19,14 @@ Rust toolchain isn't in this image, so the host oracle is the honest
 stand-in for the reference's single-thread CPU search.
 
 Per-workload details go to stderr; ``--verbose`` adds per-run wave
-metrics (frontier size, occupancy, dedup ratio, shuffle volume).
+metrics (frontier size, occupancy, dedup ratio — plus shuffle volume
+on SHARDED lanes, where the engines count routed rows; the standard
+lanes are single-chip and have no shuffle). A lane whose checker
+reports ``shuffle_volume`` gets it in its detail row, and a traced
+lane whose TRACE carries per-shard ``shard_wave`` events additionally
+gets the derived ``shard_balance`` skew/routing summary
+(telemetry.shard_balance — the same block the MULTICHIP dryrun
+embeds), so direction-1 mesh runs land with skew numbers attached.
 """
 
 import argparse
@@ -512,7 +519,10 @@ def main():
     headline_name, headline_sps = None, 0.0
     loads = tpu_workloads(quick=args.quick)
     for i, (name, spawn, hybrid_spawn, expected) in enumerate(loads):
-        if tracer is not None and i == len(loads) - 1:
+        # ONE definition of "the traced lane" (the headline), shared
+        # by the tracing block and the shard_balance attachment below
+        lane_traced = tracer is not None and i == len(loads) - 1
+        if lane_traced:
             # Trace the headline lane's timed runs (warm run last, so
             # trace_diff's default last-run view reads the warm one).
             # Artifacts land in a finally: a failed/interrupted run's
@@ -543,7 +553,22 @@ def main():
             # in provenance, not N+1 times per artifact line
             **({"lint": lint_ref["artifact"]}
                if lint_ref is not None else {}),
+            # sharded lanes: routed shuffle volume (the module
+            # docstring's promise — recorded where a shuffle exists)
+            **({"shuffle_volume": checker.metrics["shuffle_volume"]}
+               if "shuffle_volume" in checker.metrics else {}),
         }
+        if lane_traced:
+            # a traced MESH lane leaves its skew numbers in the lane
+            # detail (single-chip traces have no shard_wave events
+            # and skip this)
+            from stateright_tpu.telemetry import shard_balance
+
+            bal = shard_balance(tracer.events)
+            if bal is not None:
+                detail[name]["shard_balance"] = {
+                    k: v for k, v in bal.items() if k != "per_wave"
+                }
         _stderr(
             f"tpu  {name}: unique={unique} sec={sec:.3f} "
             f"states/sec={sps:,.0f}"

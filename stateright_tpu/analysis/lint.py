@@ -25,11 +25,15 @@ For each registered encoding (:mod:`.registry`) the driver traces
   ``axis_name="shard"``, exactly as the sharded engine
   (parallel/engine_sortmerge.py) invokes it,
 
-and runs the full rule registry (:mod:`.rules`) over each. A separate
-wave-body fixture traces the single-chip engine's ENTIRE per-wave
-program (class-ladder switch included) on a small 2pc model so the
+and runs the full rule registry (:mod:`.rules`) over each. Separate
+wave-body fixtures trace each engine's ENTIRE per-wave program
+(class-ladder switch included) on a small 2pc model so the
 branch-shape rule and the carry-copy-bytes estimator see the real
-switch structure — the thing the per-path traces can't show.
+switch structure — the thing the per-path traces can't show: the
+single-chip body once per merge implementation, and (round 11) the
+SHARDED body in its TRACED form, so the per-shard mesh-log append
+(``slog``/``swave``, telemetry.SHARD_LOG_FIELDS) is priced by the
+same five gated rules and the carry-copy budget.
 
 Everything here runs on CPU: jaxprs are backend-independent, which is
 what lets a CPU-only CI run refuse an encoding or engine change that
@@ -248,6 +252,49 @@ def trace_wave_body_fixture(track_paths: bool = True,
     )
 
 
+def trace_sharded_wave_body_fixture(track_paths: bool = True):
+    """``(name, ClosedJaxpr)`` of the SHARDED sort-merge engine's full
+    wave body — the routing sort, dest tiles, ``all_to_all``, merge
+    switches — in its TRACED form (round 11): the per-shard mesh log
+    (``slog``/``swave``, telemetry.SHARD_LOG_FIELDS) is part of the
+    program, so the five gated rules and the carry-copy-bytes budget
+    price the log-append path the mesh runs actually execute
+    (registry.SHARDED_WAVE_BODY_FIXTURE keys the budget). Built on a
+    1-device mesh (the axis plumbing, not the device count, is what
+    the trace pins) with the same small 2pc model and short ladders
+    as the single-chip fixture; abstract-traced via ``eval_shape`` on
+    the seed program, so no buffers are allocated."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.two_phase_commit import TwoPhaseSys
+    from .registry import SHARDED_WAVE_BODY_FIXTURE
+
+    checker = TwoPhaseSys(rm_count=3).checker().spawn_tpu_sharded_sortmerge(
+        n_shards=1,
+        capacity=1 << 11,
+        frontier_capacity=1 << 9,
+        cand_capacity=1 << 11,
+        bucket_capacity=1 << 10,
+        f_min=64,
+        v_min=256,
+        track_paths=track_paths,
+        waves_per_sync=4,
+        merge_impl="xla",
+    )
+    # Force the traced program: the per-shard log path is the thing
+    # this fixture registers (a truthy tracer stand-in flips the
+    # _wave_log_enabled gate exactly as a real RunTracer would).
+    checker._tracer = object()
+    init = jnp.asarray(checker.encoded.init_vecs())
+    seed_fn, _chunk_fn = checker._build_programs(init.shape[0])
+    carry_shapes = jax.eval_shape(seed_fn, init)
+    return (
+        SHARDED_WAVE_BODY_FIXTURE,
+        jax.make_jaxpr(checker._wave_body_sm)(carry_shapes),
+    )
+
+
 def trace_merge_kernels(n: int = LINT_N) -> dict:
     """``{label: ClosedJaxpr}`` of the streaming-merge dedup ops
     (registry.MERGE_KERNEL_PATHS): membership and visited append,
@@ -410,6 +457,17 @@ def lint_wave_body(merge_impl: str = "xla") -> tuple:
     over the engine wave-body fixture (once per merge
     implementation; see trace_wave_body_fixture)."""
     name, closed = trace_wave_body_fixture(merge_impl=merge_impl)
+    return _lint_traced_wave_body(name, closed)
+
+
+def lint_sharded_wave_body() -> tuple:
+    """Same rules over the sharded engine's TRACED wave body (the
+    per-shard log path; see trace_sharded_wave_body_fixture)."""
+    name, closed = trace_sharded_wave_body_fixture()
+    return _lint_traced_wave_body(name, closed)
+
+
+def _lint_traced_wave_body(name: str, closed) -> tuple:
     ctx = TraceCtx(
         path="wave-body",
         encoding=name,
@@ -469,6 +527,11 @@ def run_lint(encodings: Optional[tuple] = None,
             fs, st = lint_wave_body(merge_impl=impl)
             all_findings.extend(fs)
             all_stats.extend(st)
+        # the sharded engine's TRACED wave body — the per-shard mesh
+        # log path (round 11, registry.SHARDED_WAVE_BODY_FIXTURE)
+        fs, st = lint_sharded_wave_body()
+        all_findings.extend(fs)
+        all_stats.extend(st)
     errors = [f for f in all_findings if f.severity == "error"]
     return dict(
         clean=not errors,

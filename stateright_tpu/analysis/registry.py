@@ -62,6 +62,18 @@ MERGE_KERNEL_PATHS = (
     "merge:member:pallas", "merge:append:pallas",
 )
 
+#: the sharded engine's TRACED wave-body fixture (round 11): the full
+#: per-wave program of parallel/engine_sortmerge.py — routing sort,
+#: dest tiles, ``all_to_all``, merge switches — with the per-shard
+#: mesh log (``slog``/``swave``, telemetry.SHARD_LOG_FIELDS) compiled
+#: in, exactly as a traced mesh run executes it. Registering the log
+#: path here means kernel-lint's five gated rules AND the
+#: carry-copy-bytes budget (tables.CARRY_COPY_BYTE_BUDGETS keys this
+#: name) run over it: a telemetry change that re-grows a gather, a
+#: dense mask, or a fat switch carry on the sharded wave path fails
+#: the lint before it reaches a mesh.
+SHARDED_WAVE_BODY_FIXTURE = "engine-fixture(2pc-rm3,sharded+slog)"
+
 
 @dataclass(frozen=True)
 class EncodingSpec:

@@ -94,6 +94,20 @@ CARRY_COPY_BYTE_BUDGETS = {
     # chip invocation style): identical switch structure, so the
     # same budget pins it.
     "engine-fixture(2pc-rm3,merge=pallas)": 450_000,
+    # The SHARDED engine's wave body in its TRACED form (round 11,
+    # registry.SHARDED_WAVE_BODY_FIXTURE): 9 switches / 153,780 B
+    # measured at the fixture shapes. The per-shard mesh log's only
+    # switch-carry addition is the 36 B ``swave`` row the merge stage
+    # returns (9 uint32 lanes; the ``slog`` appends live OUTSIDE the
+    # switches, in the body wrapper, so they price as loop-body DUS,
+    # not branch carry) — i.e. the telemetry layer moved the carry
+    # budget by 36 B, not by the log size. The sharded body's total
+    # sits BELOW the single-chip fixture's 344,908 B because its
+    # f-ladder switch carries the lean per-shard buffers (C=2^11 per
+    # shard) while the dest tiles and recv buffers are wave-local
+    # temporaries. Budget ~30% above measurement, same policy as the
+    # rows above.
+    "engine-fixture(2pc-rm3,sharded+slog)": 200_000,
 }
 
 

@@ -742,7 +742,13 @@ class TpuBfsChecker(Checker):
                 self.cancelled = True
                 return
             t0 = time.monotonic()
-            carry, stats = chunk_fn(carry)
+            # Sharded engines return a third output when traced: the
+            # per-shard mesh wave log (telemetry.SHARD_LOG_FIELDS),
+            # sharded across devices — it rides the same dispatch and
+            # the same sync point as the packed stats.
+            out = chunk_fn(carry)
+            carry, stats = out[0], out[1]
+            shard_log = out[2] if len(out) > 2 else None
             t_disp = time.monotonic()  # async dispatch returns here
             t_dev = t_disp
             dev_sec = None
@@ -760,6 +766,7 @@ class TpuBfsChecker(Checker):
                 waves_now = int(s[4])
                 n_waves = waves_now - prev_waves
                 rows = self._wave_log_rows(s, n_props)
+                srows = self._shard_log_rows(shard_log)
                 tracer.record_chunk(
                     chunk=chunk_idx,
                     wave0=prev_waves,
@@ -772,6 +779,8 @@ class TpuBfsChecker(Checker):
                     wave_rows=(None if rows is None
                                else rows[:n_waves]),
                     pairs_valid=self._wave_log_pairs_valid(),
+                    shard_rows=(None if srows is None
+                                else srows[:, :n_waves]),
                 )
                 prev_waves = waves_now
                 chunk_idx += 1
@@ -923,12 +932,36 @@ class TpuBfsChecker(Checker):
         """Hook for engine variants that append metric lanes after the
         per-property discovery lanes (see parallel/engine.py)."""
 
+    def _wave_log_enabled(self) -> bool:
+        """Whether the chunk carry includes the per-wave trace log.
+        Resolved from the tracer ``_run`` attaches BEFORE program
+        build, so the flag, the compiled program, and the stats parser
+        can't disagree. Engine variants that implement a log gate the
+        carry field (and their cache key) on this; the base hash-table
+        engine compiles no log either way."""
+        return self._tracer is not None
+
     def _wave_log_rows(self, s: np.ndarray, n_props: int):
         """Hook: the device wave-log rows out of a chunk's packed
         stats ([waves_per_sync, telemetry.WAVE_LOG_LANES] int array),
         or None when this engine keeps no per-wave log (the hash-table
         engine — its chunks still produce chunk/span events)."""
         return None
+
+    def _shard_log_rows(self, shard_log):
+        """The per-shard mesh wave log out of a chunk's third output,
+        unpacked from its device-axis concatenation to
+        ``[n_shards, waves_per_sync, telemetry.SHARD_LOG_LANES]``.
+        ``shard_log`` is None on single-chip engines and untraced runs
+        (only the sharded engines return a third chunk output, so
+        ``n_shards`` is always defined when this reshapes)."""
+        if shard_log is None:
+            return None
+        from ..telemetry import SHARD_LOG_LANES as SL
+
+        return np.asarray(shard_log).reshape(
+            self.n_shards, self.waves_per_sync, SL
+        )
 
     def _wave_log_pairs_valid(self) -> bool:
         """Hook: whether wave-log lane 1 really is the enabled-pair
@@ -967,15 +1000,21 @@ class TpuBfsChecker(Checker):
     def _maybe_warn_occupancy(self, occupancy: float) -> None:
         """Open addressing degrades before it overflows; warn early.
         (The sort-merge engine overrides this: its visited array is
-        exact-capacity with no probe pressure.)"""
-        if occupancy > 0.7:
+        exact-capacity with no probe pressure.) The message comes from
+        the shared formatter (stateright_tpu/occupancy.py) the mesh
+        observability layer's per-shard occupancy metric also uses."""
+        from ..occupancy import occupancy_warning
+
+        msg = occupancy_warning(
+            occupancy,
+            used=self._unique_states,
+            capacity=self.total_capacity,
+        )
+        if msg is not None:
             import warnings
 
             warnings.warn(
-                f"visited table {occupancy:.0%} full "
-                f"({self._unique_states}/{self.total_capacity}); "
-                "probe failures become likely past ~85% — consider a "
-                "larger capacity",
+                msg,
                 RuntimeWarning,
                 # 3 = the user's spawn/join call site for the direct
                 # _run depth; engine subclasses share that depth today.

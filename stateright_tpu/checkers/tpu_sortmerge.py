@@ -762,13 +762,10 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         )
 
     # -- telemetry (stateright_tpu/telemetry.py) ---------------------------
-
-    def _wave_log_enabled(self) -> bool:
-        """Whether the chunk carry includes the per-wave trace log.
-        Resolved from the tracer tpu.py's ``_run`` attached before
-        program build, so the flag, the compiled program, and the
-        stats parser can't disagree."""
-        return self._tracer is not None
+    #
+    # _wave_log_enabled is inherited from TpuBfsChecker (one home for
+    # the tracer→program gate; the sharded engines key their per-shard
+    # mesh log on the same flag).
 
     def _wave_log_rows(self, s: np.ndarray, n_props: int):
         if not self._wave_log_enabled():
@@ -801,7 +798,11 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
 
     def _maybe_warn_occupancy(self, occupancy: float) -> None:
         """No probe pressure: the sorted array works at 100% occupancy
-        and overflow is detected exactly — nothing to warn about."""
+        and overflow is detected exactly — nothing to warn about
+        per-chunk. (Per-SHARD occupancy headroom on the mesh engines
+        IS watched, by the trace-side metric: telemetry.shard_balance
+        reuses the shared formatter in stateright_tpu/occupancy.py
+        with the exact-capacity HEADROOM_THRESHOLD.)"""
 
     def _cand_overflow_message(self) -> str:
         if self._use_sparse():

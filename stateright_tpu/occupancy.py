@@ -1,0 +1,62 @@
+"""The one home of occupancy/headroom warning text.
+
+Three consumers watch a fixed-capacity device buffer fill up and want
+the same warning shape — "X% full (n/cap); what breaks past the
+cliff, which knob to turn":
+
+* the hash-table engine's visited-table watch
+  (checkers/tpu.py ``_maybe_warn_occupancy`` — open addressing
+  degrades before it overflows, so it warns at 70%),
+* the per-shard visited-occupancy metric of the mesh observability
+  layer (telemetry.shard_balance / tools/shard_report.py — the
+  sorted arrays are exact-capacity, so the watch is overflow
+  headroom, not probe pressure),
+* the routed dest-tile fill watch (same report — ``all_to_all``
+  correctness depends on every destination run fitting its lossless
+  ``Bd`` tile, so fill approaching the cap is the signal that the
+  next skewed wave trips ``c_overflow``).
+
+Each used to (or would) carry its own f-string; this module is the
+shared formatter so the message, the threshold semantics, and the
+"which knob" pointer can't drift per consumer. Import-light by
+design: tools and telemetry read traces without jax.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+#: the hash-table engine's probe-pressure threshold (open addressing
+#: degrades well before it is full).
+PROBE_PRESSURE_THRESHOLD = 0.7
+
+#: headroom threshold for EXACT-capacity buffers (the sorted visited
+#: arrays, the routed dest tiles): nothing degrades before 100%, but
+#: past this fill one skewed wave can overflow.
+HEADROOM_THRESHOLD = 0.8
+
+
+def occupancy_warning(
+    occupancy: float,
+    *,
+    kind: str = "visited table",
+    threshold: float = PROBE_PRESSURE_THRESHOLD,
+    used: Optional[int] = None,
+    capacity: Optional[int] = None,
+    consequence: str = (
+        "probe failures become likely past ~85% — consider a larger "
+        "capacity"
+    ),
+) -> Optional[str]:
+    """The shared warning line, or None while ``occupancy`` is at or
+    under ``threshold``. ``used``/``capacity`` add the absolute
+    counts; ``consequence`` names what breaks and which knob fixes
+    it."""
+    if occupancy <= threshold:
+        return None
+    detail = (
+        f" ({used}/{capacity})"
+        if used is not None and capacity is not None
+        else ""
+    )
+    return f"{kind} {occupancy:.0%} full{detail}; {consequence}"

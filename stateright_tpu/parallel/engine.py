@@ -50,6 +50,15 @@ from ..checkers.tpu import (
 )
 
 
+def payload_tile_width(w: int, track_paths: bool) -> int:
+    """Lanes of this engine's routed candidate payload (``E2`` in
+    ``_build_programs``): state + (parent fp when tracked) + ebits +
+    the candidate's own fp limbs. ONE formula for the device program
+    and the lane config's ``dest_tile_lanes`` (what
+    telemetry.shard_balance prices routed bytes with)."""
+    return (w + 3 if track_paths else w + 1) + 2
+
+
 class ShardedTpuBfsChecker(TpuBfsChecker):
     """``CheckerBuilder.spawn_tpu_sharded()`` — the wave engine over a
     ``jax.sharding.Mesh``. Inherits the whole result/reconstruction
@@ -104,7 +113,13 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
     def _cache_extras(self) -> tuple:
         # Mesh hashes by devices + axis names, so equivalent meshes
         # share compiled programs and distinct device sets never alias.
-        return (self.n_shards, self.bucket_capacity, self.mesh)
+        # Traced runs carry the wave/shard logs: a different program.
+        return (
+            self.n_shards,
+            self.bucket_capacity,
+            self.mesh,
+            self._wave_log_enabled(),
+        )
 
     def _cand_overflow_message(self) -> str:
         return (
@@ -120,6 +135,41 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             self.metrics["shuffle_volume"] = int(extra[0]) | (
                 int(extra[1]) << 32
             )
+
+    # -- telemetry (stateright_tpu/telemetry.py) ---------------------------
+
+    def _wave_log_rows(self, s: np.ndarray, n_props: int):
+        if not self._wave_log_enabled():
+            return None
+        from ..telemetry import WAVE_LOG_LANES as WL
+
+        off = 11 + 3 * n_props + 2  # scalars + discovery + sent lanes
+        return s[off:off + self.waves_per_sync * WL].reshape(
+            self.waves_per_sync, WL
+        )
+
+    def _wave_log_pairs_valid(self) -> bool:
+        # Dense hash-table waves have no (row, slot) pair extraction;
+        # the shard log's lane 1 carries the candidate count (the
+        # single-chip dense convention) and back-fills the wave event.
+        return False
+
+    def _lane_config(self) -> dict:
+        lane = super()._lane_config()
+        lane.update(
+            n_shards=self.n_shards,
+            bucket_capacity=self.bucket_capacity,
+            # routed-payload lanes (E2): what telemetry.shard_balance
+            # prices routed-byte volume with (rows x lanes x 4 B)
+            dest_tile_lanes=payload_tile_width(
+                self.encoded.width, self.track_paths
+            ),
+            # open addressing: shard_balance's occupancy watch uses
+            # the probe-pressure threshold, not exact-capacity
+            # headroom (stateright_tpu/occupancy.py)
+            visited_exact=False,
+        )
+        return lane
 
     # -- device programs ---------------------------------------------------
 
@@ -171,10 +221,23 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         # own fingerprint so owners don't re-hash after the shuffle.
         # All-zero rows mark unused bucket slots (fingerprints are
         # never 0, ops/fingerprint.py).
-        E = W + 3 if track_paths else W + 1
+        E2 = payload_tile_width(W, track_paths)
+        E = E2 - 2
         EB = E - 1
-        E2 = E + 2
         mesh = self.mesh
+        # Per-wave trace logs (telemetry.py, round 11): the GLOBAL
+        # wave log (psum'd counters — this engine's body is monolithic,
+        # so the row is assembled in place) and the PER-SHARD mesh log
+        # (SHARD_LOG_FIELDS: local frontier/candidates, routed and
+        # received rows, bucket fill vs the lossless Bd cap, local
+        # new/visited). Gated on an active tracer and cache-keyed so
+        # untraced programs compile exactly as before. ``u_loc`` (the
+        # per-shard visited counter the log's last lane reports) only
+        # exists on traced runs.
+        from ..telemetry import SHARD_LOG_LANES as SL
+        from ..telemetry import WAVE_LOG_LANES as WL
+
+        trace_log = self._wave_log_enabled()
 
         def bool_any(x):
             """Global OR of per-shard bools (replicated result)."""
@@ -198,6 +261,16 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             )
             overflow = bool_any(jnp.any(pending))
             return dict(
+                **(
+                    dict(
+                        wlog=jnp.zeros((waves_per_sync, WL),
+                                       jnp.uint32),
+                        slog=jnp.zeros((waves_per_sync, SL),
+                                       jnp.uint32),
+                        u_loc=n_mine.astype(jnp.uint32).reshape(1),
+                    )
+                    if trace_log else {}
+                ),
                 t_lo=table.lo,
                 t_hi=table.hi,
                 p_lo_t=jnp.zeros(capacity if track_paths else 0, jnp.uint32),
@@ -228,6 +301,8 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             ebits = c["ebits"]
             fval = c["fval"]
             me = lax.axis_index("shard").astype(jnp.uint32)
+            if trace_log:
+                n_f_loc = jnp.sum(fval, dtype=jnp.uint32)
 
             if target_depth is None:
                 expand = jnp.bool_(True)
@@ -295,12 +370,19 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             # swaps tiles so every candidate lands on its owner.
             send = jnp.zeros((S * Bd, E2), dtype=jnp.uint32)
             route_ovf = jnp.bool_(False)
+            fill_peak = jnp.uint32(0)
             for d in range(S):
                 m = b_val & (owner == d)
                 pos = jnp.cumsum(m) - 1
                 sp = jnp.where(m, d * Bd + pos, S * Bd)
                 send = send.at[sp].set(payload, mode="drop")
-                route_ovf = route_ovf | (jnp.sum(m) > Bd)
+                cnt_d = jnp.sum(m)
+                route_ovf = route_ovf | (cnt_d > Bd)
+                if trace_log:
+                    # peak destination-bucket fill for the shard log
+                    fill_peak = jnp.maximum(
+                        fill_peak, cnt_d.astype(jnp.uint32)
+                    )
             c_overflow = c_overflow | bool_any(route_ovf)
             cross = n_cand - jnp.sum(b_val & (owner == me))
             g_cross = lax.psum(cross.astype(jnp.uint32), "shard")
@@ -370,7 +452,52 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                 & ~c_overflow
                 & ~e_overflow
             )
+            trace_extra = {}
+            if trace_log:
+                u_loc = c["u_loc"] + new_count.astype(jnp.uint32)
+                # GLOBAL wave row (replicated lanes only): lane 1 is 0
+                # — the dense wave has no pair popcount; the host
+                # back-fills the event from the shard log's candidate
+                # lane (_wave_log_pairs_valid).
+                row = jnp.stack(
+                    [
+                        lax.psum(n_f_loc, "shard"),
+                        jnp.uint32(0),
+                        g_cand,
+                        g_new,
+                        new,
+                        c["depth"].astype(jnp.uint32),
+                        jnp.uint32(0),  # no frontier ladder here
+                        jnp.uint32(0),  # no visited ladder here
+                    ]
+                )
+                # PER-SHARD mesh row (SHARD_LOG_FIELDS): never psum'd.
+                srow = jnp.stack(
+                    [
+                        n_f_loc,
+                        n_cand.astype(jnp.uint32),  # dense: candidates
+                        n_cand.astype(jnp.uint32),
+                        cross.astype(jnp.uint32),
+                        jnp.sum(r_val, dtype=jnp.uint32),
+                        fill_peak,
+                        jnp.uint32(Bd),
+                        new_count.astype(jnp.uint32),
+                        u_loc[0],
+                    ]
+                )
+                trace_extra = dict(
+                    wlog=lax.dynamic_update_slice(
+                        c["wlog"], row[None, :],
+                        (c["wchunk"], jnp.int32(0)),
+                    ),
+                    slog=lax.dynamic_update_slice(
+                        c["slog"], srow[None, :],
+                        (c["wchunk"], jnp.int32(0)),
+                    ),
+                    u_loc=u_loc,
+                )
             return dict(
+                **trace_extra,
                 t_lo=table.lo,
                 t_hi=table.hi,
                 p_lo_t=p_lo_t,
@@ -422,19 +549,28 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
                     c["e_overflow"].astype(jnp.uint32),
                 ]
             )
-            stats = jnp.concatenate(
-                [
-                    scalars,
-                    c["disc_found"].astype(jnp.uint32),
-                    c["disc_lo"],
-                    c["disc_hi"],
-                    jnp.stack([c["sent_lo"], c["sent_hi"]]),
-                ]
-            )
+            parts = [
+                scalars,
+                c["disc_found"].astype(jnp.uint32),
+                c["disc_lo"],
+                c["disc_hi"],
+                jnp.stack([c["sent_lo"], c["sent_hi"]]),
+            ]
+            if trace_log:
+                parts.append(c["wlog"].reshape(-1))
+            stats = jnp.concatenate(parts)
+            if trace_log:
+                # the per-shard mesh log: a second, shard-sharded
+                # stats output — same dispatch, same sync point
+                return c, stats, c["slog"].reshape(-1)
             return c, stats
 
         P_shard = P("shard")
         specs = dict(
+            **(
+                dict(wlog=P(), slog=P("shard", None), u_loc=P_shard)
+                if trace_log else {}
+            ),
             t_lo=P_shard,
             t_hi=P_shard,
             p_lo_t=P_shard,
@@ -466,12 +602,15 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         from jax import lax as _lax
 
         sm_kw = {} if hasattr(_lax, "pvary") else {"check_rep": False}
+        chunk_out = (
+            (specs, P(), P_shard) if trace_log else (specs, P())
+        )
         seed_sm = shard_map(
             seed_local, mesh=mesh, in_specs=P(), out_specs=specs,
             **sm_kw,
         )
         chunk_sm = shard_map(
-            chunk, mesh=mesh, in_specs=(specs,), out_specs=(specs, P()),
+            chunk, mesh=mesh, in_specs=(specs,), out_specs=chunk_out,
             **sm_kw,
         )
         return jax.jit(seed_sm), jax.jit(chunk_sm, donate_argnums=0)

@@ -199,9 +199,11 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             self.metrics["max_wave_candidates"] = int(extra[2])
 
     def _wave_log_pairs_valid(self) -> bool:
-        # The sharded log wrapper can't see the enabled-pair popcount
-        # (it lives inside the per-shard wave switch): lane 1 is 0 and
-        # the tracer records enabled_pairs=null.
+        # The sharded GLOBAL log wrapper can't see the enabled-pair
+        # popcount (it lives inside the per-shard wave switch): lane 1
+        # is 0. The per-shard mesh log DOES see it (swave lane 1), so
+        # the tracer back-fills the wave event from the shard sum
+        # instead of recording enabled_pairs=null.
         return False
 
     def _lane_config(self) -> dict:
@@ -209,6 +211,15 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
         lane.update(
             n_shards=self.n_shards,
             bucket_capacity=self.bucket_capacity,
+            # routed-tile lanes: what telemetry.shard_balance prices
+            # routed-byte volume with (rows x lanes x 4 B)
+            dest_tile_lanes=dest_tile_width(
+                self.encoded.width, self.track_paths
+            ),
+            # sorted arrays work to exactly 100%: shard_balance's
+            # occupancy watch uses the exact-capacity headroom
+            # threshold (stateright_tpu/occupancy.py)
+            visited_exact=True,
         )
         return lane
 
@@ -286,13 +297,28 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
         waves_per_sync = self.waves_per_sync
         ebits_init = self._eventually_bits_init()
         track_paths = self.track_paths
-        # Per-wave trace log (telemetry.py): GLOBAL per-wave counters
-        # (psum'd frontier rows, the replicated gen/new deltas) appended
-        # by a wrapper around the wave body — the inner wave/merge
-        # builders never see the log, so the replicated row stays out
-        # of the shard-varying carry plumbing. The enabled-pair
-        # popcount is not visible at this level: lane 1 logs 0 and the
-        # host records enabled_pairs=null (_wave_log_pairs_valid).
+        # Per-wave trace logs (telemetry.py). TWO of them since round
+        # 11:
+        # * the GLOBAL log (psum'd frontier rows, the replicated
+        #   gen/new deltas), appended by a wrapper around the wave
+        #   body — the inner wave/merge builders never see it, so the
+        #   replicated row stays out of the shard-varying carry
+        #   plumbing. The enabled-pair popcount is not visible at
+        #   this level: lane 1 logs 0 (_wave_log_pairs_valid; the
+        #   host back-fills the wave event from the shard log's sum);
+        # * the PER-SHARD mesh log (SHARD_LOG_FIELDS) — NOT
+        #   psum-collapsed: each shard's wave row (local frontier/
+        #   pairs/candidates, routed and received rows, dest-tile
+        #   fill vs the lossless Bd cap, local post-dedup new, local
+        #   visited count) is assembled INSIDE the wave switch (where
+        #   those quantities exist) as the ``swave`` carry lane, and
+        #   the body wrapper appends it to ``slog``. Both logs ride
+        #   the chunk carry and download at the existing per-chunk
+        #   sync (slog as a second, shard-sharded stats output — same
+        #   dispatch, same blocking point, no extra round trip).
+        # Gated on an active tracer and cache-keyed (_cache_extras),
+        # so untraced programs compile exactly as before.
+        from ..telemetry import SHARD_LOG_LANES as SL
         from ..telemetry import WAVE_LOG_LANES as WL
 
         trace_log = self._wave_log_enabled()
@@ -396,8 +422,13 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             ])
             return dict(
                 **(
-                    dict(wlog=jnp.zeros((waves_per_sync, WL),
-                                        jnp.uint32))
+                    dict(
+                        wlog=jnp.zeros((waves_per_sync, WL),
+                                       jnp.uint32),
+                        slog=jnp.zeros((waves_per_sync, SL),
+                                       jnp.uint32),
+                        swave=jnp.zeros(SL, jnp.uint32),
+                    )
                     if trace_log else {}
                 ),
                 vkeys=vkeys,
@@ -427,7 +458,8 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                 done=jnp.bool_(n0 == 0),
             )
 
-        def merge_stage(c, v_class, R_c, recv, n_cand, sent, disc, ovf):
+        def merge_stage(c, v_class, R_c, recv, n_cand, sent, disc, ovf,
+                        shard_log=None):
             """Owner-local streaming-merge dedup (the DashMap-shard
             role, bfs.rs:28-29, on the TPU-fast path), round 10: the
             shard's visited array is incrementally sorted, so dedup is
@@ -448,7 +480,16 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             ONCE at wave level. Collectives (psum/pmax) stay out of
             the branches: every shard takes the same branch (the
             classes are pmax-agreed), but uniform collectives outside
-            the switch are the simpler contract."""
+            the switch are the simpler contract.
+
+            ``shard_log`` (traced runs only) is the wave's
+            ``(enabled_pairs, routed_rows, dest_fill_peak, dest_cap)``
+            per-shard scalars from the routing stage; this stage adds
+            the quantities it owns (received rows, post-dedup new,
+            the visited total) and returns the assembled
+            ``swave: uint32[SHARD_LOG_LANES]`` row in the carry — 36
+            bytes of extra switch output, priced by the lint's
+            sharded wave-body fixture (analysis/tables.py)."""
             disc_found, disc_lo, disc_hi = disc
             overflow0, f_overflow0, c_overflow, e_overflow = ovf
 
@@ -603,7 +644,29 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                 & ~e_overflow
             )
             nc_u32 = new_count.astype(jnp.uint32)
+            trace_extra = {}
+            if shard_log is not None:
+                # The per-shard mesh wave row (SHARD_LOG_FIELDS),
+                # assembled where the local quantities exist — lanes
+                # 0-1 close the sharded enabled_pairs=null hole.
+                wv_pairs, cross_rows, fill_peak, dest_cap = shard_log
+                trace_extra = dict(
+                    swave=jnp.stack(
+                        [
+                            c["n_loc"][0],
+                            wv_pairs.astype(jnp.uint32),
+                            n_cand.astype(jnp.uint32),
+                            cross_rows.astype(jnp.uint32),
+                            jnp.sum(r_val, dtype=jnp.uint32),
+                            fill_peak.astype(jnp.uint32),
+                            dest_cap,
+                            nc_u32,
+                            c["u_loc"][0] + nc_u32,
+                        ]
+                    )
+                )
             return dict(
+                **trace_extra,
                 vkeys=vkeys_new,
                 plog=plog_new,
                 pl_n=pl_n,
@@ -1003,11 +1066,26 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                     tiled=True,
                 )
 
+                shard_log = None
+                if trace_log:
+                    # Routing-stage lanes of the per-shard log: the
+                    # local enabled-pair popcount (the quantity the
+                    # global log can't see; candidates on the dense
+                    # path, mirroring the single-chip convention),
+                    # rows routed off-shard, and the peak destination
+                    # run vs this class's lossless tile cap.
+                    shard_log = (
+                        n_pairs if use_sparse else n_cand,
+                        cross,
+                        jnp.max(counts),
+                        jnp.uint32(Bd_c),
+                    )
                 return merge_stage(
                     c, v_class, R_c, recv, n_cand, sent,
                     (disc_found, disc_lo, disc_hi),
                     (c["overflow"], c["f_overflow"],
                      c_overflow, e_overflow),
+                    shard_log=shard_log,
                 )
 
             return wave
@@ -1027,7 +1105,8 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                 ).astype(jnp.int32)
             if trace_log:
                 n_tot = lax.psum(c["n_loc"][0], "shard")
-            ci = {k: v for k, v in c.items() if k != "wlog"}
+            ci = {k: v for k, v in c.items()
+                  if k not in ("wlog", "slog")}
             c2 = lax.switch(
                 f_class,
                 [make_wave(fc, v_class) for fc in range(len(f_ladder))],
@@ -1053,6 +1132,12 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                     c2,
                     wlog=lax.dynamic_update_slice(
                         c["wlog"], row[None, :],
+                        (c["wchunk"], jnp.int32(0)),
+                    ),
+                    # the per-shard row merge_stage assembled inside
+                    # the wave switch (shard-varying, never psum'd)
+                    slog=lax.dynamic_update_slice(
+                        c["slog"], c2["swave"][None, :],
                         (c["wchunk"], jnp.int32(0)),
                     ),
                 )
@@ -1094,11 +1179,19 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             if trace_log:
                 parts.append(c["wlog"].reshape(-1))
             stats = jnp.concatenate(parts)
+            if trace_log:
+                # The per-shard mesh log returns as a SECOND stats
+                # output, sharded along the device axis (the packed
+                # stats stay replicated) — same dispatch, same sync.
+                return c, stats, c["slog"].reshape(-1)
             return c, stats
 
         P_shard = P("shard")
         specs = dict(
-            **(dict(wlog=P()) if trace_log else {}),
+            **(
+                dict(wlog=P(), slog=P("shard", None), swave=P_shard)
+                if trace_log else {}
+            ),
             # SoA resident buffers shard along their ROW axis (axis 1
             # of the [lanes, rows] layout).
             vkeys=P(None, "shard"),
@@ -1132,12 +1225,23 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
         # (its named workaround). Newer jax type-checks varying-ness
         # instead, which the pvary/pcast promotions satisfy.
         sm_kw = {} if hasattr(lax, "pvary") else {"check_rep": False}
+        chunk_out = (
+            (specs, P(), P_shard) if trace_log else (specs, P())
+        )
         seed_sm = shard_map(
             seed_local, mesh=mesh, in_specs=P(), out_specs=specs,
             **sm_kw,
         )
         chunk_sm = shard_map(
-            chunk, mesh=mesh, in_specs=(specs,), out_specs=(specs, P()),
+            chunk, mesh=mesh, in_specs=(specs,), out_specs=chunk_out,
+            **sm_kw,
+        )
+        # Tooling hook (analysis/lint.py): the shard_map-wrapped wave
+        # body, re-traceable on the GLOBAL carry shapes — the sharded
+        # analog of the single-chip engine's ``_wave_body`` (the lint's
+        # sharded wave-body fixture prices the per-shard log path).
+        self._wave_body_sm = shard_map(
+            body, mesh=mesh, in_specs=(specs,), out_specs=specs,
             **sm_kw,
         )
         return jax.jit(seed_sm), jax.jit(chunk_sm, donate_argnums=0)

@@ -18,6 +18,16 @@ on — PAPERS.md: arXiv 1801.05857, 1203.6806):
   (8 uint32 lanes × waves_per_sync rows, downloaded WITH the packed
   stats — one readback per chunk, so the default path keeps async
   dispatch and the <5% overhead bar; see WAVE_LOG_LANES).
+* **Per-shard wave events** (round 11, the mesh observability layer)
+  — the sharded engines keep a second device log that is NOT
+  psum-collapsed (``SHARD_LOG_FIELDS``: local frontier/enabled/
+  candidate counts, routed and received row counts, dest-tile fill
+  vs the lossless ``Bd`` cap, per-shard post-dedup new and visited
+  totals), downloaded in the same per-chunk sync and emitted as one
+  ``shard_wave`` event per (wave, shard). :func:`shard_balance`
+  derives the skew/routing/occupancy summary ROADMAP direction 1
+  needs (tools/shard_report.py renders it; the dryrun/bench lanes
+  embed it).
 * **Chunk events** — the host-side wall split the engine can measure
   without extra syncs: device dispatch (the async ``chunk_fn`` call)
   vs host fetch (the blocking stats readback, which at the default
@@ -71,6 +81,32 @@ WAVE_LOG_FIELDS = (
     "depth",           # depth entering the wave
     "f_class",         # frontier ladder class dispatched
     "v_class",         # visited ladder class dispatched
+)
+
+#: per-SHARD device wave-log lane layout (the round-11 mesh
+#: observability layer): the sharded engines additionally keep a
+#: ``uint32[waves_per_sync, SHARD_LOG_LANES]`` log PER SHARD that is
+#: NOT psum-collapsed — it rides the chunk carry next to the global
+#: log and is downloaded with the packed stats (one extra device
+#: array in the same sync; no extra round trip). The host unpacks
+#: rows into ``shard_wave`` events, one per (wave, shard).
+#: ``enabled_pairs`` here is measured INSIDE the wave switch, so it is
+#: real on the sharded engine too (the global log's lane 1 can't see
+#: it and records null; :meth:`RunTracer.record_chunk` back-fills the
+#: global ``wave`` event from the shard sum). On dense paths — which
+#: have no (row, slot) pair extraction — the lane holds the candidate
+#: count, mirroring the single-chip dense wave's convention.
+SHARD_LOG_LANES = 9
+SHARD_LOG_FIELDS = (
+    "frontier_rows",   # live rows entering the wave on this shard
+    "enabled_pairs",   # local enabled-pair popcount (candidates on dense)
+    "candidates",      # surviving local candidates
+    "routed_rows",     # rows this shard sent to OTHER shards (send side)
+    "recv_rows",       # valid rows received after the all_to_all
+    "dest_fill_peak",  # peak per-destination send-tile fill this wave
+    "dest_cap",        # the lossless per-destination tile cap (Bd_c)
+    "new_states",      # post-dedup winners this shard appended
+    "visited_total",   # this shard's visited count AFTER the wave
 )
 
 _ACTIVE: Optional["RunTracer"] = None
@@ -262,12 +298,21 @@ class RunTracer:
         n_waves: int | None = None,
         wave_rows=None,
         pairs_valid: bool = True,
+        shard_rows=None,
     ) -> None:
         """One chunk sync: the host wall split plus the downloaded
         device wave-log rows (``wave_rows``: int array
         [n_waves, WAVE_LOG_LANES]; None for engines without a wave
-        log — the chunk event still lands). ``t0``/``t1`` are absolute
-        ``time.monotonic()`` stamps bracketing dispatch→fetch."""
+        log — the chunk event still lands). ``shard_rows`` is the
+        per-shard mesh log (int array
+        [n_shards, n_waves, SHARD_LOG_LANES]; None off the sharded
+        engines) — it lands as one ``shard_wave`` event per
+        (wave, shard), and when the GLOBAL log can't see the
+        enabled-pair popcount (``pairs_valid=False``) the wave event's
+        ``enabled_pairs`` is back-filled from the shard sum, closing
+        the sharded ``enabled_pairs=null`` hole. ``t0``/``t1`` are
+        absolute ``time.monotonic()`` stamps bracketing
+        dispatch→fetch."""
         rt0 = t0 - self._t_base
         rt1 = t1 - self._t_base
         if wave_rows is not None and n_waves is None:
@@ -284,6 +329,16 @@ class RunTracer:
             )
         )
         if wave_rows is None or n_waves is None or n_waves == 0:
+            if shard_rows is not None and n_waves != 0:
+                # loud, not silent: shard rows borrow their wave's
+                # identity (and its Chrome interval) — an engine that
+                # logs per-shard without a global log is a contract
+                # violation, not an empty trace
+                raise ValueError(
+                    "record_chunk: shard_rows without wave_rows — "
+                    "the per-shard mesh log requires the global wave "
+                    "log (shard_wave events hang off wave events)"
+                )
             return
         # Default level: the chunk ran async, so per-wave walls don't
         # exist — spread the chunk interval evenly and flag the
@@ -294,7 +349,13 @@ class RunTracer:
             row = [int(x) for x in wave_rows[i]]
             fields = dict(zip(WAVE_LOG_FIELDS, row))
             if not pairs_valid:
-                fields["enabled_pairs"] = None
+                if shard_rows is not None:
+                    # lane 1 of SHARD_LOG_FIELDS, summed over shards
+                    fields["enabled_pairs"] = int(
+                        sum(int(sr[i][1]) for sr in shard_rows)
+                    )
+                else:
+                    fields["enabled_pairs"] = None
             self._append(
                 dict(
                     ev="wave", run=self._run_idx, wave=wave0 + i,
@@ -305,6 +366,16 @@ class RunTracer:
                     **fields,
                 )
             )
+            if shard_rows is not None:
+                for s, srows in enumerate(shard_rows):
+                    self._append(
+                        dict(
+                            ev="shard_wave", run=self._run_idx,
+                            wave=wave0 + i, chunk=chunk, shard=s,
+                            **dict(zip(SHARD_LOG_FIELDS,
+                                       [int(x) for x in srows[i]])),
+                        )
+                    )
 
     # -- exporters -------------------------------------------------------
 
@@ -317,26 +388,48 @@ class RunTracer:
 
     def write_chrome_trace(self, path: str) -> str:
         """Chrome-trace / Perfetto JSON: host phases, device chunks,
-        and waves on three named tracks, plus counter tracks for the
-        frontier/new-state curves (``chrome://tracing`` or
-        ui.perfetto.dev)."""
+        and waves on three named tracks — plus one track PER SHARD
+        when the trace carries ``shard_wave`` events (tid 3+shard, so
+        a mesh run's per-shard load renders side by side) — and
+        counter tracks for the frontier/new-state curves
+        (``chrome://tracing`` or ui.perfetto.dev)."""
         with self._lock:
             events = list(self.events)
         out: list[dict] = []
         for pid, name in ((0, "stateright_tpu"),):
             out.append(dict(ph="M", pid=pid, name="process_name",
                             args=dict(name=name)))
-        for tid, name in ((0, "host phases"), (1, "device chunks"),
-                          (2, "waves")):
+        tracks = [(0, "host phases"), (1, "device chunks"),
+                  (2, "waves")]
+        shards = sorted({ev["shard"] for ev in events
+                         if ev.get("ev") == "shard_wave"})
+        tracks += [(3 + s, f"shard {s}") for s in shards]
+        for tid, name in tracks:
             out.append(dict(ph="M", pid=0, tid=tid, name="thread_name",
                             args=dict(name=name)))
+        # shard_wave events carry no walls of their own: they borrow
+        # their wave's interval, so index those intervals first.
+        wave_span = {
+            (ev["run"], ev["wave"]): (ev["t0"], ev["t1"])
+            for ev in events if ev.get("ev") == "wave"
+        }
 
         def us(t):
             return round(t * 1e6, 1)
 
         for ev in events:
             kind = ev.get("ev")
-            if kind == "span":
+            if kind == "shard_wave":
+                t0, t1 = wave_span.get(
+                    (ev["run"], ev["wave"]), (0.0, 0.0)
+                )
+                out.append(
+                    dict(ph="X", pid=0, tid=3 + ev["shard"],
+                         name=f"wave {ev['wave']}",
+                         ts=us(t0), dur=us(t1 - t0),
+                         args={k: ev[k] for k in SHARD_LOG_FIELDS})
+                )
+            elif kind == "span":
                 out.append(
                     dict(ph="X", pid=0, tid=0, name=ev["phase"],
                          ts=us(ev["t0"]), dur=us(ev["dur"]),
@@ -425,6 +518,8 @@ _REQUIRED = {
               "fetch_sec"),
     "wave": ("run", "wave", "chunk", "t0", "t1", "t_est")
     + WAVE_LOG_FIELDS,
+    "shard_wave": ("run", "wave", "chunk", "shard")
+    + SHARD_LOG_FIELDS,
 }
 
 
@@ -462,6 +557,10 @@ def validate_events(events: list[dict]) -> None:
     open_runs: set[int] = set()
     last_unique: dict[int, int] = {}
     last_wave: dict[int, int] = {}
+    # per (run, shard): the same running-sum check over the per-shard
+    # visited counter (visited_total is u_loc AFTER the wave)
+    last_visited: dict[tuple, int] = {}
+    last_shard_wave: dict[tuple, int] = {}
     for i, ev in enumerate(events):
         kind = ev["ev"]
         for field in _REQUIRED.get(kind, ()):
@@ -495,6 +594,28 @@ def validate_events(events: list[dict]) -> None:
                 )
             last_unique[run] = ev["unique_total"]
             last_wave[run] = ev["wave"]
+        elif kind == "shard_wave":
+            run = ev["run"]
+            if run not in open_runs:
+                raise ValueError(
+                    f"event {i}: shard_wave outside an open run"
+                )
+            key = (run, ev["shard"])
+            if (key in last_shard_wave
+                    and ev["wave"] <= last_shard_wave[key]):
+                last_visited.pop(key, None)  # retry restart
+            prev = last_visited.get(key)
+            if prev is not None and ev["visited_total"] != (
+                prev + ev["new_states"]
+            ):
+                raise ValueError(
+                    f"event {i}: shard {ev['shard']} wave "
+                    f"{ev['wave']} visited_total "
+                    f"{ev['visited_total']} != previous {prev} + "
+                    f"new_states {ev['new_states']}"
+                )
+            last_visited[key] = ev["visited_total"]
+            last_shard_wave[key] = ev["wave"]
 
 
 def _runs(events: list[dict]) -> list[int]:
@@ -503,7 +624,8 @@ def _runs(events: list[dict]) -> list[int]:
 
 def _run_view(events: list[dict], run: int) -> dict:
     view: dict = dict(run=run, begin=None, end=None, waves=[],
-                      chunks=[], spans=[], phase_totals={})
+                      chunks=[], spans=[], phase_totals={},
+                      shard_waves={})
     for ev in events:
         if ev.get("run") != run:
             continue
@@ -514,6 +636,13 @@ def _run_view(events: list[dict], run: int) -> dict:
             view["end"] = ev
         elif kind == "wave":
             view["waves"].append(ev)
+        elif kind == "shard_wave":
+            # keyed (wave, shard), last occurrence wins — the same
+            # last-attempt alignment the global wave dict gets from
+            # its keyed overwrite
+            view["shard_waves"].setdefault(
+                ev["wave"], {}
+            )[ev["shard"]] = ev
         elif kind == "chunk":
             view["chunks"].append(ev)
         elif kind == "span":
@@ -551,10 +680,269 @@ def _phase_durations(view: dict) -> dict[str, float]:
     return out
 
 
+# -- mesh observability: derived balance / routing metrics ----------------
+
+
+def _skew(xs: list) -> Optional[float]:
+    """max/mean over shards (1.0 = perfectly balanced, n_shards =
+    one shard carries everything); None for an all-zero wave."""
+    tot = sum(xs)
+    if tot == 0:
+        return None
+    return round(max(xs) * len(xs) / tot, 4)
+
+
+def shard_balance(events: list[dict], run: int | None = None,
+                  ) -> Optional[dict]:
+    """Derive the mesh balance/routing summary from one run's
+    ``shard_wave`` events — the numbers that decide whether the
+    (owner, fp)-sort shuffle scales (ROADMAP direction 1): per-wave
+    frontier/candidate skew (max/mean), routed shuffle volume,
+    dest-tile fill vs the lossless ``Bd`` cap, and the per-shard
+    visited occupancy trajectory. Returns None when the run carries
+    no shard events (an unsharded or untraced run).
+
+    ``run`` defaults to the LAST run in the event stream (bench/dryrun
+    trace warm-run-last). Worst-skew bookkeeping ignores waves whose
+    total is below the shard count — a 1-row seed wave on an 8-shard
+    mesh is "maximally imbalanced" by arithmetic, not by scheduling.
+
+    Headroom warnings come from the shared formatter
+    (stateright_tpu/occupancy.py): per-shard visited occupancy and
+    dest-tile fill past ``HEADROOM_THRESHOLD``, plus a skew warning
+    past 2x. The ``per_wave`` list carries the full trajectory for
+    tools/shard_report.py."""
+    from .occupancy import (
+        HEADROOM_THRESHOLD,
+        PROBE_PRESSURE_THRESHOLD,
+        occupancy_warning,
+    )
+
+    runs = _runs(events)
+    if not runs:
+        return None
+    view = _run_view(events, runs[-1] if run is None else run)
+    sw = view["shard_waves"]
+    if not sw:
+        return None
+    lane = (view["begin"] or {}).get("lane") or {}
+    tile_lanes = lane.get("dest_tile_lanes")
+    per_shard_capacity = lane.get("capacity")
+    # Visited-set semantics come from the lane config: the sort-merge
+    # engines' sorted arrays work to exactly 100% (headroom watch),
+    # the hash engine's open addressing degrades from ~70% (probe
+    # pressure — its own threshold and failure mode).
+    visited_exact = bool(lane.get("visited_exact", True))
+
+    per_wave: list[dict] = []
+    routed_total = recv_total = 0
+    worst_frontier = worst_cand = None  # (skew, wave)
+    worst_fill = None  # (util, fill, cap, wave)
+    skew_wsum = skew_weight = 0.0  # size-weighted frontier skew
+    final_visited: dict[int, int] = {}
+    n_shards = 0
+    for w in sorted(sw):
+        rows = [sw[w][s] for s in sorted(sw[w])]
+        n_shards = max(n_shards, len(rows))
+        fr = [r["frontier_rows"] for r in rows]
+        cand = [r["candidates"] for r in rows]
+        new = [r["new_states"] for r in rows]
+        routed = sum(r["routed_rows"] for r in rows)
+        recv = sum(r["recv_rows"] for r in rows)
+        fill = max(r["dest_fill_peak"] for r in rows)
+        cap = max(r["dest_cap"] for r in rows)
+        util = round(fill / cap, 4) if cap else None
+        m = dict(
+            wave=w,
+            shards=len(rows),
+            frontier_total=sum(fr),
+            frontier_skew=_skew(fr),
+            candidates_total=sum(cand),
+            candidate_skew=_skew(cand),
+            new_total=sum(new),
+            routed_rows=routed,
+            recv_rows=recv,
+            dest_fill_peak=fill,
+            dest_cap=cap,
+            dest_util=util,
+        )
+        per_wave.append(m)
+        routed_total += routed
+        recv_total += recv
+        if sum(fr) >= len(rows) and m["frontier_skew"] is not None:
+            if worst_frontier is None or m["frontier_skew"] > \
+                    worst_frontier[0]:
+                worst_frontier = (m["frontier_skew"], w)
+            skew_wsum += m["frontier_skew"] * sum(fr)
+            skew_weight += sum(fr)
+        if sum(cand) >= len(rows) and m["candidate_skew"] is not None:
+            if worst_cand is None or m["candidate_skew"] > \
+                    worst_cand[0]:
+                worst_cand = (m["candidate_skew"], w)
+        if util is not None and (worst_fill is None
+                                 or util > worst_fill[0]):
+            worst_fill = (util, fill, cap, w)
+        for r in rows:
+            final_visited[r["shard"]] = r["visited_total"]
+
+    visited = [final_visited[s] for s in sorted(final_visited)]
+    weighted = (
+        round(skew_wsum / skew_weight, 4) if skew_weight else None
+    )
+    warnings: list[str] = []
+    # the imbalance warning keys on the SIZE-WEIGHTED skew: the first
+    # BFS waves of any run are a handful of rows and always look
+    # maximally skewed, but they carry ~no work — a warning should
+    # mean the big waves (where the wall lives) are imbalanced.
+    if weighted is not None and weighted > 2.0:
+        warnings.append(
+            f"frontier imbalance: size-weighted skew {weighted:.2f}x "
+            f"(worst wave {worst_frontier[1]}: "
+            f"{worst_frontier[0]:.2f}x its fair share on one shard) — "
+            "the (owner, fp) partition is not spreading this "
+            "workload; sharding buys less than 1/S"
+        )
+    if worst_fill is not None:
+        msg = occupancy_warning(
+            worst_fill[0],
+            kind=f"dest tile (wave {worst_fill[3]})",
+            threshold=HEADROOM_THRESHOLD,
+            used=worst_fill[1],
+            capacity=worst_fill[2],
+            consequence=(
+                "a destination run past the lossless Bd cap trips "
+                "c_overflow — raise bucket_capacity before the next "
+                "skewed wave does"
+            ),
+        )
+        if msg:
+            warnings.append(msg)
+    occ_max = None
+    if per_shard_capacity and visited:
+        occ_max = round(max(visited) / per_shard_capacity, 4)
+        if visited_exact:
+            occ_threshold = HEADROOM_THRESHOLD
+            occ_consequence = (
+                "the sorted visited array overflows exactly at "
+                "100% — raise the per-shard capacity"
+            )
+        else:
+            occ_threshold = PROBE_PRESSURE_THRESHOLD
+            occ_consequence = (
+                "open addressing degrades before it fills — probe "
+                "failures become likely past ~85%; raise the "
+                "per-shard capacity"
+            )
+        for s in sorted(final_visited):
+            msg = occupancy_warning(
+                final_visited[s] / per_shard_capacity,
+                kind=f"shard {s} visited array",
+                threshold=occ_threshold,
+                used=final_visited[s],
+                capacity=per_shard_capacity,
+                consequence=occ_consequence,
+            )
+            if msg:
+                warnings.append(msg)
+
+    return dict(
+        run=view["run"],
+        n_shards=n_shards,
+        waves=len(per_wave),
+        frontier_skew_worst=(
+            dict(skew=worst_frontier[0], wave=worst_frontier[1])
+            if worst_frontier else None
+        ),
+        frontier_skew_weighted=weighted,
+        candidate_skew_worst=(
+            dict(skew=worst_cand[0], wave=worst_cand[1])
+            if worst_cand else None
+        ),
+        routed_rows_total=routed_total,
+        recv_rows_total=recv_total,
+        routed_bytes_total=(
+            routed_total * int(tile_lanes) * 4
+            if tile_lanes else None
+        ),
+        dest_fill_worst=(
+            dict(util=worst_fill[0], fill=worst_fill[1],
+                 cap=worst_fill[2], wave=worst_fill[3])
+            if worst_fill else None
+        ),
+        visited_per_shard=visited,
+        visited_skew=_skew(visited) if visited else None,
+        shard_capacity=per_shard_capacity,
+        occupancy_max=occ_max,
+        warnings=warnings,
+        per_wave=per_wave,
+    )
+
+
 #: wave counters trace_diff requires to MATCH between the two sides —
 #: two traces of the same workload must explore the same space.
 DIFF_COUNTERS = ("frontier_rows", "candidates", "new_states",
                  "unique_total")
+
+#: per-shard counters trace_diff compares — as a MULTISET of per-shard
+#: rows per wave, not positionally: the (owner, fp) partition is
+#: deterministic up to shard numbering, so a mesh relabeling (device
+#: enumeration order, a different host) permutes the rows without
+#: changing the set; positional comparison would false-positive.
+#: ``dest_cap`` is excluded: it is CONFIG (the class's Bd tile size),
+#: not exploration — a bucket_capacity-only A/B (the tuning diff this
+#: tool exists for) must compare on timing, not fail as divergence.
+SHARD_DIFF_COUNTERS = tuple(
+    f for f in SHARD_LOG_FIELDS if f != "dest_cap"
+)
+
+
+def _shard_divergences(va: dict, vb: dict) -> list[dict]:
+    """Shard-aware wave alignment (the mesh observability layer): for
+    every wave BOTH sides have per-shard rows for, the multisets of
+    per-shard counter tuples must match — shard RENUMBERING is fine
+    (the multiset is invariant), a different partition of the same
+    global counts is not. A wave with shard rows on exactly one side
+    also diverges (one run was sharded-traced, the other not — they
+    are not comparable as a mesh A/B)."""
+    from collections import Counter
+
+    out: list[dict] = []
+    sa, sb = va["shard_waves"], vb["shard_waves"]
+    if not sa and not sb:
+        return out
+    for i in sorted(set(sa) | set(sb)):
+        if (i in sa) != (i in sb):
+            out.append(
+                dict(wave=i, field="shard_present",
+                     a=i in sa, b=i in sb)
+            )
+            continue
+
+        def rows(view_waves):
+            return Counter(
+                tuple(ev[f] for f in SHARD_DIFF_COUNTERS)
+                for ev in view_waves[i].values()
+            )
+
+        ca, cb = rows(sa), rows(sb)
+        if len(sa[i]) != len(sb[i]):
+            out.append(
+                dict(wave=i, field="shard_count",
+                     a=len(sa[i]), b=len(sb[i]))
+            )
+        if ca != cb:
+            only_a = next(iter((ca - cb).elements()), None)
+            only_b = next(iter((cb - ca).elements()), None)
+            out.append(
+                dict(
+                    wave=i, field="shard_multiset",
+                    a="/".join(map(str, only_a))
+                    if only_a else None,
+                    b="/".join(map(str, only_b))
+                    if only_b else None,
+                )
+            )
+    return out
 
 
 def diff_traces(
@@ -601,6 +989,7 @@ def diff_traces(
                     dict(wave=i, field=field,
                          a=wa[i][field], b=wb[i][field])
                 )
+    divergences.extend(_shard_divergences(va, vb))
 
     pa = _phase_durations(va)
     pb = _phase_durations(vb)

@@ -77,6 +77,13 @@ def test_lint_clean_all_registered():
             # must be audited in both invocation styles.
             assert (spec.name, path) in covered, (spec.name, path)
     assert any(p["path"] == "wave-body" for p in report["paths"])
+    # the sharded engine's TRACED wave body (round 11: the per-shard
+    # mesh-log path) is part of the default gate
+    from stateright_tpu.analysis.registry import (
+        SHARDED_WAVE_BODY_FIXTURE,
+    )
+
+    assert (SHARDED_WAVE_BODY_FIXTURE, "wave-body") in covered
 
 
 def test_lint_registry_names_all_rules():
@@ -110,6 +117,32 @@ def test_wave_body_estimator_emits_and_meets_budget():
     # 10x above the measurement would let the collapse regress half
     # way back before failing).
     budget = CARRY_COPY_BYTE_BUDGETS[est[0].encoding]
+    assert data["budget_bytes"] == budget
+    assert data["switch_carry_bytes"] <= budget
+    assert budget < 2 * data["switch_carry_bytes"]
+
+
+def test_sharded_wave_body_traced_and_meets_budget():
+    """The SHARDED engine's wave body, in its TRACED form (round 11):
+    the per-shard mesh-log path (slog/swave) is registered with the
+    lint — zero gated-rule errors, and the switch-carry total sits
+    under its own budget (tables.CARRY_COPY_BYTE_BUDGETS) with the
+    same has-teeth margin as the single-chip fixture."""
+    from stateright_tpu.analysis.lint import lint_sharded_wave_body
+    from stateright_tpu.analysis.registry import (
+        SHARDED_WAVE_BODY_FIXTURE,
+    )
+    from stateright_tpu.analysis.tables import CARRY_COPY_BYTE_BUDGETS
+
+    findings, stats = lint_sharded_wave_body()
+    assert not _errors(findings)
+    est = [f for f in findings
+           if f.rule == "carry-copy-bytes" and f.severity == "info"]
+    assert len(est) == 1
+    assert est[0].encoding == SHARDED_WAVE_BODY_FIXTURE
+    data = est[0].data
+    assert data["switches"] > 0
+    budget = CARRY_COPY_BYTE_BUDGETS[SHARDED_WAVE_BODY_FIXTURE]
     assert data["budget_bytes"] == budget
     assert data["switch_carry_bytes"] <= budget
     assert budget < 2 * data["switch_carry_bytes"]

@@ -281,9 +281,133 @@ def test_traced_sharded_engine_wave_log():
     assert waves and waves[-1]["unique_total"] == 288
     # global (psum'd) frontier rows, not per-shard
     assert waves[0]["frontier_rows"] == 1
-    # the sharded log wrapper can't see the enabled popcount
-    assert all(w["enabled_pairs"] is None for w in waves)
+    # the GLOBAL log wrapper still can't see the enabled popcount,
+    # but the per-shard mesh log can: the wave event's enabled_pairs
+    # is back-filled from the shard sum (the round-11 hole closure)
+    shard_waves = [e for e in tr.events if e["ev"] == "shard_wave"]
+    assert shard_waves
+    for w in waves:
+        rows = [e for e in shard_waves if e["wave"] == w["wave"]]
+        assert len(rows) == 4
+        assert w["enabled_pairs"] == sum(
+            r["enabled_pairs"] for r in rows
+        )
+        assert w["enabled_pairs"] >= w["candidates"]
     assert tr.events[0]["lane"]["n_shards"] == 4
+    assert tr.events[0]["lane"]["dest_tile_lanes"] > 0
+
+
+def test_traced_sharded_parity_and_shard_log_8_mesh():
+    """The round-11 acceptance gate: on the virtual 8-CPU mesh, a
+    TRACED sharded run explores exactly the space an untraced one does
+    (the per-shard log must not perturb the search), every wave gets
+    one ``shard_wave`` event per shard, the per-shard counters
+    reconcile with the global log lane for lane, and the derived
+    shard_balance summary agrees with the engine's own shuffle
+    metric."""
+    from jax.sharding import Mesh
+
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+    from stateright_tpu.telemetry import shard_balance
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs the virtual 8-device CPU mesh")
+    mesh = Mesh(np.array(devices[:8]), ("shard",))
+
+    def spawn():
+        return (
+            TwoPhaseSys(rm_count=3)
+            .checker()
+            .spawn_tpu_sharded_sortmerge(
+                mesh=mesh,
+                capacity=1 << 10,
+                frontier_capacity=256,
+                cand_capacity=1024,
+                bucket_capacity=512,
+                waves_per_sync=8,
+                track_paths=False,
+            )
+        )
+
+    c0 = spawn().join()
+    tr = RunTracer()
+    with tr.activate():
+        c1 = spawn().join()
+    assert c1.unique_state_count() == c0.unique_state_count() == 288
+    assert c1.state_count() == c0.state_count()
+    validate_events(tr.events)
+    waves = {e["wave"]: e for e in tr.events if e["ev"] == "wave"}
+    shard_waves = [e for e in tr.events if e["ev"] == "shard_wave"]
+    assert waves and shard_waves
+    for w, ev in waves.items():
+        rows = [e for e in shard_waves if e["wave"] == w]
+        assert len(rows) == 8
+        assert sum(r["frontier_rows"] for r in rows) == \
+            ev["frontier_rows"]
+        assert sum(r["candidates"] for r in rows) == ev["candidates"]
+        assert sum(r["new_states"] for r in rows) == ev["new_states"]
+        assert sum(r["visited_total"] for r in rows) == \
+            ev["unique_total"]
+        # the Bd cap gates all_to_all correctness: a logged fill can
+        # never exceed it on a completed (non-overflow) run
+        assert all(r["dest_fill_peak"] <= r["dest_cap"] for r in rows)
+    bal = shard_balance(tr.events)
+    assert bal is not None and bal["n_shards"] == 8
+    assert bal["waves"] == len(waves)
+    assert sum(bal["visited_per_shard"]) == 288
+    # trace-derived routed volume == the engine's psum'd shuffle
+    # counter (two independent paths to the same number)
+    assert bal["routed_rows_total"] == c1.metrics["shuffle_volume"]
+    # a self-diff of the sharded trace is clean (shard-aware
+    # alignment included)
+    rep = diff_traces(tr.events, tr.events)
+    assert rep["ok"], rep["divergences"]
+
+
+def test_traced_sharded_hash_engine_shard_log():
+    """The hash-table sharded engine (parallel/engine.py) grew BOTH
+    logs in round 11 — it previously traced chunk events only. Counts
+    unchanged, wave events present, shard rows reconcile."""
+    from jax.sharding import Mesh
+
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    devices = jax.devices()
+    if len(devices) < 4:
+        pytest.skip("needs the virtual multi-device CPU mesh")
+    mesh = Mesh(np.array(devices[:4]), ("shard",))
+
+    def spawn():
+        return (
+            TwoPhaseSys(rm_count=3)
+            .checker()
+            .spawn_tpu_sharded(
+                mesh=mesh,
+                capacity=1 << 10,
+                frontier_capacity=256,
+                cand_capacity=1024,
+                bucket_capacity=512,
+                waves_per_sync=8,
+                track_paths=False,
+            )
+        )
+
+    c0 = spawn().join()
+    tr = RunTracer()
+    with tr.activate():
+        c1 = spawn().join()
+    assert c1.unique_state_count() == c0.unique_state_count() == 288
+    validate_events(tr.events)
+    waves = [e for e in tr.events if e["ev"] == "wave"]
+    shard_waves = [e for e in tr.events if e["ev"] == "shard_wave"]
+    assert waves[-1]["unique_total"] == 288
+    for w in waves:
+        rows = [e for e in shard_waves if e["wave"] == w["wave"]]
+        assert len(rows) == 4
+        assert sum(r["new_states"] for r in rows) == w["new_states"]
+        assert sum(r["visited_total"] for r in rows) == \
+            w["unique_total"]
 
 
 def test_auto_budget_retry_event_and_warning(tmp_path):
@@ -472,6 +596,321 @@ def test_trace_diff_cli_exit_codes(tmp_path):
     with open(bad, "w") as fh:
         fh.write("not json\n")
     assert run(a, bad).returncode == 2
+
+
+# -- mesh observability: shard_wave / shard_balance / shard_report -------
+
+
+def _synthetic_shard_trace(tmp_path, name, per_shard_new, *,
+                           permute=False, dest_cap=512, fill=8,
+                           capacity=1024, visited0=1,
+                           visited_exact=True):
+    """A schema-valid sharded trace: ``per_shard_new[wave][shard]`` is
+    the post-dedup new count; global rows are the shard sums, so the
+    two log levels reconcile the way a real engine's do. ``permute``
+    reverses the shard numbering (the relabeling the multiset
+    alignment must tolerate); ``fill``/``dest_cap`` set the dest-tile
+    lanes; ``visited0`` seeds each shard's visited counter."""
+    tr = RunTracer()
+    with tr.activate():
+        S = len(per_shard_new[0])
+        tr.begin_run(lane=dict(engine="T", n_shards=S,
+                               capacity=capacity, dest_tile_lanes=10,
+                               visited_exact=visited_exact))
+        visited = [visited0] * S
+        prev_front = [1] * S
+        u = S * visited0
+        rows_g, rows_s = [], []
+        for i, new in enumerate(per_shard_new):
+            cand = [n * 2 for n in new]
+            u += sum(new)
+            rows_g.append([sum(prev_front), sum(cand), sum(cand),
+                           sum(new), u, i + 1, 0, 0])
+            wave_rows = []
+            for s in range(S):
+                visited[s] += new[s]
+                wave_rows.append([
+                    prev_front[s], cand[s], cand[s],
+                    cand[s] // 2, cand[s], fill, dest_cap,
+                    new[s], visited[s],
+                ])
+            rows_s.append(wave_rows)
+            prev_front = new
+        sr = np.array(rows_s).transpose(1, 0, 2)  # [S, waves, lanes]
+        if permute:
+            sr = sr[::-1]
+        tr.record_chunk(
+            chunk=0, wave0=0, t0=0.0, t1=1.0,
+            dispatch_sec=0.01, fetch_sec=0.9,
+            wave_rows=np.array(rows_g), shard_rows=sr,
+        )
+        tr.end_run(error=None, total_states=u, unique_states=u,
+                   max_depth=len(per_shard_new), duration_sec=2.0)
+    path = str(tmp_path / name)
+    tr.write_jsonl(path)
+    return path
+
+
+BALANCED = [[8, 8, 8, 8], [40, 40, 40, 40], [100, 100, 100, 100]]
+
+
+def test_shard_wave_schema_valid_and_chrome_tracks(tmp_path):
+    from stateright_tpu.telemetry import SHARD_LOG_FIELDS
+
+    path = _synthetic_shard_trace(tmp_path, "s.jsonl", BALANCED)
+    evs = load_trace(path)
+    validate_events(evs)
+    sws = [e for e in evs if e["ev"] == "shard_wave"]
+    assert len(sws) == 3 * 4
+    for field in SHARD_LOG_FIELDS:
+        assert field in sws[0]
+    # schema rejection: a broken per-shard running sum
+    bad = [dict(e) for e in evs]
+    victim = next(e for e in bad if e["ev"] == "shard_wave"
+                  and e["wave"] == 2)
+    victim["visited_total"] += 1
+    with pytest.raises(ValueError, match="visited_total"):
+        validate_events(bad)
+    # missing-field rejection
+    bad2 = [dict(e) for e in evs]
+    del next(e for e in bad2
+             if e["ev"] == "shard_wave")["routed_rows"]
+    with pytest.raises(ValueError, match="routed_rows"):
+        validate_events(bad2)
+    # Chrome export renders one track per shard
+    tr = RunTracer()
+    tr.events = evs
+    chrome = tr.write_chrome_trace(str(tmp_path / "s.trace.json"))
+    ct = json.load(open(chrome))
+    names = {e["args"]["name"] for e in ct["traceEvents"]
+             if e.get("name") == "thread_name"}
+    assert {"shard 0", "shard 3"} <= names
+
+
+def test_shard_balance_flags_deliberate_imbalance(tmp_path):
+    """The skew-metric satellite: one shard carrying the whole big
+    waves must flag, a balanced run must not."""
+    from stateright_tpu.telemetry import shard_balance
+
+    ok = load_trace(
+        _synthetic_shard_trace(tmp_path, "ok.jsonl", BALANCED)
+    )
+    bal = shard_balance(ok)
+    assert bal["n_shards"] == 4 and bal["waves"] == 3
+    assert bal["frontier_skew_weighted"] == 1.0
+    assert not any("imbalance" in w for w in bal["warnings"])
+
+    skewed = load_trace(
+        _synthetic_shard_trace(
+            tmp_path, "skew.jsonl",
+            [[8, 8, 8, 8], [400, 0, 0, 0], [400, 0, 0, 0]],
+        )
+    )
+    bal2 = shard_balance(skewed)
+    assert bal2["frontier_skew_worst"]["skew"] == 4.0
+    assert bal2["frontier_skew_weighted"] > 2.0
+    assert any("imbalance" in w for w in bal2["warnings"])
+    # routed volume prices bytes off the lane's tile width
+    assert bal2["routed_bytes_total"] == \
+        bal2["routed_rows_total"] * 10 * 4
+
+
+def test_shard_balance_headroom_warnings(tmp_path):
+    """dest-tile fill near the lossless Bd cap and a shard's visited
+    occupancy near capacity both warn, via the SHARED formatter
+    (stateright_tpu/occupancy.py)."""
+    from stateright_tpu.telemetry import shard_balance
+
+    tight = load_trace(
+        _synthetic_shard_trace(
+            tmp_path, "tight.jsonl", BALANCED,
+            dest_cap=100, fill=95, capacity=200, visited0=40,
+        )
+    )
+    bal = shard_balance(tight)
+    assert bal["dest_fill_worst"]["util"] == 0.95
+    assert any("dest tile" in w and "bucket_capacity" in w
+               for w in bal["warnings"])
+    assert bal["occupancy_max"] is not None
+    assert any("visited array" in w and "overflows exactly" in w
+               for w in bal["warnings"])
+
+    # a HASH-engine lane (visited_exact=False) watches probe
+    # pressure instead: warns earlier (0.7 bar) with the
+    # open-addressing failure mode, not exact-capacity headroom
+    probing = load_trace(
+        _synthetic_shard_trace(
+            tmp_path, "probe.jsonl", BALANCED,
+            capacity=200, visited0=40, visited_exact=False,
+        )
+    )
+    bal2 = shard_balance(probing)
+    assert any("probe failures" in w for w in bal2["warnings"])
+    assert not any("overflows exactly" in w for w in bal2["warnings"])
+    # at ~0.37 occupancy an exact-capacity lane is quiet where the
+    # probe watch would also be — threshold semantics, not noise
+    mid = load_trace(
+        _synthetic_shard_trace(
+            tmp_path, "mid.jsonl", BALANCED,
+            capacity=200, visited0=11, visited_exact=False,
+        )
+    )
+    # 11 + 148 = 159/200 = 0.795 > 0.7: the probe watch fires where
+    # the exact-capacity watch (0.8 bar) would stay quiet
+    bal3 = shard_balance(mid)
+    assert any("probe failures" in w for w in bal3["warnings"])
+    mid_exact = load_trace(
+        _synthetic_shard_trace(
+            tmp_path, "mid_exact.jsonl", BALANCED,
+            capacity=200, visited0=11, visited_exact=True,
+        )
+    )
+    assert not any("visited array" in w
+                   for w in shard_balance(mid_exact)["warnings"])
+
+
+def test_occupancy_warning_shared_helper():
+    """The deduplicated occupancy formatter: one home for the
+    hash-engine probe-pressure warning AND the mesh report's
+    exact-capacity headroom warnings."""
+    from stateright_tpu.occupancy import (
+        HEADROOM_THRESHOLD,
+        occupancy_warning,
+    )
+
+    assert occupancy_warning(0.5) is None
+    msg = occupancy_warning(0.8, used=800, capacity=1000)
+    assert "80% full" in msg and "(800/1000)" in msg
+    assert "probe failures" in msg  # the hash-engine default
+    custom = occupancy_warning(
+        0.95, kind="shard 3 visited array",
+        threshold=HEADROOM_THRESHOLD,
+        consequence="overflows at 100%",
+    )
+    assert custom.startswith("shard 3 visited array")
+    assert "overflows at 100%" in custom
+    # at-threshold is quiet (warn past, not at)
+    assert occupancy_warning(HEADROOM_THRESHOLD,
+                             threshold=HEADROOM_THRESHOLD) is None
+
+
+def test_hash_engine_occupancy_warning_uses_helper():
+    """checkers/tpu.py's probe-pressure warning now routes through
+    the shared formatter (the dedup satellite) — same text, same
+    threshold, absolute counts included."""
+    from stateright_tpu.checkers.tpu import TpuBfsChecker
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    c = TwoPhaseSys(rm_count=3).checker().spawn_tpu(
+        capacity=1 << 10, frontier_capacity=256, track_paths=False,
+    )
+    assert isinstance(c, TpuBfsChecker)
+    c._unique_states = 800
+    c.total_capacity = 1000
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        c._maybe_warn_occupancy(0.8)
+        c._maybe_warn_occupancy(0.5)  # under threshold: quiet
+    msgs = [str(w.message) for w in rec]
+    assert len(msgs) == 1
+    assert "visited table 80% full (800/1000)" in msgs[0]
+    assert "probe failures" in msgs[0]
+
+
+def test_trace_diff_shard_aware_alignment(tmp_path):
+    """Shard-aware wave alignment: shard RENUMBERING must not
+    false-positive (multiset comparison), a redistributed partition
+    with identical GLOBAL counters must still diverge."""
+    a = load_trace(_synthetic_shard_trace(tmp_path, "a.jsonl",
+                                          BALANCED))
+    # same rows, shards relabeled in reverse — a mesh relabeling
+    perm = load_trace(
+        _synthetic_shard_trace(tmp_path, "p.jsonl", BALANCED,
+                               permute=True)
+    )
+    rep = diff_traces(a, perm)
+    assert rep["ok"], rep["divergences"]
+
+    # dest_cap is CONFIG, not exploration: a bucket_capacity-only
+    # A/B (different Bd, same counts) must compare on timing, not
+    # fail the alignment gate
+    retuned = load_trace(
+        _synthetic_shard_trace(tmp_path, "cap.jsonl", BALANCED,
+                               dest_cap=2048)
+    )
+    assert diff_traces(a, retuned)["ok"]
+
+    # redistribute wave 2 across shards: global sums identical, the
+    # per-shard partition is not → shard_multiset divergence
+    moved = load_trace(
+        _synthetic_shard_trace(
+            tmp_path, "m.jsonl",
+            [[8, 8, 8, 8], [40, 40, 40, 40], [130, 70, 100, 100]],
+        )
+    )
+    rep2 = diff_traces(a, moved)
+    assert not rep2["ok"]
+    fields = {d["field"] for d in rep2["divergences"]}
+    # the redistribution preserves every GLOBAL counter — only the
+    # shard-aware layer catches it
+    assert fields == {"shard_multiset"}
+    # one side sharded, the other not → shard_present divergence
+    unsharded = load_trace(_synthetic_trace(tmp_path, "u.jsonl",
+                                            new=(32, 160, 400)))
+    rep3 = diff_traces(a, unsharded)
+    assert not rep3["ok"]
+    assert any(d["field"] == "shard_present"
+               for d in rep3["divergences"])
+
+
+def test_trace_diff_cli_shard_exit_codes(tmp_path):
+    """The satellite's exit-code contract, through the real CLI."""
+    a = _synthetic_shard_trace(tmp_path, "a.jsonl", BALANCED)
+    perm = _synthetic_shard_trace(tmp_path, "p.jsonl", BALANCED,
+                                  permute=True)
+    moved = _synthetic_shard_trace(
+        tmp_path, "m.jsonl",
+        [[8, 8, 8, 8], [40, 40, 40, 40], [130, 70, 100, 100]],
+    )
+    tool = os.path.join(REPO_ROOT, "tools", "trace_diff.py")
+
+    def run(*argv):
+        return subprocess.run(
+            [sys.executable, tool, *argv],
+            capture_output=True, text=True, cwd=REPO_ROOT,
+        )
+
+    assert run(a, perm).returncode == 0  # renumbering: clean
+    div = run(a, moved)
+    assert div.returncode == 1
+    assert "shard_multiset" in div.stdout
+
+
+def test_shard_report_cli(tmp_path):
+    tool = os.path.join(REPO_ROOT, "tools", "shard_report.py")
+
+    def run(*argv):
+        return subprocess.run(
+            [sys.executable, tool, *argv],
+            capture_output=True, text=True, cwd=REPO_ROOT,
+        )
+
+    skewed = _synthetic_shard_trace(
+        tmp_path, "skew.jsonl",
+        [[8, 8, 8, 8], [400, 0, 0, 0], [400, 0, 0, 0]],
+    )
+    out = run(skewed)
+    assert out.returncode == 0, out.stderr
+    assert "shard balance: run #0, 4 shards" in out.stdout
+    assert "worst-wave skew" in out.stdout
+    assert "cumulative shuffle" in out.stdout
+    assert "WARNING" in out.stdout  # the skew warning surfaces
+
+    # a trace without shard events is a usage error, not a crash
+    plain = _synthetic_trace(tmp_path, "plain.jsonl")
+    bad = run(plain)
+    assert bad.returncode == 2
+    assert "no shard_wave events" in bad.stderr
 
 
 # -- CLI flag ------------------------------------------------------------

@@ -13,6 +13,12 @@ artifact and this tool is the comparison —
   divergence means the runs are not comparable (different model,
   bounds, or a correctness regression) and the gate fails regardless
   of timing.
+* **shard-aware alignment** (round 11) — traces carrying per-shard
+  ``shard_wave`` events additionally align each wave's SHARD rows as
+  a MULTISET of counter tuples: the (owner, fp) partition is
+  deterministic up to shard numbering, so a mesh relabeling passes
+  while a redistributed partition — even one whose GLOBAL sums
+  match — fails. The global counters must still match exactly.
 * **per-phase deltas** — host spans (compile, reconstruction,
   property checks), the chunk dispatch/fetch wall split, the wave
   wall, and the run total, each reported as A/B/delta/relative.

@@ -27,6 +27,11 @@ lane whose TRACE carries per-shard ``shard_wave`` events additionally
 gets the derived ``shard_balance`` skew/routing summary
 (telemetry.shard_balance — the same block the MULTICHIP dryrun
 embeds), so direction-1 mesh runs land with skew numbers attached.
+Every lane also embeds its ``memory_plan`` totals (the resident-buffer
+ledger, stateright_tpu/memplan.py) and — on traced lanes, where the
+watermark polls — the run's device peak bytes, so BENCH artifacts
+land with memory numbers attached the way they land with balance
+numbers.
 """
 
 import argparse
@@ -558,6 +563,31 @@ def main():
             **({"shuffle_volume": checker.metrics["shuffle_volume"]}
                if "shuffle_volume" in checker.metrics else {}),
         }
+        # Memory ledger (round 12, stateright_tpu/memplan.py): every
+        # lane embeds its resident/staging plan totals — the engines
+        # compute the plan untraced too (eval_shape, no device work)
+        # — and the run peak where the watermark polled it (traced
+        # lanes only: polling rides the tracer gate).
+        mp = getattr(checker, "memory_plan", None)
+        if mp is not None:
+            detail[name]["memory_plan"] = {
+                "resident_bytes": mp["resident_bytes"],
+                "class_peak_bytes": mp["class_peak_bytes"],
+                "total_bytes": mp["total_bytes"],
+            }
+            _stderr(
+                f"     memory: resident "
+                f"{mp['resident_bytes']:,} B + class peak "
+                f"{mp['class_peak_bytes']:,} B = "
+                f"{mp['total_bytes']:,} B planned"
+                + (f"; device peak "
+                   f"{checker.metrics['device_peak_bytes']:,} B"
+                   if "device_peak_bytes" in checker.metrics else "")
+            )
+        if "device_peak_bytes" in getattr(checker, "metrics", {}):
+            detail[name]["device_peak_bytes"] = (
+                checker.metrics["device_peak_bytes"]
+            )
         if lane_traced:
             # a traced MESH lane leaves its skew numbers in the lane
             # detail (single-chip traces have no shard_wave events
@@ -665,6 +695,24 @@ def main():
                                 detail[headline_name]["merge_stage"],
                         } if headline_name in detail
                             and "merge_impl" in detail[headline_name]
+                            else {}),
+                        # the headline's memory ledger totals + run
+                        # peak (round 12): the BENCH artifact carries
+                        # the numbers a chip run's capacity decisions
+                        # read, the way it carries merge_stage
+                        **({
+                            "memory_plan":
+                                detail[headline_name]["memory_plan"],
+                        } if headline_name in detail
+                            and "memory_plan" in detail[headline_name]
+                            else {}),
+                        **({
+                            "device_peak_bytes":
+                                detail[headline_name][
+                                    "device_peak_bytes"],
+                        } if headline_name in detail
+                            and "device_peak_bytes"
+                            in detail[headline_name]
                             else {}),
                         **({"lint": lint_ref}
                            if lint_ref is not None else {}),

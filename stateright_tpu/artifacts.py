@@ -13,6 +13,13 @@ for the provenance block every artifact embeds — the "number with no
 context" fix: a count or a wall time is only comparable across rounds
 when the artifact names the jax/jaxlib versions, device, platform,
 git SHA, and lane config it was measured under.
+
+``MEM_r*.json`` (memory summaries, tools/mem_report.py /
+memplan.write_memory_artifact) numbers through the same helpers but
+in its OWN sequence (``next_round(root, stems=("MEM",))`` —
+``MEM_r01`` first): a MEM artifact is *derived from* a TRACE and
+names it in its ``trace`` field, so the cross-reference — not a
+shared counter — pairs it with a perf round.
 """
 
 from __future__ import annotations

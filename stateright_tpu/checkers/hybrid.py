@@ -106,12 +106,30 @@ class HybridChecker(Checker):
                 # deep workloads (exactly where the device wins by
                 # ~83x) running out of host memory is the race being
                 # LOST, not a defect in the model. Keep the device's
-                # completed verification; note the host's demise.
+                # completed verification; note the host's demise — as
+                # a warning for humans AND a structured telemetry
+                # event (phase + message) so a traced run records the
+                # race outcome in the artifact, not only on stderr
+                # (the memory-observability contract: host OOM is a
+                # memory datum).
                 import warnings
 
-                warnings.warn(
+                from .. import telemetry
+
+                msg = (
                     "hybrid race: host engine ran out of memory; "
-                    "adopting the device engine's completed result",
+                    "adopting the device engine's completed result"
+                )
+                telemetry.emit(
+                    "hybrid_host_oom",
+                    phase="host_dfs",
+                    message=msg,
+                    winner=self.winner,
+                    error=f"{type(host_error[0]).__name__}: "
+                          f"{host_error[0]}",
+                )
+                warnings.warn(
+                    msg,
                     RuntimeWarning,
                     stacklevel=2,
                 )

@@ -359,6 +359,14 @@ class TpuBfsChecker(Checker):
         #: the active RunTracer (telemetry.py), resolved at _run time;
         #: engine variants gate their device wave log on it.
         self._tracer = None
+        #: per-ladder-class build info recorded by _build_programs
+        #: (staging shapes, CHUNKED-mode records) — rides the program
+        #: cache so cache-hit instances see it too (_lookup_programs).
+        self._build_info = None
+        #: the resident-buffer ledger (stateright_tpu/memplan.py),
+        #: set by every _run — bench.py embeds its totals per lane
+        #: even untraced; traced runs emit it as the memory_plan event.
+        self.memory_plan = None
 
     # -- results ----------------------------------------------------------
 
@@ -635,6 +643,26 @@ class TpuBfsChecker(Checker):
         def cond(c):
             return ~c["done"] & (c["wchunk"] < waves_per_sync)
 
+        # Memory ledger (memplan.py): the hash engine has no ladder —
+        # one fixed-shape class whose staging is the flat candidate
+        # payload, its compacted B-row buffer, and the key limbs.
+        from ..memplan import buffer_entry, plan_total
+
+        _staging = [
+            buffer_entry("cand_payload", (F * K, E), "uint32"),
+            buffer_entry("cand_compact", (B, E), "uint32"),
+            buffer_entry("cand_keys", (2, B), "uint32"),
+        ]
+        self._build_info = dict(
+            classes=[dict(
+                f_class=0, v_class=0, mode="hash",
+                frontier_rows=F, visited_rows=capacity,
+                staging=_staging, staging_bytes=plan_total(_staging),
+            )],
+            v_classes=[],
+            engine_modes=[],
+        )
+
         def chunk(carry):
             c = dict(carry, wchunk=jnp.int32(0))
             c = lax.while_loop(cond, body, c)
@@ -692,6 +720,9 @@ class TpuBfsChecker(Checker):
             )
             self.waves_per_sync = 1
             self._programs = None
+            # the wave-log shape changed with waves_per_sync: the
+            # ledger must re-derive from the rebuilt programs
+            self.memory_plan = None
 
         enc = self.encoded
         props = list(self.model.properties())
@@ -731,12 +762,47 @@ class TpuBfsChecker(Checker):
                 self._programs = self._lookup_programs(n0)
         seed_fn, chunk_fn = self._programs
 
+        # Resident-buffer ledger (stateright_tpu/memplan.py): the
+        # plan is declared at program-build time from the seed
+        # program's OWN output spec (jax.eval_shape — no allocation,
+        # no compile), so the declaration cannot drift from the
+        # carry the engine actually keeps resident. Always kept on
+        # the checker (bench.py embeds the totals untraced); emitted
+        # as the schema-validated ``memory_plan`` event — with the
+        # compiled-program memory analysis attached, the one part
+        # that costs an AOT compile the persistent XLA cache dedups
+        # — only when a tracer is active. Computed once per BUILT
+        # program, not per run: re-joins of one checker reuse it
+        # (the untraced overhead pool must not pay a per-run seed
+        # retrace), and every site that rebuilds programs (retry
+        # resize, deep-sync override) clears it alongside.
+        plan_key = (n0, self._wave_log_enabled())
+        if (self.memory_plan is None
+                or getattr(self, "_memory_plan_key", None)
+                != plan_key):
+            self.memory_plan = self._memory_plan(
+                n0, with_compiled=tracer is not None
+            )
+            self._memory_plan_key = plan_key
+        if tracer is not None:
+            for mode in (getattr(self, "_build_info", None)
+                         or {}).get("engine_modes", ()):
+                tracer.event("engine_mode", **mode)
+            tracer.event("memory_plan", **self.memory_plan)
+
         with telemetry.span("seed_upload"):
             carry = seed_fn(jnp.asarray(init))  # the run's one upload
 
         chunk_idx = 0
         prev_waves = 0
         deep = tracer is not None and tracer.level == "deep"
+        # Live watermarks: device bytes-in-use polled ONLY at the
+        # existing per-chunk sync (the stats readback just blocked —
+        # no new syncs), traced runs only so the untraced host path
+        # is untouched.
+        mem_peak = None
+        mem_src = None
+        mem_polls = 0
         while True:
             if self.cancel_event is not None and self.cancel_event.is_set():
                 self.cancelled = True
@@ -763,6 +829,14 @@ class TpuBfsChecker(Checker):
             s = np.asarray(stats)  # the chunk's one readback
             t1 = time.monotonic()
             if tracer is not None:
+                from ..memplan import device_bytes_in_use
+
+                mem_now, src = device_bytes_in_use()
+                if mem_now is not None:
+                    mem_src = src
+                    mem_polls += 1
+                    mem_peak = (mem_now if mem_peak is None
+                                else max(mem_peak, mem_now))
                 waves_now = int(s[4])
                 n_waves = waves_now - prev_waves
                 rows = self._wave_log_rows(s, n_props)
@@ -781,6 +855,7 @@ class TpuBfsChecker(Checker):
                     pairs_valid=self._wave_log_pairs_valid(),
                     shard_rows=(None if srows is None
                                 else srows[:, :n_waves]),
+                    mem_bytes=mem_now,
                 )
                 prev_waves = waves_now
                 chunk_idx += 1
@@ -798,6 +873,8 @@ class TpuBfsChecker(Checker):
                 ),
                 waves=int(s[4]),
             )
+            if mem_peak is not None:
+                self.metrics["device_peak_bytes"] = mem_peak
             overflow_msg = None
             if bool(s[1]):
                 overflow_msg = (
@@ -847,6 +924,13 @@ class TpuBfsChecker(Checker):
                         "discovery_fingerprints() after catching this "
                         "error."
                     )
+                if tracer is not None:
+                    # the overflow attempt's watermark still lands
+                    # (the auto-budget retry re-runs inside the same
+                    # trace run; last watermark wins in the views)
+                    self._emit_memory_watermark(
+                        tracer, mem_peak, mem_src, mem_polls
+                    )
                 raise RuntimeError(overflow_msg)
             if not done:
                 self._maybe_warn_occupancy(self.metrics["occupancy"])
@@ -863,6 +947,10 @@ class TpuBfsChecker(Checker):
                     )
                 )
 
+        if tracer is not None:
+            self._emit_memory_watermark(
+                tracer, mem_peak, mem_src, mem_polls
+            )
         # Keep device handles; download lazily only if a path is
         # reconstructed (_build_generated).
         self._capture_final(carry)
@@ -892,18 +980,17 @@ class TpuBfsChecker(Checker):
                 if reconstruct and self.track_paths:
                     self._discoveries[prop.name] = self._reconstruct(fp)
 
-    def _lookup_programs(self, n0: int):
-        """Build-or-fetch the compiled device programs. Programs are
-        shared between checkers only when the encoding declares an
-        identity (cache_key): shapes alone can't distinguish different
-        transition functions. Engine variants reuse this helper so the
-        cache key stays defined in exactly one place."""
-        _enable_persistent_cache()
+    def _program_cache_key(self, n0: int):
+        """The compiled-program identity (one home; engine variants
+        contribute via ``_cache_extras``). None when the encoding
+        declares no ``cache_key`` — shapes alone can't distinguish
+        different transition functions, so such programs are never
+        shared (and their memory analysis is never disk-cached)."""
         enc = self.encoded
         key_fn = getattr(enc, "cache_key", None)
         if key_fn is None:
-            return self._build_programs(n0)
-        cache_key = (
+            return None
+        return (
             type(self),
             self._cache_extras(),
             type(enc),
@@ -924,9 +1011,155 @@ class TpuBfsChecker(Checker):
                 for p in self.model.properties()
             ),
         )
+
+    def _lookup_programs(self, n0: int):
+        """Build-or-fetch the compiled device programs (cache key:
+        :meth:`_program_cache_key`). The per-class build info the
+        memory ledger reads (``_build_info`` — ladder-class staging
+        shapes, CHUNKED-mode records) rides the cache entry: a
+        cache-hit checker instance never ran ``_build_programs``, but
+        its plan must still be a function of the ladder classes."""
+        _enable_persistent_cache()
+        cache_key = self._program_cache_key(n0)
+        if cache_key is None:
+            return self._build_programs(n0)
         if cache_key not in _CHUNK_CACHE:
-            _CHUNK_CACHE[cache_key] = self._build_programs(n0)
-        return _CHUNK_CACHE[cache_key]
+            programs = self._build_programs(n0)
+            _CHUNK_CACHE[cache_key] = (
+                programs, getattr(self, "_build_info", None)
+            )
+        programs, self._build_info = _CHUNK_CACHE[cache_key]
+        return programs
+
+    # -- memory observability (stateright_tpu/memplan.py) ------------------
+
+    def _plan_sharded_names(self) -> tuple:
+        """Carry leaves split across the mesh (their ledger rows get
+        ``per_shard_bytes = bytes / n_shards``); single-chip engines
+        shard nothing."""
+        return ()
+
+    def _memory_plan(self, n0: int, with_compiled: bool = False) -> dict:
+        """The resident-buffer ledger: every chunk-carry buffer this
+        engine keeps device-resident between syncs, derived from the
+        seed program's output spec via ``jax.eval_shape`` (no
+        allocation, no compile — the declaration cannot drift from
+        the allocation, which the plan-vs-``nbytes`` test pins on
+        real arrays), plus the per-ladder-class staging ledger
+        recorded at program build (``_build_info``) and — when
+        ``with_compiled`` — XLA's own ``memory_analysis()`` of the
+        chunk program (null where the backend doesn't report it)."""
+        import jax
+        import jax.numpy as jnp
+
+        from .. import memplan
+
+        seed_fn, chunk_fn = self._programs
+        spec = jax.eval_shape(
+            seed_fn,
+            jax.ShapeDtypeStruct((n0, self.encoded.width), jnp.uint32),
+        )
+        n_shards = getattr(self, "n_shards", 1)
+        resident = memplan.plan_entries(
+            spec, sharded=self._plan_sharded_names(), n_shards=n_shards
+        )
+        info = getattr(self, "_build_info", None) or {}
+        classes = info.get("classes", [])
+        v_classes = info.get("v_classes", [])
+        # Class staging shapes are PER DEVICE (the shard_map body's
+        # view on mesh engines; the whole device on single-chip) —
+        # the global peak multiplies by the mesh width.
+        class_peak = n_shards * max(
+            (c.get("staging_bytes", 0) for c in classes), default=0
+        )
+        merge_peak = n_shards * max(
+            (v.get("merge_scratch_bytes", 0) for v in v_classes),
+            default=0,
+        )
+        compiled = None
+        if with_compiled:
+            token = self._program_cache_key(n0)
+            if token is None:
+                try:
+                    compiled = memplan.compiled_memory(
+                        chunk_fn.lower(spec).compile()
+                    )
+                except Exception:
+                    compiled = None
+            else:
+                compiled = memplan.compiled_memory_analysis(
+                    chunk_fn, spec, token
+                )
+        resident_bytes = memplan.plan_total(resident)
+        return dict(
+            engine=type(self).__name__,
+            n_shards=n_shards,
+            resident=resident,
+            resident_bytes=resident_bytes,
+            classes=classes,
+            v_classes=v_classes,
+            class_peak_bytes=int(class_peak + merge_peak),
+            compiled=compiled,
+            total_bytes=int(resident_bytes + class_peak + merge_peak),
+        )
+
+    def _emit_memory_watermark(self, tracer, peak, source,
+                               polls) -> None:
+        """The run-end watermark event: device peak bytes (from the
+        per-chunk polls), visited/budget headroom, and the capacity
+        projection — the numbers the tiered-visited-set and
+        HBM-staging decisions (ROADMAP directions 1b/2b) read."""
+        tracer.event(
+            "memory_watermark",
+            source=source,
+            device_peak_bytes=(None if peak is None else int(peak)),
+            polls=int(polls),
+            headroom=self._memory_headroom(),
+            projection=self._memory_projection(),
+        )
+
+    def _visited_bytes_per_row(self) -> int:
+        """Logical device bytes per visited entry: two uint32 key-limb
+        lanes, plus the parent-forest lanes when paths are tracked."""
+        return 8 + (8 if self.track_paths else 0)
+
+    def _memory_headroom(self) -> dict:
+        """Host-side visited/budget byte accounting for the watermark:
+        observed unique rows vs capacity, priced in bytes, plus the
+        persisted auto-budget join on engines that have one."""
+        bpr = self._visited_bytes_per_row()
+        cap = self.total_capacity
+        u = self._unique_states
+        return dict(
+            visited_rows=int(u),
+            visited_capacity=int(cap),
+            occupancy=(round(u / cap, 4) if cap else None),
+            bytes_per_row=bpr,
+            visited_used_bytes=int(u * bpr),
+            visited_capacity_bytes=int(cap * bpr),
+            budget=self._budget_headroom(),
+        )
+
+    def _budget_headroom(self):
+        """Joined from the persisted auto-budget store on engines
+        that keep one (the sort-merge ``cand_capacity="auto"`` path);
+        None elsewhere."""
+        return None
+
+    def _memory_projection(self) -> dict:
+        """Predicted bytes at the next capacity step. The hash-table
+        engine has no ladder: open addressing degrades past probe
+        pressure and the remedy is doubling, so the projection prices
+        capacity x2. (The sort-merge engines override this with the
+        next-visited-ladder-class prediction — the number that
+        decides when V stops fitting VMEM.)"""
+        bpr = self._visited_bytes_per_row()
+        nxt = 2 * self.total_capacity
+        return dict(
+            kind="capacity_x2",
+            next_rows=int(nxt),
+            next_visited_bytes=int(nxt * bpr),
+        )
 
     def _consume_extra_stats(self, extra: np.ndarray) -> None:
         """Hook for engine variants that append metric lanes after the
@@ -980,6 +1213,10 @@ class TpuBfsChecker(Checker):
             cand_capacity=self.cand_capacity,
             waves_per_sync=self.waves_per_sync,
             track_paths=self.track_paths,
+            # per-entry visited cost (the memory ledger's number):
+            # what telemetry.shard_balance prices occupancy warnings
+            # with, the way dest_tile_lanes prices routed bytes
+            visited_row_bytes=self._visited_bytes_per_row(),
         )
         return lane
 
@@ -1009,6 +1246,9 @@ class TpuBfsChecker(Checker):
             occupancy,
             used=self._unique_states,
             capacity=self.total_capacity,
+            # the ledger's per-entry cost (round 12): the warning
+            # prices the fill in bytes, not just rows
+            bytes_per_row=self._visited_bytes_per_row(),
         )
         if msg is not None:
             import warnings

@@ -719,8 +719,11 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
 
     def _reset_for_retry(self) -> None:
         """Discard one failed attempt's partial results so the resized
-        re-run starts clean (programs rebuild at the new shapes)."""
+        re-run starts clean (programs rebuild at the new shapes).
+        The memory ledger re-derives with them — a resized budget is
+        a different class ladder."""
         self._programs = None
+        self.memory_plan = None
         self._discovered_fps.clear()
         self._discoveries.clear()
         self._total_states = 0
@@ -803,6 +806,63 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         IS watched, by the trace-side metric: telemetry.shard_balance
         reuses the shared formatter in stateright_tpu/occupancy.py
         with the exact-capacity HEADROOM_THRESHOLD.)"""
+
+    # -- memory observability (stateright_tpu/memplan.py) ------------------
+
+    def _visited_bytes_per_row(self) -> int:
+        # vkeys: two uint32 key limbs; plog appends 4 uint32 lanes
+        # (parent + child limbs) per unique state when paths are on.
+        return 8 + (16 if self.track_paths else 0)
+
+    def _budget_headroom(self):
+        """The observed-peak-vs-budget join the watermark carries:
+        the same persisted store the auto-budget sizes from, so the
+        headroom the event reports is the headroom the next process's
+        budget decision reads."""
+        if not self.auto_budget:
+            return None
+        peak = self.metrics.get("max_wave_candidates")
+        if not peak:
+            saved = self._load_budget() or {}
+            peak = saved.get("observed_peak")
+        cap = self.cand_capacity
+        return dict(
+            cand_capacity=cap,
+            observed_peak=(int(peak) if peak else None),
+            headroom_ratio=(round(cap / peak, 4)
+                            if cap and peak else None),
+        )
+
+    def _memory_projection(self) -> dict:
+        """Predicted resident bytes at the NEXT visited ladder class
+        — the number that decides when V stops fitting VMEM (ROADMAP
+        direction 2b) and when the visited set must tier to host DRAM
+        (direction 1b). Past the ladder top the projection prices the
+        next capacity step (``capacity * v_ladder_step``) instead: the
+        cost of the next-size workload."""
+        v_ladder = _ladder(self.v_min, self.capacity,
+                           self.v_ladder_step)
+        shards = getattr(self, "n_shards", 1)
+        u_shard = -(-max(self._unique_states, 1) // shards)
+        # the class the engine dispatched at the end of the run (the
+        # same u > V_i counting the wave body's ladder switch uses),
+        # and the step PAST it — past the ladder top, the next
+        # capacity a bigger workload would need
+        idx = sum(1 for V_i in v_ladder[:-1] if u_shard > V_i)
+        cur = v_ladder[idx]
+        nxt = (v_ladder[idx + 1] if idx + 1 < len(v_ladder)
+               else self.capacity * self.v_ladder_step)
+        F = self.frontier_capacity
+        return dict(
+            kind="next_v_class",
+            current_rows=int(cur),
+            next_rows=int(nxt),
+            # vkeys [2, V + F]: the resident block the class keeps
+            next_vkeys_bytes=int((nxt + F) * 8),
+            # the streaming merge reads [0, V) and writes the merged
+            # [0, V + NF) block back: the class-local scratch
+            next_merge_scratch_bytes=int((nxt + F) * 8),
+        )
 
     def _cand_overflow_message(self) -> str:
         if self._use_sparse():
@@ -1516,7 +1576,12 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         else:
             sparse_has_trunc = False
 
-        def make_sparse_wave(fc: int, v_class):
+        def sparse_class_params(fc: int) -> dict:
+            """Static per-frontier-class shapes of the sparse wave —
+            ONE home shared by ``make_sparse_wave`` and the memory
+            ledger's per-class staging rows (``_build_info``), so the
+            plan the ``memory_plan`` event declares cannot drift from
+            the classes the wave programs compile."""
             F_f = f_ladder[fc]
             EV = self._pair_width()
             NPg = F_f * EV
@@ -1537,7 +1602,6 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             NT = _divisor_at_least(F_f, want_tiles) if compaction else 1
             T = F_f // NT
             Ba = (B_p + T * EV) if compaction else NPg
-            L = mask_words(K)
             # Memory-lean mode: when the [Ba, W] successor tensor would
             # blow the flat budget (paxos check 4: 28M pairs × 19 lanes
             # ≈ 2GB at merge-time peak), fingerprint pairs in chunks
@@ -1553,6 +1617,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             chunked = compaction and (
                 Ba * row_pad > self.flat_budget_bytes
             )
+            NC = Bc = 0
             if chunked:
                 NC = -(-(Ba * row_pad) // self.flat_budget_bytes)
                 Bc = -(-Ba // NC)
@@ -1572,6 +1637,19 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             pay_fetch = (not chunked) and (
                 Ba * pay_row_pad <= self.flat_budget_bytes
             )
+            return dict(
+                F_f=F_f, EV=EV, NPg=NPg, B_p=B_p,
+                compaction=compaction, NT=NT, T=T, Ba=Ba,
+                row_pad=row_pad, chunked=chunked, NC=NC, Bc=Bc,
+                pay_fetch=pay_fetch,
+            )
+
+        def make_sparse_wave(fc: int, v_class):
+            p = sparse_class_params(fc)
+            F_f, EV, B_p = p["F_f"], p["EV"], p["B_p"]
+            NT, T, Ba = p["NT"], p["T"], p["Ba"]
+            chunked, pay_fetch = p["chunked"], p["pay_fetch"]
+            NC, Bc = p["NC"], p["Bc"]
 
             def wave(c):
                 if target_depth is None:
@@ -1867,6 +1945,90 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         # switch branches for the no-branch-pad-concat rule and the
         # carry-copy-bytes estimator, never allocating buffers).
         self._wave_body = body
+
+        # Memory ledger (stateright_tpu/memplan.py): per-ladder-class
+        # staging rows, recorded AT BUILD so the memory_plan event is
+        # a function of the (f, v) class — the shapes come from the
+        # SAME class_params/sparse_class_params the wave programs
+        # compile from. CHUNKED memory-lean classes additionally land
+        # an ``engine_mode`` record (emitted as a telemetry event at
+        # run time): until round 12 that flip was observable only as
+        # a docstring behavior.
+        from ..memplan import buffer_entry, plan_total
+
+        EPw = payload_width(W, track_paths)
+        _classes = []
+        _modes = []
+        for fc in range(len(f_ladder)):
+            if use_sparse:
+                p = sparse_class_params(fc)
+                staging = [
+                    buffer_entry("enabled_bits",
+                                 (p["F_f"], mask_words(K)), "uint32"),
+                    buffer_entry("pair_index", (3, p["Ba"]), "uint32"),
+                    buffer_entry("cand_keys", (2, p["Ba"]), "uint32"),
+                ]
+                if p["chunked"]:
+                    mode = "chunked"
+                    staging.append(
+                        buffer_entry("succ_chunk", (W, p["Bc"]),
+                                     "uint32")
+                    )
+                    _modes.append(dict(
+                        engine=type(self).__name__, mode="chunked",
+                        f_class=fc, buffer_rows=p["Ba"],
+                        chunks=p["NC"], chunk_rows=p["Bc"],
+                        row_pad_bytes=p["row_pad"],
+                        flat_budget_bytes=self.flat_budget_bytes,
+                    ))
+                elif p["pay_fetch"]:
+                    mode = "pay_fetch"
+                    staging.append(
+                        buffer_entry("cand_payload", (p["Ba"], EPw),
+                                     "uint32")
+                    )
+                else:
+                    mode = "recompute"
+                    staging.append(
+                        buffer_entry("succ_t", (W, p["Ba"]), "uint32")
+                    )
+                _classes.append(dict(
+                    f_class=fc, mode=mode, frontier_rows=p["F_f"],
+                    pair_width=p["EV"], budget_rows=p["B_p"],
+                    tiles=p["NT"], buffer_rows=p["Ba"],
+                    staging=staging, staging_bytes=plan_total(staging),
+                ))
+            else:
+                (F_f, FK, NT_d, _T, _Bt, B_eff, Ba_d, B_class,
+                 _compaction, full_flat) = class_params(fc)
+                if full_flat:
+                    mode = "full_flat"
+                    rows = Ba_d
+                    staging = [
+                        buffer_entry("succ_flat", (FK, W), "uint32"),
+                        buffer_entry("cand_keys", (3, rows), "uint32"),
+                    ]
+                else:
+                    mode = "tile_payload"
+                    rows = B_eff
+                    staging = [
+                        buffer_entry("cand_keys", (2, rows), "uint32"),
+                        buffer_entry("cand_payload", (rows, EPw),
+                                     "uint32"),
+                    ]
+                _classes.append(dict(
+                    f_class=fc, mode=mode, frontier_rows=F_f,
+                    budget_rows=B_class, tiles=NT_d, buffer_rows=rows,
+                    staging=staging, staging_bytes=plan_total(staging),
+                ))
+        from ..memplan import v_class_entries
+
+        _NFmax = min(F, max(c["buffer_rows"] for c in _classes))
+        self._build_info = dict(
+            classes=_classes,
+            v_classes=v_class_entries(v_ladder, _NFmax),
+            engine_modes=_modes,
+        )
 
         def chunk(carry):
             c = dict(carry, wchunk=jnp.int32(0))

@@ -43,6 +43,7 @@ def occupancy_warning(
     threshold: float = PROBE_PRESSURE_THRESHOLD,
     used: Optional[int] = None,
     capacity: Optional[int] = None,
+    bytes_per_row: Optional[int] = None,
     consequence: str = (
         "probe failures become likely past ~85% — consider a larger "
         "capacity"
@@ -50,8 +51,11 @@ def occupancy_warning(
 ) -> Optional[str]:
     """The shared warning line, or None while ``occupancy`` is at or
     under ``threshold``. ``used``/``capacity`` add the absolute
-    counts; ``consequence`` names what breaks and which knob fixes
-    it."""
+    counts; ``bytes_per_row`` (the resident-buffer ledger's per-entry
+    cost, round 12) additionally prices them — the warning then says
+    what the fill *weighs* and what the full buffer would, so the
+    capacity decision is a memory decision, not just a row count;
+    ``consequence`` names what breaks and which knob fixes it."""
     if occupancy <= threshold:
         return None
     detail = (
@@ -59,4 +63,14 @@ def occupancy_warning(
         if used is not None and capacity is not None
         else ""
     )
+    if (bytes_per_row is not None and used is not None
+            and capacity is not None):
+        # ONE byte formatter repo-wide (memplan.format_bytes — the
+        # same rendering mem_report uses; numpy-only, still no jax)
+        from .memplan import format_bytes
+
+        detail += (
+            f" [{format_bytes(used * bytes_per_row)} of "
+            f"{format_bytes(capacity * bytes_per_row)}]"
+        )
     return f"{kind} {occupancy:.0%} full{detail}; {consequence}"

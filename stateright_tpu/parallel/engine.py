@@ -154,6 +154,13 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         # single-chip dense convention) and back-fills the wave event.
         return False
 
+    def _plan_sharded_names(self) -> tuple:
+        # Mirrors the shard_map out_specs below: these carry leaves
+        # are split across the mesh, so their ledger rows report
+        # per_shard_bytes = bytes / n_shards (memplan.plan_entries).
+        return ("t_lo", "t_hi", "p_lo_t", "p_hi_t", "frontier",
+                "fval", "ebits", "slog", "u_loc")
+
     def _lane_config(self) -> dict:
         lane = super()._lane_config()
         lane.update(
@@ -525,6 +532,28 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
 
         def cond(c):
             return ~c["done"] & (c["wchunk"] < waves_per_sync)
+
+        # Memory ledger (memplan.py): no ladder here either — one
+        # fixed-shape class per shard; the routed send/recv tiles are
+        # the staging this engine adds over the single-chip one.
+        from ..memplan import buffer_entry, plan_total
+
+        _staging = [
+            buffer_entry("cand_payload", (F * K, E), "uint32"),
+            buffer_entry("cand_compact", (B, E2), "uint32"),
+            buffer_entry("send_tiles", (S * Bd, E2), "uint32"),
+            buffer_entry("recv_tiles", (S * Bd, E2), "uint32"),
+        ]
+        self._build_info = dict(
+            classes=[dict(
+                f_class=0, v_class=0, mode="hash-sharded",
+                frontier_rows=F, visited_rows=capacity,
+                dest_cap=Bd, staging=_staging,
+                staging_bytes=plan_total(_staging),
+            )],
+            v_classes=[],
+            engine_modes=[],
+        )
 
         def chunk(carry):
             from jax import lax as _lax

@@ -206,6 +206,13 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
         # instead of recording enabled_pairs=null.
         return False
 
+    def _plan_sharded_names(self) -> tuple:
+        # Mirrors the shard_map out_specs in _build_programs: these
+        # carry leaves shard along their row axis, so their ledger
+        # rows report per_shard_bytes = bytes / n_shards.
+        return ("vkeys", "plog", "pl_n", "frontier", "fval", "ebits",
+                "n_loc", "u_loc", "slog", "swave")
+
     def _lane_config(self) -> dict:
         lane = super()._lane_config()
         lane.update(
@@ -1089,6 +1096,76 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                 )
 
             return wave
+
+        # Memory ledger (stateright_tpu/memplan.py): per-ladder-class
+        # staging rows, PER SHARD (the shard_map body's view), from
+        # the same class_params the wave programs compile from. The
+        # chunked memory-lean gate mirrors make_wave's (R_src rows at
+        # the padded ~512 B/row cost vs the flat budget); chunked
+        # classes land an ``engine_mode`` record like the single-chip
+        # engine's.
+        from ..memplan import buffer_entry, plan_total
+
+        from ..ops.bitmask import mask_words as _mask_words
+
+        _row_pad = -(-W // 128) * 512
+        _classes = []
+        _modes = []
+        for fc in range(len(f_ladder)):
+            F_c, NT_c, _T_c, R_src, B_c, Bd_c = class_params(fc)
+            staging = [
+                buffer_entry("cand_keys", (2, R_src), "uint32"),
+                buffer_entry("send_tiles", (S * Bd_c, E + 2),
+                             "uint32"),
+                buffer_entry("recv_tiles", (S * Bd_c, E + 2),
+                             "uint32"),
+            ]
+            chunked_c = False
+            if use_sparse:
+                staging.insert(0, buffer_entry(
+                    "enabled_bits", (F_c, _mask_words(K)), "uint32"
+                ))
+                staging.insert(1, buffer_entry(
+                    "pair_index", (3, R_src), "uint32"
+                ))
+                chunked_c = R_src * _row_pad > self.flat_budget_bytes
+                if chunked_c:
+                    NC_c = -(-(R_src * _row_pad)
+                             // self.flat_budget_bytes)
+                    Bc_c = -(-R_src // NC_c)
+                    staging.append(buffer_entry(
+                        "succ_chunk", (W, Bc_c), "uint32"
+                    ))
+                    _modes.append(dict(
+                        engine=type(self).__name__, mode="chunked",
+                        f_class=fc, buffer_rows=R_src, chunks=NC_c,
+                        chunk_rows=Bc_c, row_pad_bytes=_row_pad,
+                        flat_budget_bytes=self.flat_budget_bytes,
+                    ))
+                else:
+                    staging.append(buffer_entry(
+                        "succ_t", (W, R_src), "uint32"
+                    ))
+            else:
+                staging.insert(0, buffer_entry(
+                    "succ_flat", (F_c * K, W), "uint32"
+                ))
+            _classes.append(dict(
+                f_class=fc,
+                mode=("chunked" if chunked_c
+                      else "sparse" if use_sparse else "dense"),
+                frontier_rows=F_c, budget_rows=B_c, tiles=NT_c,
+                buffer_rows=R_src, dest_cap=Bd_c,
+                staging=staging, staging_bytes=plan_total(staging),
+            ))
+        from ..memplan import v_class_entries
+
+        _NFmax = min(F, max(c["buffer_rows"] for c in _classes))
+        self._build_info = dict(
+            classes=_classes,
+            v_classes=v_class_entries(v_ladder, _NFmax),
+            engine_modes=_modes,
+        )
 
         def body(c):
             n_max = lax.pmax(c["n_loc"][0], "shard")

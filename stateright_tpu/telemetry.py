@@ -40,6 +40,17 @@ on — PAPERS.md: arXiv 1801.05857, 1203.6806):
   the context-manager API (:func:`span` / :meth:`RunTracer.phase_acc`)
   used by checker.py and the host checkers. When no tracer is active
   every hook is a no-op.
+* **Memory events** (round 12, the memory observability layer —
+  stateright_tpu/memplan.py): one schema-validated ``memory_plan``
+  event per run (the resident-buffer ledger + per-ladder-class
+  staging + ``Compiled.memory_analysis()``), per-chunk device
+  bytes-in-use on the ``chunk`` event (polled at the existing sync —
+  no new syncs), and a ``memory_watermark`` event at run end (peak,
+  host visited bytes, budget-store headroom, and the capacity
+  projection). :func:`memory_summary` derives the report
+  tools/mem_report.py renders; :func:`diff_traces` aligns the plan
+  exactly and the measured temp/live bytes under the relative
+  threshold.
 * **Exporters** — JSONL (``TRACE_r*.jsonl``, auto-numbered beside the
   BENCH/LINT artifacts via :mod:`stateright_tpu.artifacts`) and
   Chrome-trace/Perfetto JSON (``TRACE_r*.trace.json``), plus the
@@ -299,6 +310,7 @@ class RunTracer:
         wave_rows=None,
         pairs_valid: bool = True,
         shard_rows=None,
+        mem_bytes: int | None = None,
     ) -> None:
         """One chunk sync: the host wall split plus the downloaded
         device wave-log rows (``wave_rows``: int array
@@ -312,7 +324,10 @@ class RunTracer:
         ``enabled_pairs`` is back-filled from the shard sum, closing
         the sharded ``enabled_pairs=null`` hole. ``t0``/``t1`` are
         absolute ``time.monotonic()`` stamps bracketing
-        dispatch→fetch."""
+        dispatch→fetch. ``mem_bytes`` is the device bytes-in-use the
+        engine polled at this sync (memplan.device_bytes_in_use —
+        OPTIONAL so pre-round-12 traces stay valid; None means not
+        polled)."""
         rt0 = t0 - self._t_base
         rt1 = t1 - self._t_base
         if wave_rows is not None and n_waves is None:
@@ -326,6 +341,8 @@ class RunTracer:
                 device_sec=(None if device_sec is None
                             else round(device_sec, 6)),
                 fetch_sec=round(fetch_sec, 6),
+                **({"mem_bytes": int(mem_bytes)}
+                   if mem_bytes is not None else {}),
             )
         )
         if wave_rows is None or n_waves is None or n_waves == 0:
@@ -446,6 +463,14 @@ class RunTracer:
                                ("run", "waves", "dispatch_sec",
                                 "device_sec", "fetch_sec")})
                 )
+                if ev.get("mem_bytes") is not None:
+                    # memory watermark as a counter track: the
+                    # bytes-in-use curve next to the frontier curve
+                    out.append(
+                        dict(ph="C", pid=0, name="mem_bytes",
+                             ts=us(ev["t1"]),
+                             args=dict(bytes=ev["mem_bytes"]))
+                    )
             elif kind == "wave":
                 args = {k: ev[k] for k in WAVE_LOG_FIELDS}
                 args["t_est"] = ev["t_est"]
@@ -520,6 +545,14 @@ _REQUIRED = {
     + WAVE_LOG_FIELDS,
     "shard_wave": ("run", "wave", "chunk", "shard")
     + SHARD_LOG_FIELDS,
+    # The memory observability layer (round 12, memplan.py). ``chunk``
+    # events gained an OPTIONAL ``mem_bytes`` lane (not listed above:
+    # pre-round-12 traces must stay valid); these two are whole new
+    # event types, so their contracts are required outright.
+    "memory_plan": ("run", "engine", "resident", "resident_bytes",
+                    "classes", "compiled", "total_bytes"),
+    "memory_watermark": ("run", "source", "device_peak_bytes",
+                         "headroom", "projection"),
 }
 
 
@@ -616,6 +649,16 @@ def validate_events(events: list[dict]) -> None:
                 )
             last_visited[key] = ev["visited_total"]
             last_shard_wave[key] = ev["wave"]
+        elif kind == "memory_plan":
+            # the ledger's own sum must hold: a plan whose total
+            # disagrees with its rows is a hand-edited artifact
+            tot = sum(int(e["bytes"]) for e in ev["resident"])
+            if int(ev["resident_bytes"]) != tot:
+                raise ValueError(
+                    f"event {i}: memory_plan resident_bytes "
+                    f"{ev['resident_bytes']} != sum of resident "
+                    f"entry bytes {tot}"
+                )
 
 
 def _runs(events: list[dict]) -> list[int]:
@@ -625,7 +668,8 @@ def _runs(events: list[dict]) -> list[int]:
 def _run_view(events: list[dict], run: int) -> dict:
     view: dict = dict(run=run, begin=None, end=None, waves=[],
                       chunks=[], spans=[], phase_totals={},
-                      shard_waves={})
+                      shard_waves={}, memory_plan=None,
+                      memory_watermark=None)
     for ev in events:
         if ev.get("run") != run:
             continue
@@ -634,6 +678,8 @@ def _run_view(events: list[dict], run: int) -> dict:
             view["begin"] = ev
         elif kind == "run_end":
             view["end"] = ev
+        elif kind in ("memory_plan", "memory_watermark"):
+            view[kind] = ev  # one per run; last occurrence wins
         elif kind == "wave":
             view["waves"].append(ev)
         elif kind == "shard_wave":
@@ -728,6 +774,10 @@ def shard_balance(events: list[dict], run: int | None = None,
     lane = (view["begin"] or {}).get("lane") or {}
     tile_lanes = lane.get("dest_tile_lanes")
     per_shard_capacity = lane.get("capacity")
+    # per-entry byte costs from the lane config (the memory ledger's
+    # numbers, round 12): headroom warnings price the fill in bytes
+    visited_row_bytes = lane.get("visited_row_bytes")
+    tile_row_bytes = int(tile_lanes) * 4 if tile_lanes else None
     # Visited-set semantics come from the lane config: the sort-merge
     # engines' sorted arrays work to exactly 100% (headroom watch),
     # the hash engine's open addressing degrades from ~70% (probe
@@ -809,6 +859,7 @@ def shard_balance(events: list[dict], run: int | None = None,
             threshold=HEADROOM_THRESHOLD,
             used=worst_fill[1],
             capacity=worst_fill[2],
+            bytes_per_row=tile_row_bytes,
             consequence=(
                 "a destination run past the lossless Bd cap trips "
                 "c_overflow — raise bucket_capacity before the next "
@@ -840,6 +891,7 @@ def shard_balance(events: list[dict], run: int | None = None,
                 threshold=occ_threshold,
                 used=final_visited[s],
                 capacity=per_shard_capacity,
+                bytes_per_row=visited_row_bytes,
                 consequence=occ_consequence,
             )
             if msg:
@@ -875,6 +927,59 @@ def shard_balance(events: list[dict], run: int | None = None,
         occupancy_max=occ_max,
         warnings=warnings,
         per_wave=per_wave,
+    )
+
+
+# -- memory observability: the derived plan/watermark summary -------------
+
+
+def _strip_ev(ev: Optional[dict]) -> Optional[dict]:
+    if ev is None:
+        return None
+    return {k: v for k, v in ev.items()
+            if k not in ("ev", "run", "t")}
+
+
+def memory_summary(events: list[dict], run: int | None = None,
+                   ) -> Optional[dict]:
+    """Derive one run's memory view from its ``memory_plan`` /
+    ``memory_watermark`` events and the per-chunk ``mem_bytes`` lane —
+    the data behind tools/mem_report.py and the ``MEM_r*.json``
+    artifacts (memplan.write_memory_artifact). Returns None when the
+    run carries no memory events (a pre-round-12 trace, or an engine
+    without the ledger) — mem_report exits 2 on that.
+
+    ``run`` defaults to the LAST run in the event stream (bench/CLI
+    trace warm-run-last, so the default view is the warm one)."""
+    runs = _runs(events)
+    if not runs:
+        return None
+    view = _run_view(events, runs[-1] if run is None else run)
+    plan = view["memory_plan"]
+    wm = view["memory_watermark"]
+    chunk_mem = [
+        dict(chunk=c["chunk"], bytes=c["mem_bytes"])
+        for c in view["chunks"] if c.get("mem_bytes") is not None
+    ]
+    if plan is None and wm is None and not chunk_mem:
+        return None
+    lane = (view["begin"] or {}).get("lane") or {}
+    modes = [
+        _strip_ev(ev) for ev in events
+        if ev.get("ev") == "engine_mode" and ev.get("run") == view["run"]
+    ]
+    return dict(
+        run=view["run"],
+        engine=(plan or {}).get("engine") or lane.get("engine"),
+        lane={k: lane[k] for k in
+              ("engine", "model", "encoding", "capacity",
+               "frontier_capacity", "cand_capacity", "n_shards",
+               "track_paths", "merge_impl")
+              if k in lane},
+        plan=_strip_ev(plan),
+        watermark=_strip_ev(wm),
+        chunk_mem=chunk_mem,
+        engine_modes=modes,
     )
 
 
@@ -945,6 +1050,98 @@ def _shard_divergences(va: dict, vb: dict) -> list[dict]:
     return out
 
 
+#: measured-byte lanes smaller than this on the A side are ignored by
+#: the memory regression check (the byte analog of ``min_sec``: a
+#: few-KB toy trace's live-array noise is not a regression signal).
+MEM_DIFF_MIN_BYTES = 1 << 20
+
+
+def _memory_diff(va: dict, vb: dict, threshold: float) -> dict:
+    """Memory-counter alignment between two runs (the round-12 layer):
+    the PLAN is config — resident shapes/dtypes/bytes and the
+    per-class staging ledger must match EXACTLY (a changed resident
+    layout is a different engine, not a perf delta) — while MEASURED
+    bytes (compiled temp bytes, the live watermark peak) are compared
+    RELATIVE under ``threshold``, so jax-version allocator skew
+    doesn't false-positive a counter gate. Byte lanes compare only
+    when both sides measured them the same way (equal ``source`` /
+    both reported) — and a side with NO memory events at all (a
+    pre-round-12 baseline trace) is simply not comparable on this
+    axis: the diff skips it rather than failing the gate, so chip
+    A/Bs against committed pre-round-12 baselines keep working."""
+    divs: list[dict] = []
+    lanes: dict = {}
+    regressions: list[str] = []
+    pa, pb = va["memory_plan"], vb["memory_plan"]
+    if pa is not None and pb is not None:
+        ra = {e["name"]: (tuple(e["shape"]), e["dtype"], e["bytes"])
+              for e in pa["resident"]}
+        rb = {e["name"]: (tuple(e["shape"]), e["dtype"], e["bytes"])
+              for e in pb["resident"]}
+        for name in sorted(set(ra) | set(rb)):
+            if ra.get(name) != rb.get(name):
+                divs.append(dict(
+                    field="memory_plan", name=name,
+                    a=("/".join(map(str, ra[name]))
+                       if name in ra else None),
+                    b=("/".join(map(str, rb[name]))
+                       if name in rb else None),
+                ))
+        if len(pa["classes"]) != len(pb["classes"]):
+            divs.append(dict(field="memory_plan_classes",
+                             name="ladder depth",
+                             a=len(pa["classes"]),
+                             b=len(pb["classes"])))
+        else:
+            # name the class AND the field that moved — bare
+            # 'A=5 B=5' class counts would be unactionable
+            for i, (ca_c, cb_c) in enumerate(zip(pa["classes"],
+                                                 pb["classes"])):
+                if ca_c == cb_c:
+                    continue
+                keys = sorted(
+                    k for k in set(ca_c) | set(cb_c)
+                    if k != "staging" and ca_c.get(k) != cb_c.get(k)
+                ) or ["staging"]
+                for k in keys:
+                    divs.append(dict(
+                        field="memory_plan_classes",
+                        name=f"class {i}.{k}",
+                        a=(len(ca_c.get("staging", []))
+                           if k == "staging" else ca_c.get(k)),
+                        b=(len(cb_c.get("staging", []))
+                           if k == "staging" else cb_c.get(k)),
+                    ))
+
+    def byte_lane(name, a, b):
+        if a is None or b is None:
+            return
+        rel = (b - a) / a if a > 0 else (
+            float("inf") if b > 0 else 0.0
+        )
+        lanes[name] = dict(
+            a=int(a), b=int(b), delta=int(b - a),
+            rel=round(rel, 4) if rel != float("inf") else None,
+        )
+        if a >= MEM_DIFF_MIN_BYTES and rel > threshold:
+            regressions.append(name)
+
+    if pa is not None and pb is not None:
+        ca, cb = pa.get("compiled"), pb.get("compiled")
+        if ca is not None and cb is not None:
+            byte_lane("compiled_temp_bytes",
+                      ca.get("temp_size_in_bytes"),
+                      cb.get("temp_size_in_bytes"))
+    wa, wb = va["memory_watermark"], vb["memory_watermark"]
+    if (wa is not None and wb is not None
+            and wa.get("source") == wb.get("source")):
+        byte_lane("device_peak_bytes",
+                  wa.get("device_peak_bytes"),
+                  wb.get("device_peak_bytes"))
+    return dict(divergences=divs, bytes=lanes,
+                regressions=regressions)
+
+
 def diff_traces(
     a_events: list[dict],
     b_events: list[dict],
@@ -964,7 +1161,11 @@ def diff_traces(
       ``regressions`` — phases where B exceeds A by more than
         ``threshold`` (relative), ignoring phases under ``min_sec``
         on the A side (noise floor),
-      ``ok`` — True iff no divergence and no regression.
+      ``memory`` — the memory-counter alignment (:func:`_memory_diff`:
+        plan shapes exact, measured temp/live bytes under
+        ``threshold``),
+      ``ok`` — True iff no divergence and no regression (timing OR
+        memory).
 
     ``run_a``/``run_b`` default to the LAST run in each file (bench
     traces warm-run-last)."""
@@ -1006,15 +1207,19 @@ def diff_traces(
         if a >= min_sec and rel > threshold:
             regressions.append(phase)
 
+    memory = _memory_diff(va, vb, threshold)
     return dict(
         run_a=va["run"], run_b=vb["run"],
         waves_a=len(va["waves"]), waves_b=len(vb["waves"]),
         divergences=divergences,
         phases=phases,
         regressions=regressions,
+        memory=memory,
         threshold=threshold,
         min_sec=min_sec,
-        ok=not divergences and not regressions,
+        ok=(not divergences and not regressions
+            and not memory["divergences"]
+            and not memory["regressions"]),
     )
 
 
@@ -1051,10 +1256,32 @@ def format_diff(report: dict) -> str:
             f"{phase:28s} {p['a']:10.4f} {p['b']:10.4f} "
             f"{p['delta']:+10.4f} {rel:>8s}{flag}"
         )
+    mem = report.get("memory") or {}
+    if mem.get("divergences"):
+        lines.append(
+            f"MEMORY-PLAN DIVERGENCE ({len(mem['divergences'])} "
+            "mismatches) — the two runs declared different resident "
+            "layouts:"
+        )
+        for d in mem["divergences"][:10]:
+            lines.append(
+                f"  {d['field']:20s} {d.get('name', ''):14s} "
+                f"A={d['a']} B={d['b']}"
+            )
+    for name, p in (mem.get("bytes") or {}).items():
+        rel = "n/a" if p["rel"] is None else f"{p['rel']:+.1%}"
+        flag = ("  <-- REGRESSION"
+                if name in mem.get("regressions", ()) else "")
+        lines.append(
+            f"{name:28s} {p['a']:10d} {p['b']:10d} "
+            f"{p['delta']:+10d} {rel:>8s}{flag}"
+        )
+    mem_regs = mem.get("regressions") or []
     verdict = "OK" if report["ok"] else (
         "FAIL: wave divergence" if report["divergences"]
-        else f"FAIL: {len(report['regressions'])} phase(s) past "
-             f"+{report['threshold']:.0%}"
+        else "FAIL: memory-plan divergence" if mem.get("divergences")
+        else f"FAIL: {len(report['regressions']) + len(mem_regs)} "
+             f"lane(s) past +{report['threshold']:.0%}"
     )
     lines.append(f"verdict: {verdict}")
     return "\n".join(lines)

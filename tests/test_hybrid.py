@@ -57,6 +57,71 @@ def test_hybrid_does_not_mask_host_panic():
         )
 
 
+def test_hybrid_host_oom_emits_structured_event():
+    """Host-side MemoryError is the race being LOST, not a model
+    error: the device result is adopted with a warning — and, since
+    round 12, a STRUCTURED telemetry event (phase + message) so a
+    traced run records the outcome in the artifact, not only on
+    stderr (the memory-observability satellite)."""
+    import warnings
+
+    import pytest
+
+    from stateright_tpu.telemetry import RunTracer, validate_events
+
+    class OomIncrement(Increment):
+        def actions(self, state):
+            raise MemoryError("host trace tuples exhausted RAM")
+
+    tracer = RunTracer()
+    with tracer.activate():
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            hy = (
+                OomIncrement(thread_count=4)
+                .checker()
+                .spawn_hybrid(
+                    capacity=1 << 16,
+                    frontier_capacity=1 << 12,
+                    cand_capacity=1 << 14,
+                    track_paths=False,
+                )
+                .join()
+            )
+    assert hy.winner == "device"
+    assert any("ran out of memory" in str(x.message) for x in w)
+    validate_events(tracer.events)
+    evs = [e for e in tracer.events if e["ev"] == "hybrid_host_oom"]
+    assert len(evs) == 1
+    assert evs[0]["phase"] == "host_dfs"
+    assert "ran out of memory" in evs[0]["message"]
+    assert evs[0]["error"].startswith("MemoryError")
+
+    # The existing error path is unchanged: a non-OOM host raise is a
+    # model error and must still surface (no masking, no event).
+    class PanickingIncrement(Increment):
+        def actions(self, state):
+            raise RuntimeError("panic! (host-only model error)")
+
+    tracer2 = RunTracer()
+    with tracer2.activate():
+        with pytest.raises(RuntimeError,
+                           match="panic|refusing to mask"):
+            (
+                PanickingIncrement(thread_count=4)
+                .checker()
+                .spawn_hybrid(
+                    capacity=1 << 16,
+                    frontier_capacity=1 << 12,
+                    cand_capacity=1 << 14,
+                    track_paths=False,
+                )
+                .join()
+            )
+    assert not [e for e in tracer2.events
+                if e["ev"] == "hybrid_host_oom"]
+
+
 def test_hybrid_full_verification_matches():
     """Run-to-completion workload: whichever engine wins, the count is
     the pinned 8,832 and the property set matches the host oracle."""

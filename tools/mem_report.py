@@ -1,0 +1,261 @@
+#!/usr/bin/env python
+"""Memory report: the human-readable view of a run's memory telemetry.
+
+Reads a ``TRACE_r*.jsonl`` run-telemetry artifact whose run carries
+the round-12 memory events (``memory_plan`` / ``memory_watermark`` /
+per-chunk ``mem_bytes`` — any traced run of the device engines) and
+renders the capacity numbers ROADMAP directions 1b (tiered visited
+set) and 2b (HBM-staged merge) decide from:
+
+* **resident-buffer ledger** — every chunk-carry buffer the engine
+  keeps device-resident between syncs (frontier, vkeys, plog, ebits,
+  the wave/shard logs), with dtype/shape/bytes and per-shard splits
+  on mesh runs,
+* **per-ladder-class staging** — what each (f, v) class's wave
+  buffers cost, so the plan is a function of the class the adaptive
+  ladder dispatches, not just the peak (CHUNKED memory-lean classes
+  are flagged),
+* **compiled-program analysis** — XLA's own
+  ``Compiled.memory_analysis()`` of the wave program (temp/argument/
+  output/alias bytes; '-' where the backend doesn't report it),
+* **live watermarks** — the per-chunk device bytes-in-use trajectory
+  and the run peak, plus observed-vs-capacity headroom (joined from
+  the persisted auto-budget store) and the **capacity projection**:
+  predicted bytes at the next visited ladder class — the number that
+  decides when V stops fitting VMEM.
+
+The derived summary comes from ``telemetry.memory_summary`` (the same
+block bench lanes and the MULTICHIP dryrun embed), so this report and
+those artifacts cannot disagree. ``--json`` additionally writes an
+auto-numbered ``MEM_r*.json`` artifact (its own round sequence —
+``MEM_r01`` first — cross-referenced to the TRACE it was derived
+from; numbering via stateright_tpu/artifacts.py).
+
+Usage:
+  python tools/mem_report.py TRACE_r18.jsonl
+  python tools/mem_report.py TRACE_r18.jsonl --run 0
+  python tools/mem_report.py TRACE_r18.jsonl --json
+
+Exit status: 0 (report printed), 2 bad input / no memory events in
+the trace (a pre-round-12 artifact, or an untraced-engine run).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def format_report(summary: dict, max_chunks: int = 20) -> str:
+    from stateright_tpu.memplan import format_bytes as fb
+
+    lines = [
+        f"memory report: run #{summary['run']}, "
+        f"engine {summary['engine']}",
+    ]
+    lane = summary.get("lane") or {}
+    if lane:
+        lines.append(
+            "lane: " + ", ".join(
+                f"{k}={lane[k]}" for k in sorted(lane)
+            )
+        )
+    plan = summary.get("plan")
+    if plan:
+        lines.append("")
+        lines.append(
+            f"resident-buffer ledger ({plan['n_shards']} shard(s), "
+            f"total {fb(plan['resident_bytes'])}):"
+        )
+        lines.append(
+            f"  {'buffer':14s} {'shape':>18s} {'dtype':>8s} "
+            f"{'bytes':>14s}" + (
+                f" {'per-shard':>12s}" if plan["n_shards"] > 1 else ""
+            )
+        )
+        for e in plan["resident"]:
+            shape = "x".join(map(str, e["shape"])) or "scalar"
+            row = (
+                f"  {e['name']:14s} {shape:>18s} {e['dtype']:>8s} "
+                f"{e['bytes']:>14,d}"
+            )
+            if plan["n_shards"] > 1:
+                row += f" {e.get('per_shard_bytes', e['bytes']):>12,d}"
+            lines.append(row)
+        if plan.get("classes"):
+            lines.append("")
+            lines.append("per-ladder-class staging (per shard):")
+            lines.append(
+                f"  {'f':>2s} {'mode':12s} {'frontier':>9s} "
+                f"{'buffer':>9s} {'tiles':>6s} {'bytes':>14s}"
+            )
+            for c in plan["classes"]:
+                lines.append(
+                    f"  {c['f_class']:2d} {c['mode']:12s} "
+                    f"{c['frontier_rows']:9,d} "
+                    f"{c.get('buffer_rows', 0):9,d} "
+                    f"{c.get('tiles', 1):6d} "
+                    f"{c['staging_bytes']:>14,d}"
+                )
+        if plan.get("v_classes"):
+            lines.append("  v-ladder merge scratch: " + ", ".join(
+                f"v{v['v_class']}={v['visited_rows']:,}rows/"
+                f"{fb(v['merge_scratch_bytes'])}"
+                for v in plan["v_classes"]
+            ))
+        lines.append(
+            f"plan total (resident + peak-class staging): "
+            f"{fb(plan['total_bytes'])}"
+        )
+        comp = plan.get("compiled")
+        lines.append("")
+        if comp:
+            lines.append(
+                "compiled wave program (XLA memory_analysis): "
+                f"temp {fb(comp.get('temp_size_in_bytes'))}, "
+                f"args {fb(comp.get('argument_size_in_bytes'))}, "
+                f"out {fb(comp.get('output_size_in_bytes'))}, "
+                f"alias {fb(comp.get('alias_size_in_bytes'))}"
+            )
+        else:
+            lines.append(
+                "compiled wave program: memory_analysis not reported "
+                "by this backend"
+            )
+    for m in summary.get("engine_modes") or ():
+        lines.append(
+            f"ENGINE MODE: {m.get('engine')} f_class "
+            f"{m.get('f_class')} ran {m.get('mode').upper()} "
+            f"memory-lean ({m.get('buffer_rows'):,} rows in "
+            f"{m.get('chunks')} chunks of {m.get('chunk_rows'):,}; "
+            f"flat budget {fb(m.get('flat_budget_bytes'))})"
+        )
+    wm = summary.get("watermark")
+    chunks = summary.get("chunk_mem") or []
+    if wm or chunks:
+        lines.append("")
+        lines.append("live watermarks:")
+    if chunks:
+        shown = chunks[:max_chunks]
+        lines.append(
+            "  per-chunk bytes-in-use: " + " ".join(
+                fb(c["bytes"]) for c in shown
+            ) + (f" ... ({len(chunks) - max_chunks} more)"
+                 if len(chunks) > max_chunks else "")
+        )
+    if wm:
+        lines.append(
+            f"  run peak: {fb(wm.get('device_peak_bytes'))} "
+            f"(source: {wm.get('source')}, "
+            f"{wm.get('polls', 0)} polls)"
+        )
+        hr = wm.get("headroom") or {}
+        occ = hr.get("occupancy")
+        lines.append(
+            f"  visited headroom: {hr.get('visited_rows', 0):,}/"
+            f"{hr.get('visited_capacity', 0):,} rows"
+            + (f" ({occ:.1%})" if occ is not None else "")
+            + f" = {fb(hr.get('visited_used_bytes'))} of "
+            f"{fb(hr.get('visited_capacity_bytes'))}"
+        )
+        budget = hr.get("budget")
+        if budget:
+            ratio = budget.get("headroom_ratio")
+            lines.append(
+                f"  auto-budget: cand_capacity "
+                f"{budget.get('cand_capacity'):,} vs observed peak "
+                f"{budget.get('observed_peak') or 0:,}"
+                + (f" ({ratio:.2f}x headroom)"
+                   if ratio is not None else "")
+            )
+        proj = wm.get("projection") or {}
+        if proj.get("kind") == "next_v_class":
+            lines.append(
+                f"  projection (next v-class): "
+                f"{proj.get('current_rows', 0):,} -> "
+                f"{proj.get('next_rows', 0):,} visited rows = "
+                f"{fb(proj.get('next_vkeys_bytes'))} resident vkeys "
+                f"+ {fb(proj.get('next_merge_scratch_bytes'))} merge "
+                "scratch"
+            )
+        elif proj:
+            lines.append(
+                f"  projection ({proj.get('kind')}): "
+                f"{proj.get('next_rows', 0):,} rows = "
+                f"{fb(proj.get('next_visited_bytes'))}"
+            )
+    return "\n".join(lines)
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="memory plan/watermark/headroom report over a "
+        "TRACE"
+    )
+    ap.add_argument("trace", help="TRACE_r*.jsonl artifact")
+    ap.add_argument(
+        "--run", type=int, default=None,
+        help="run index inside the trace (default: the last run)",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="also write an auto-numbered MEM_r*.json artifact "
+        "(beside the trace's repo artifacts)",
+    )
+    ap.add_argument(
+        "--root", default=None,
+        help="artifact directory for --json (default: the repo root)",
+    )
+    ap.add_argument(
+        "--chunks", type=int, default=20,
+        help="max per-chunk watermark samples to print (default 20)",
+    )
+    args = ap.parse_args()
+
+    from stateright_tpu.telemetry import (
+        load_trace,
+        memory_summary,
+        validate_events,
+    )
+
+    try:
+        events = load_trace(args.trace)
+        validate_events(events)
+    except (OSError, ValueError) as exc:
+        print(f"mem_report: bad input: {exc}", file=sys.stderr)
+        sys.exit(2)
+
+    runs = sorted({e["run"] for e in events
+                   if e["ev"] == "run_begin"})
+    if args.run is not None and args.run not in runs:
+        print(
+            f"mem_report: run {args.run} not in this trace "
+            f"(runs: {runs})",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+
+    summary = memory_summary(events, run=args.run)
+    if summary is None:
+        print(
+            "mem_report: no memory events in this trace — trace a "
+            "device-engine run on round >= 12 code "
+            "(memory_plan/memory_watermark land automatically on "
+            "traced runs)",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+    print(format_report(summary, args.chunks))
+    if args.json:
+        from stateright_tpu.memplan import write_memory_artifact
+
+        summary = dict(summary, trace=os.path.basename(args.trace))
+        path = write_memory_artifact(summary, root=args.root)
+        print(f"\nwrote {path}")
+
+
+if __name__ == "__main__":
+    main()

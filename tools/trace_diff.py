@@ -22,6 +22,12 @@ artifact and this tool is the comparison —
 * **per-phase deltas** — host spans (compile, reconstruction,
   property checks), the chunk dispatch/fetch wall split, the wave
   wall, and the run total, each reported as A/B/delta/relative.
+* **memory alignment** (round 12) — traces carrying ``memory_plan``
+  events must declare IDENTICAL resident layouts and ladder-class
+  staging (plan shapes are config: a mismatch fails the gate like a
+  counter divergence), while MEASURED bytes — compiled temp bytes,
+  the live watermark peak — compare relative under ``--threshold``,
+  so jax-version allocator skew doesn't false-positive.
 * **regression threshold** — exit nonzero when any phase at least
   ``--min-sec`` long on the A side grew by more than ``--threshold``
   (relative), or on any wave divergence.

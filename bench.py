@@ -510,7 +510,10 @@ def main():
     # number and the static switch-carry state it was measured under
     # pair up without hand-matching round numbers (the gated
     # carry-copy rule, stateright_tpu/analysis/).
-    from stateright_tpu.artifacts import latest_lint_summary
+    from stateright_tpu.artifacts import (
+        latest_comms_summary,
+        latest_lint_summary,
+    )
 
     lint_ref = latest_lint_summary()
     if lint_ref is not None:
@@ -518,6 +521,16 @@ def main():
             f"lint ref: {lint_ref['artifact']} "
             f"carry_copy_bytes={lint_ref['carry_copy_bytes']} "
             f"clean={lint_ref['clean']}"
+        )
+    # COMM cross-reference (round 13, same best-effort contract):
+    # the newest comms-lint artifact — the static collective
+    # accounting a traced mesh lane's shard_balance.comms_static
+    # reconciles against (PERF.md §comms-lint).
+    comms_ref = latest_comms_summary()
+    if comms_ref is not None:
+        _stderr(
+            f"comms ref: {comms_ref['artifact']} "
+            f"clean={comms_ref['clean']}"
         )
 
     detail = {}
@@ -558,6 +571,8 @@ def main():
             # in provenance, not N+1 times per artifact line
             **({"lint": lint_ref["artifact"]}
                if lint_ref is not None else {}),
+            **({"comms": comms_ref["artifact"]}
+               if comms_ref is not None else {}),
             # sharded lanes: routed shuffle volume (the module
             # docstring's promise — recorded where a shuffle exists)
             **({"shuffle_volume": checker.metrics["shuffle_volume"]}
@@ -716,6 +731,8 @@ def main():
                             else {}),
                         **({"lint": lint_ref}
                            if lint_ref is not None else {}),
+                        **({"comms": comms_ref}
+                           if comms_ref is not None else {}),
                     }
                 ),
                 "detail": detail,

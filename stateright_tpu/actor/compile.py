@@ -993,10 +993,16 @@ class CompiledActorEncoding(EncodedModelBase):
         # 31 — one gather serves both in the per-pair/per-slot step.
         hist = np.zeros((len(self.H), n_cls), np.uint32)
         missing = np.ones((len(self.H), n_cls), bool)
+        # Sentinel lookup, NOT .get(key) is-None: a history-free model
+        # (init_history=None) legitimately stores None as the harvested
+        # next-history value, and conflating that with "key absent"
+        # marked EVERY deliver/timeout missing — hard-truncating the
+        # whole model on its first wave.
+        _absent = object()
         for hi, h in enumerate(self.H):
             for ci, cls in enumerate(classes):
-                h2 = self._hist_tr.get((h, cls[0], cls[1]))
-                if h2 is not None:
+                h2 = self._hist_tr.get((h, cls[0], cls[1]), _absent)
+                if h2 is not _absent:
                     hist[hi, ci] = self.hidx[h2]
                     missing[hi, ci] = False
         self.tbl_history_packed = hist | (

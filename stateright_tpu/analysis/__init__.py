@@ -4,42 +4,63 @@ The invariants two rounds of perf work bought — no dense ``[F, K]``
 bool on the sparse path, gather-free mask construction, table-row-only
 step gathers, no ``[N, 1]`` lane-padded ALU, class-local switch-branch
 carries — are checkable on the TRACED program, on CPU, before any
-chip run. This package is their single home:
+chip run. Round 13 adds the second rule family, COMMS-LINT: static
+collective accounting and shard-safety over the sharded wave paths
+(collectives only under pmax-agreed switches, all_to_all fed from the
+routing seam, scalar-only reductions, no all_gather, per-wave
+collective byte budgets). This package is their single home:
 
 * :mod:`.tables` — the shared primitive/HLO classification tables
   (also consumed by tests/test_codegen_shapes.py and
-  stateright_tpu/wavewall.py, so the three audits cannot drift);
-* :mod:`.walker` — jaxpr traversal with sub-jaxpr descent and
-  source attribution;
-* :mod:`.rules` — the declarative rule registry;
+  stateright_tpu/wavewall.py, so the audits cannot drift), including
+  the jaxpr-collective and HLO-collective tables the comms rules and
+  the ``--hlo`` cross-check classify with;
+* :mod:`.walker` — jaxpr traversal with sub-jaxpr descent, source
+  attribution, and the whole-jaxpr dataflow marks (shard-varying
+  taint, routing-seam derivation) the comms rules share;
+* :mod:`.rules` — the declarative rule registries (``RULES`` +
+  ``COMMS_RULES``);
 * :mod:`.registry` — every encoding the sparse engines are pinned
   for, with calibrated allowances;
-* :mod:`.lint` — the driver (``tools/lint_kernels.py``,
-  ``pytest -m lint``).
+* :mod:`.lint` — the codegen driver (``tools/lint_kernels.py``,
+  ``pytest -m lint``);
+* :mod:`.comms` — the comms driver (``tools/lint_comms.py``, the
+  same ``lint`` pytest marker).
 """
 
 from .tables import (  # noqa: F401
     ALU_PRIMS,
     CARRY_MOVE_PRIMS,
+    COLLECTIVE_PRIMS,
+    COMMS_BYTE_BUDGETS,
     DTYPE_BYTES,
     HLO_CATEGORY,
+    HLO_COLLECTIVE_OPS,
     HLO_WALL_CATEGORIES,
+    collective_bytes,
+    collective_category,
     hlo_category,
     hlo_type_bytes,
+    is_collective,
     is_gather,
     output_bytes,
     parse_hlo_categories,
+    parse_hlo_collectives,
 )
 from .walker import (  # noqa: F401
     EqnSite,
+    SiteWalk,
     audit_jaxpr,
     eqn_alu_n1,
     eqn_dense_bool_k,
     eqn_wide_concat_n1,
     iter_eqns,
+    seam_derived_vars,
+    shard_varying_vars,
     source_of,
 )
 from .rules import (  # noqa: F401
+    COMMS_RULES,
     Finding,
     RULES,
     Rule,
@@ -59,4 +80,12 @@ from .lint import (  # noqa: F401
     trace_encoding_paths,
     trace_engine_pipeline,
     trace_wave_body_fixture,
+)
+from .comms import (  # noqa: F401
+    RECONCILIATION_FIXTURE,
+    format_comms_report,
+    hlo_collective_crosscheck,
+    reconcile_collective_categories,
+    run_comms_lint,
+    trace_comms_fixture,
 )

@@ -392,10 +392,15 @@ def _ctx_for_path(spec: EncodingSpec, enc, label: str,
     # design — when EV == K (tiny action sets) it is shape-identical
     # to the dense mask, so the dense-mask rule needs a real sparse
     # pair width (the same precondition the codegen-shape tests
-    # calibrated).
+    # calibrated). check_comms rides along (round 13): the pipeline
+    # contains no collectives today, and the comms rules pin exactly
+    # that — an all_gather sneaking in via sharding propagation (or a
+    # buffer-sized psum added to the pair pipeline) fails here, not
+    # first on a mesh.
     return TraceCtx(path=label, encoding=spec.name, n=n, k=K,
                     sparse=engine_pair_width(enc) < K,
-                    allow_gathers=0, check_lane_alu=False)
+                    allow_gathers=0, check_lane_alu=False,
+                    check_comms=True)
 
 
 def lint_encoding(spec: EncodingSpec,
@@ -542,19 +547,7 @@ def run_lint(encodings: Optional[tuple] = None,
             for r in RULES
         ],
         paths=all_stats,
-        findings=[
-            dict(
-                rule=f.rule,
-                severity=f.severity,
-                encoding=f.encoding,
-                path=f.path,
-                message=f.message,
-                primitive=f.primitive,
-                source=f.source,
-                **({"data": f.data} if f.data else {}),
-            )
-            for f in all_findings
-        ],
+        findings=[f.as_dict() for f in all_findings],
     )
 
 

@@ -16,6 +16,12 @@ varying:
 * compiled encodings fetch at most the four intended table rows
   (params, flat transition, packed history, crash mask).
 
+Round 13 (ROADMAP direction 5, first step) registers the COMPILED
+paxos and 2pc encodings beside the hand ones — the two flagship
+protocols are now held to the hand-encoding codegen bar through the
+same gate, and the comms rules (analysis/comms.py) run over every
+entry's sharded pipeline.
+
 Adding an encoding to the engines means adding a spec here — the
 ``pytest -m lint`` gate then pins its codegen automatically.
 
@@ -130,6 +136,36 @@ def _compiled_ping_pong():
     return compile_actor_model(model, **ping_pong_device_specs(cfg))
 
 
+def _compiled_paxos():
+    # The COMPILED paxos encoding (round 13, ROADMAP direction 5: the
+    # compiled path held to the hand-encoding bar): the actor paxos
+    # model through the generic compiler, zero hand device code — the
+    # same protocol whose HAND encoding is the registry's calibration
+    # source. 2c/2s keeps the reachable-mode harvest (the
+    # linearizability-tester history domain) registry-sized.
+    from ..models.paxos import PaxosModelCfg, paxos_compiled_encoded
+
+    return paxos_compiled_encoded(
+        PaxosModelCfg(client_count=2, server_count=2, put_count=1)
+    )
+
+
+def _compiled_2pc_actors():
+    # The COMPILED 2pc encoding (round 13): the actor-model
+    # reformulation (models/two_phase_commit_actors.py) through the
+    # compiler — 2pc's hand encoding finally has a compiled
+    # counterpart under the same gate.
+    from ..actor.compile import compile_actor_model
+    from ..models.two_phase_commit_actors import (
+        two_phase_actor_device_specs,
+        two_phase_actor_model,
+    )
+
+    return compile_actor_model(
+        two_phase_actor_model(2), **two_phase_actor_device_specs(2)
+    )
+
+
 #: every encoding the sparse engines are pinned for. Order is the
 #: report order (hand encodings — the calibration sources — first).
 ENCODINGS: tuple = (
@@ -155,6 +191,18 @@ ENCODINGS: tuple = (
         name="compiled-ping-pong-nondup",
         kind="compiled",
         factory=_compiled_ping_pong,
+        max_step_gathers=4,
+    ),
+    EncodingSpec(
+        name="compiled-paxos-2c2s",
+        kind="compiled",
+        factory=_compiled_paxos,
+        max_step_gathers=4,
+    ),
+    EncodingSpec(
+        name="compiled-2pc-actors-rm2",
+        kind="compiled",
+        factory=_compiled_2pc_actors,
         max_step_gathers=4,
     ),
 )

@@ -45,19 +45,27 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Optional
 
 from .tables import (
+    ALL_GATHER_ALLOWANCES,
     BRANCH_PAD_CONCAT_GROWTH,
     BRANCH_PAD_CONCAT_MIN_BYTES,
     CARRY_COPY_BYTE_BUDGETS,
     CARRY_MOVE_PRIMS,
+    COMMS_BYTE_BUDGETS,
+    REDUCTION_CATEGORIES,
+    SCALAR_REDUCTION_MAX_ELEMS,
+    collective_bytes,
+    collective_category,
+    is_collective,
     is_gather,
     output_bytes,
 )
 from .walker import (
     EqnSite,
+    SiteWalk,
     eqn_alu_n1,
     eqn_dense_bool_k,
     eqn_wide_concat_n1,
-    iter_eqns,
+    iter_eqns,  # noqa: F401 — re-exported for external walkers
     source_of,
 )
 
@@ -92,6 +100,16 @@ class TraceCtx:
     check_lane_alu: bool = True
     #: audit cond/switch branch shapes + price carry movement
     check_branches: bool = False
+    #: run the comms rule family (COMMS_RULES, round 13): collective
+    #: placement/accounting over sharded wave paths — off on the
+    #: single-chip contract paths, which trace no axis context
+    check_comms: bool = False
+    #: routing seam the no-unsorted-all-to-all rule requires every
+    #: all_to_all operand to derive from: "sort" (the sort-merge
+    #: engine's (owner, fp) routing sort), "scatter" (the hash
+    #: engine's owner-position tile build), or None (rule off — paths
+    #: with no shuffle)
+    routing_seam: Optional[str] = None
 
 
 @dataclass(frozen=True)
@@ -112,6 +130,22 @@ class Finding:
         return (
             f"[{self.rule}] {self.encoding} / {self.path}: "
             f"{self.message}{loc}"
+        )
+
+    def as_dict(self) -> dict:
+        """The JSON-artifact record of one finding — the ONE
+        serialization every report writer uses (run_lint,
+        run_comms_lint, the --hlo pass), so a new Finding field can't
+        land in some artifacts and not others."""
+        return dict(
+            rule=self.rule,
+            severity=self.severity,
+            encoding=self.encoding,
+            path=self.path,
+            message=self.message,
+            primitive=self.primitive,
+            source=self.source,
+            **({"data": self.data} if self.data else {}),
         )
 
 
@@ -451,6 +485,372 @@ def _carry_copy_bytes(ctx: TraceCtx, sites: list) -> Iterable[Finding]:
         )
 
 
+# -- the comms rule family (round 13: comms-lint) --------------------------
+#
+# Static collective accounting and shard-safety over the sharded wave
+# paths (analysis/comms.py traces them; ISSUE: a misplaced collective
+# only surfaces as a deadlock or a silent traffic blow-up ON CHIP,
+# where debugging is most expensive). Each rule pins one documented
+# invariant of parallel/engine_sortmerge.py / parallel/engine.py:
+#
+# * ``no-collective-in-switch`` — a collective under a cond/switch
+#   whose index is SHARD-VARYING deadlocks: collectives are
+#   collective, so every shard must take the same branch. The engines'
+#   class switches are legal exactly because their indices are
+#   pmax-agreed (walker.shard_varying_vars proves it);
+# * ``no-unsorted-all-to-all`` — every all_to_all operand must be
+#   data-dependent on the routing seam (the (owner, fp) sort / the
+#   owner-position scatter), or the shuffle ships unrouted candidates;
+# * ``scalar-only-reductions`` — psum/pmax/pmin operands stay rank-0/
+#   tiny; a reduction over a resident buffer is an accidental
+#   replication (S x the buffer per wave in all-reduce bandwidth);
+# * ``no-all-gather`` — the wave path never all-gathers (visited state
+#   is owner-sharded BY CONSTRUCTION; gathering it back is the 8x
+#   traffic blow-up), gated with a per-fixture allowance table for
+#   legitimate drain paths (tables.ALL_GATHER_ALLOWANCES);
+# * ``comms-bytes`` — the collective analog of carry-copy-bytes:
+#   price every collective from operand shapes, report per-category
+#   totals + the PER-WAVE PEAK (fattest class branch + out-of-branch
+#   collectives), GATED against tables.COMMS_BYTE_BUDGETS.
+
+
+def _walk_of(sites) -> SiteWalk:
+    """The SiteWalk (dataflow-capable) view of a rule's site list.
+    The comms rules NEED the whole-jaxpr dataflow marks; a plain
+    hand-built list can't recover the root jaxpr, and silently
+    treating it as 'nothing is shard-varying / nothing is
+    seam-derived' would pass the deadlock shape and flag every
+    legitimate all_to_all — fail loudly instead (run_rules always
+    constructs a SiteWalk; only bespoke callers can hit this)."""
+    if isinstance(sites, SiteWalk):
+        return sites
+    raise TypeError(
+        "comms rules require the SiteWalk from run_rules/"
+        "run_rules_with_stats (whole-jaxpr dataflow marks); got a "
+        "plain site list, whose root jaxpr is not recoverable"
+    )
+
+
+def _no_collective_in_switch(ctx: TraceCtx, sites) -> Iterable[Finding]:
+    if not ctx.check_comms:
+        return
+    varying = _walk_of(sites).shard_varying()
+    for site in sites:
+        if not is_collective(site.primitive):
+            continue
+        for cond_eqn, idx in site.enclosing_conds():
+            iv = cond_eqn.invars[0]
+            if not hasattr(iv, "count"):
+                continue  # literal index: trivially uniform
+            if id(iv) in varying:
+                yield Finding(
+                    rule="no-collective-in-switch",
+                    severity="error",
+                    encoding=ctx.encoding,
+                    path=ctx.path,
+                    message=(
+                        f"`{site.primitive}` nested under "
+                        f"{site.branch_path()} whose switch index is "
+                        "SHARD-VARYING — shards take different "
+                        "branches and the collective deadlocks on "
+                        "chip (the engine invariant: class switches "
+                        "are pmax-agreed so every shard runs the "
+                        f"same branch; switch @ {source_of(cond_eqn)})"
+                    ),
+                    primitive=site.primitive,
+                    source=source_of(site.eqn),
+                    data={"branch": idx,
+                          "switch_source": source_of(cond_eqn)},
+                )
+                break
+
+
+def _no_unsorted_all_to_all(ctx: TraceCtx, sites) -> Iterable[Finding]:
+    if not ctx.check_comms or ctx.routing_seam is None:
+        return
+    seam = _walk_of(sites).seam_derived(ctx.routing_seam)
+    seam_desc = (
+        "the (owner, fp) routing sort"
+        if ctx.routing_seam == "sort"
+        else "the owner-position tile scatter"
+    )
+    for site in sites:
+        if site.primitive != "all_to_all":
+            continue
+        routed = any(
+            hasattr(v, "count") and id(v) in seam
+            for v in site.eqn.invars
+        )
+        if not routed:
+            yield Finding(
+                rule="no-unsorted-all-to-all",
+                severity="error",
+                encoding=ctx.encoding,
+                path=ctx.path,
+                message=(
+                    "all_to_all operand is not data-dependent on "
+                    f"{seam_desc} — the shuffle ships unrouted "
+                    "candidates, so rows land on shards that do not "
+                    "own their fingerprints and the owner-local "
+                    "dedup contract breaks silently "
+                    "(engine_sortmerge.py wave step 2-3)"
+                ),
+                primitive=site.primitive,
+                source=source_of(site.eqn),
+                data={"seam": ctx.routing_seam},
+            )
+
+
+def _scalar_only_reductions(ctx: TraceCtx, sites) -> Iterable[Finding]:
+    if not ctx.check_comms:
+        return
+    for site in sites:
+        if not is_collective(site.primitive):
+            continue
+        if collective_category(site.primitive) \
+                not in REDUCTION_CATEGORIES:
+            continue
+        for v in site.eqn.invars:
+            sh = getattr(getattr(v, "aval", None), "shape", None)
+            if sh is None:
+                continue
+            elems = 1
+            for d in sh:
+                elems *= int(d)
+            if elems <= SCALAR_REDUCTION_MAX_ELEMS:
+                continue
+            yield Finding(
+                rule="scalar-only-reductions",
+                severity="error",
+                encoding=ctx.encoding,
+                path=ctx.path,
+                message=(
+                    f"`{site.primitive}` over a {list(sh)} operand "
+                    f"({elems:,} elements > "
+                    f"{SCALAR_REDUCTION_MAX_ELEMS}) — an accidental "
+                    "replication: every shard pays the full buffer's "
+                    "all-reduce bandwidth per wave; the engines "
+                    "psum SCALARS (counters, flags) and tiny "
+                    "per-property vectors only"
+                ),
+                primitive=site.primitive,
+                source=source_of(site.eqn),
+                data={"shape": [int(d) for d in sh],
+                      "elements": elems},
+            )
+            break
+
+
+def _no_all_gather(ctx: TraceCtx, sites) -> Iterable[Finding]:
+    if not ctx.check_comms:
+        return
+    gsites = [
+        s for s in sites
+        if is_collective(s.primitive)
+        and collective_category(s.primitive) == "all-gather"
+    ]
+    allowance = ALL_GATHER_ALLOWANCES.get(ctx.encoding, 0)
+    if len(gsites) <= allowance:
+        return
+    srcs = ", ".join(source_of(s.eqn) for s in gsites)
+    yield Finding(
+        rule="no-all-gather",
+        severity="error",
+        encoding=ctx.encoding,
+        path=ctx.path,
+        message=(
+            f"{len(gsites)} all_gather eqn(s) on a wave path whose "
+            f"allowance is {allowance} — visited state is "
+            "owner-sharded by construction; gathering it back onto "
+            "every shard is the S-fold traffic blow-up sharding "
+            "exists to avoid. Register a drain-path allowance in "
+            "tables.ALL_GATHER_ALLOWANCES only for a deliberate, "
+            f"priced collection; sites: {srcs}"
+        ),
+        primitive=gsites[0].primitive,
+        source=source_of(gsites[0].eqn),
+        data={"all_gathers": len(gsites), "allowance": allowance},
+    )
+
+
+def _branch_tree_peak(node: dict) -> int:
+    """Per-wave peak of a branch tree: a node's own collective bytes
+    plus, for EVERY nested cond below it, the fattest of that cond's
+    branches — at any depth exactly one branch of each switch runs
+    per wave, so siblings take max while distinct conds (which all
+    execute) sum."""
+    total = node["bytes"]
+    for branches in node["conds"].values():
+        total += max(
+            _branch_tree_peak(child) for child in branches.values()
+        )
+    return total
+
+
+def _comms_bytes(ctx: TraceCtx, sites) -> Iterable[Finding]:
+    """Price every collective from operand shapes. Collectives are
+    attributed to their FULL cond/switch branch path and the per-wave
+    peak takes the fattest branch at every nesting level (mutually
+    exclusive siblings max, sequential conds sum) plus everything
+    outside any switch — the number the byte budget gates
+    (tables.COMMS_BYTE_BUDGETS) and the one a mesh trace's routed-byte
+    counters reconcile against (telemetry.shard_balance comms_static;
+    PERF.md §comms-lint)."""
+    if not ctx.check_comms:
+        return
+    per_cat: dict = {}
+    # branch tree: bytes at this nesting level + per nested cond a
+    # {branch_idx: subtree} map (see _branch_tree_peak)
+    tree = {"bytes": 0, "conds": {}}
+    a2a_rows_max = 0
+    a2a_row_bytes = None
+    a2a_eqns = 0
+    n_coll = 0
+    top = None
+    for site in sites:
+        if not is_collective(site.primitive):
+            continue
+        n_coll += 1
+        b = collective_bytes(site.eqn)
+        cat = collective_category(site.primitive)
+        slot = per_cat.setdefault(cat, {"eqns": 0, "bytes": 0})
+        slot["eqns"] += 1
+        slot["bytes"] += b
+        if top is None or b > top[0]:
+            top = (b, site.primitive, source_of(site.eqn))
+        node = tree
+        for ce, idx in site.enclosing_conds():
+            node = node["conds"].setdefault(id(ce), {}).setdefault(
+                idx, {"bytes": 0, "conds": {}}
+            )
+        node["bytes"] += b
+        if site.primitive == "all_to_all":
+            a2a_eqns += 1
+            for v in site.eqn.invars:
+                sh = getattr(getattr(v, "aval", None), "shape", None)
+                if sh and len(sh) >= 2:
+                    rows = int(sh[0])
+                    lanes = 1
+                    for d in sh[1:]:
+                        lanes *= int(d)
+                    rb = lanes * v.aval.dtype.itemsize
+                    a2a_rows_max = max(a2a_rows_max, rows)
+                    a2a_row_bytes = (
+                        rb if a2a_row_bytes is None
+                        else max(a2a_row_bytes, rb)
+                    )
+    if n_coll == 0:
+        return
+    per_wave_peak = _branch_tree_peak(tree)
+    budget = COMMS_BYTE_BUDGETS.get(ctx.encoding)
+    top_b, top_prim, top_src = top
+    yield Finding(
+        rule="comms-bytes",
+        severity="info",
+        encoding=ctx.encoding,
+        path=ctx.path,
+        message=(
+            f"{n_coll} collective eqns move "
+            f"{sum(s['bytes'] for s in per_cat.values()) / 1e6:.3f}"
+            " MB (static program total); per-wave peak "
+            f"{per_wave_peak / 1e6:.3f} MB (fattest branch at every "
+            f"switch level + unswitched collectives); fattest: "
+            f"{top_prim} {top_b / 1e6:.3f} MB @ {top_src}"
+            + (f"; budget {budget / 1e6:.3f} MB"
+               if budget is not None else "")
+        ),
+        primitive=top_prim,
+        source=top_src,
+        data={
+            "collectives": n_coll,
+            "per_category": per_cat,
+            "bytes_total": sum(
+                s["bytes"] for s in per_cat.values()
+            ),
+            "per_wave_peak_bytes": per_wave_peak,
+            "all_to_all_eqns": a2a_eqns,
+            **({"all_to_all_row_bytes": a2a_row_bytes,
+                "all_to_all_rows_max": a2a_rows_max}
+               if a2a_row_bytes is not None else {}),
+            **({"budget_bytes": budget}
+               if budget is not None else {}),
+        },
+    )
+    if budget is not None and per_wave_peak > budget:
+        yield Finding(
+            rule="comms-bytes",
+            severity="error",
+            encoding=ctx.encoding,
+            path=ctx.path,
+            message=(
+                f"per-wave collective bytes {per_wave_peak:,} exceed "
+                f"this fixture's budget {budget:,} "
+                "(analysis/tables.COMMS_BYTE_BUDGETS) — the wave "
+                "body grew cross-chip traffic (a second shuffle, a "
+                "buffer-sized reduction, an S-fold gather). Raise "
+                "the budget only for a deliberate, priced "
+                "communication addition."
+            ),
+            primitive=top_prim,
+            source=top_src,
+            data={
+                "per_wave_peak_bytes": per_wave_peak,
+                "budget_bytes": budget,
+            },
+        )
+
+
+#: the comms rule family — run alongside RULES by the shared driver,
+#: active only on paths whose TraceCtx sets ``check_comms``
+#: (analysis/comms.py's sharded fixtures; the kernel lint's engine
+#: paths enable it too, as belt-and-braces against a collective
+#: sneaking into the pair pipeline via sharding propagation).
+COMMS_RULES: tuple = (
+    Rule(
+        name="no-collective-in-switch",
+        description=(
+            "collectives only under shard-UNIFORM (pmax-agreed) "
+            "switch indices — a shard-varying branch deadlocks the "
+            "mesh"
+        ),
+        run=_no_collective_in_switch,
+    ),
+    Rule(
+        name="no-unsorted-all-to-all",
+        description=(
+            "every all_to_all operand derives from the routing seam "
+            "(owner-sort / owner-scatter), never raw candidates"
+        ),
+        run=_no_unsorted_all_to_all,
+    ),
+    Rule(
+        name="scalar-only-reductions",
+        description=(
+            "psum/pmax/pmin operands rank-0/tiny (<= "
+            f"{SCALAR_REDUCTION_MAX_ELEMS} elements); buffer-sized "
+            "reductions are accidental replication"
+        ),
+        run=_scalar_only_reductions,
+    ),
+    Rule(
+        name="no-all-gather",
+        description=(
+            "no all_gather on wave paths (S-fold traffic); gated by "
+            "the drain-path allowance table"
+        ),
+        run=_no_all_gather,
+    ),
+    Rule(
+        name="comms-bytes",
+        description=(
+            "price collectives from operand shapes; per-wave peak "
+            "GATED against tables.COMMS_BYTE_BUDGETS"
+        ),
+        run=_comms_bytes,
+    ),
+)
+
+
 #: the registry — ``tools/lint_kernels.py`` and ``pytest -m lint``
 #: both run exactly this list.
 RULES: tuple = (
@@ -516,9 +916,11 @@ def run_rules_with_stats(ctx: TraceCtx, closed) -> tuple:
     """``(findings, n_eqns)`` — one walk serves both the rules and
     the coverage stats (the lint driver's per-path eqn counts; big
     traces run to thousands of eqns, so the walk is not re-done just
-    to count)."""
-    sites = list(iter_eqns(closed.jaxpr))
+    to count). The walk is a :class:`walker.SiteWalk`, so the comms
+    rules' whole-jaxpr dataflow marks compute at most once per path;
+    COMMS_RULES run after RULES and self-gate on ``ctx.check_comms``."""
+    sites = SiteWalk(closed)
     findings: list = []
-    for rule in RULES:
+    for rule in RULES + COMMS_RULES:
         findings.extend(rule.run(ctx, sites))
     return findings, len(sites)

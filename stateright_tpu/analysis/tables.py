@@ -115,8 +115,131 @@ def is_gather(primitive_name: str) -> bool:
     """The gather classification every audit shares: any primitive
     whose name contains ``gather`` (``gather``, ``dynamic_gather``,
     batched variants) — at the jaxpr level take/``x[idx]``/
-    ``take_along_axis`` all lower to one of these."""
-    return "gather" in primitive_name
+    ``take_along_axis`` all lower to one of these. Cross-device
+    collectives (``all_gather``) are NOT memory gathers: they classify
+    through :data:`COLLECTIVE_PRIMS` and the comms rules instead."""
+    return "gather" in primitive_name and not is_collective(
+        primitive_name
+    )
+
+
+# --------------------------------------------------------------------------
+# collective classification (the comms-lint rule family, round 13)
+# --------------------------------------------------------------------------
+
+#: jaxpr collective primitives → comms category. ONE home for both
+#: sides of the static collective accounting: the jaxpr walk
+#: (analysis/comms.py, the comms rules) classifies with this table and
+#: the ``--hlo`` cross-check classifies compiled modules with
+#: :data:`HLO_COLLECTIVE_OPS` below — the two vocabularies share the
+#: category strings, so "jaxpr reductions == HLO all-reduces" is one
+#: dict comparison, not a per-consumer mapping. ``pvary``/
+#: ``axis_index`` are deliberately absent: they are axis PLUMBING
+#: (replication typing / shard identity), move zero bytes, and listing
+#: them here would inflate every byte total.
+COLLECTIVE_PRIMS = {
+    "all_to_all": "all-to-all",
+    "psum": "reduction",
+    "psum2": "reduction",  # newer-jax spelling of the same reduce
+    "pmax": "reduction",
+    "pmin": "reduction",
+    "ppermute": "permute",
+    "pbroadcast": "broadcast",
+    "all_gather": "all-gather",
+    "all_gather_invariant": "all-gather",
+    "reduce_scatter": "reduce-scatter",
+}
+
+#: the collective categories whose operands must stay rank-0/tiny
+#: (the ``scalar-only-reductions`` rule): a psum over a resident
+#: ``[W, F]`` buffer is an accidental replication — every shard pays
+#: the full buffer's all-reduce bandwidth for a value the engine only
+#: ever needs element-wise on one shard.
+REDUCTION_CATEGORIES = frozenset({"reduction"})
+
+#: max elements a psum/pmax/pmin operand may carry before the
+#: scalar-only-reductions rule flags it. The engines' reductions are
+#: scalars and per-property vectors (property count < 32 by the
+#: eventually-bits contract); 64 leaves headroom for a property-family
+#: growth while still sitting orders of magnitude below any resident
+#: buffer.
+SCALAR_REDUCTION_MAX_ELEMS = 64
+
+#: per-fixture allowances for the GATED ``no-all-gather`` rule: how
+#: many ``all_gather`` eqns a traced comms fixture may contain.
+#: Default (unlisted) is 0 — the wave path never all-gathers: visited
+#: state is owner-sharded by construction and an all_gather of it is
+#: the 8x traffic blow-up the rule exists to catch. A DRAIN-path
+#: fixture (host-side counterexample reconstruction staging, which
+#: legitimately collects shard-local logs) would register its
+#: allowance here, the way step-path gathers register theirs in
+#: ``EncodingSpec.max_step_gathers``. No current fixture needs one.
+ALL_GATHER_ALLOWANCES: dict = {}
+
+#: per-fixture budgets for the GATED ``comms-bytes`` rule (the comms
+#: analog of CARRY_COPY_BYTE_BUDGETS): the PER-WAVE PEAK collective
+#: payload — the fattest single class branch's collective bytes plus
+#: any collectives outside the class switch — measured at the comms
+#: fixtures' shapes (analysis/comms.py) and budgeted ~30% above, so a
+#: new counter psum passes but a structural regression (a second
+#: all_to_all, a buffer-sized reduction) fails loudly. Keys are the
+#: comms fixture names (TraceCtx.encoding).
+#:
+#: Measured at S=2 (2 shards), 2pc rm=3 fixture shapes
+#: (dest_tile_width=7 lanes x 4 B rows, Bd=1024):
+#: * sortmerge untraced: 57,436 B — the peak class's all_to_all
+#:   [2*1024, 7] u32 tile exchange (57,344 B) + 54 scalar/property
+#:   psums (344 B across all classes, ~92 B in the peak branch);
+#: * sortmerge traced (+slog): 57,440 B — the per-shard mesh log is
+#:   never psum-collapsed (its contract), so tracing adds exactly ONE
+#:   scalar psum (the global wave row's n_tot back-fill, 4 B); the
+#:   shared budget pins that zero-traffic claim;
+#: * hash engine: 57,424 B untraced / 57,428 traced (same all_to_all
+#:   tile, no class ladder — one fixed-shape wave, 12-13 scalar
+#:   psums);
+#: * the reconciliation fixture (2pc rm=5 at the TRACE_r16 dryrun
+#:   config, S=8): 229,472 B — all_to_all [8*1024, 7] = 229,376 B +
+#:   scalar psums.
+COMMS_BYTE_BUDGETS = {
+    "comms(2pc-rm3,sortmerge,S2)": 75_000,
+    "comms(2pc-rm3,sortmerge,S2,traced)": 75_000,
+    "comms(2pc-rm3,hash,S2)": 75_000,
+    "comms(2pc-rm3,hash,S2,traced)": 75_000,
+    "comms(2pc-rm5,sortmerge,S8,traced)": 300_000,
+}
+
+
+def is_collective(primitive_name: str) -> bool:
+    """Whether a jaxpr primitive is a cross-shard collective — the
+    recognition every comms rule shares. Prefix-matched for the
+    all_gather family so a renamed variant (``all_gather_invariant``)
+    can't slip past the no-all-gather gate unclassified."""
+    return (
+        primitive_name in COLLECTIVE_PRIMS
+        or primitive_name.startswith("all_gather")
+    )
+
+
+def collective_category(primitive_name: str) -> str:
+    """jaxpr collective primitive → comms category."""
+    if primitive_name in COLLECTIVE_PRIMS:
+        return COLLECTIVE_PRIMS[primitive_name]
+    if primitive_name.startswith("all_gather"):
+        return "all-gather"
+    return "other-collective"
+
+
+def collective_bytes(eqn) -> int:
+    """Static byte price of one collective eqn: the larger of its
+    operand and result payloads (an all_to_all moves its operand, an
+    all_gather materializes its S-times-larger RESULT on every shard
+    — max covers both directions without a per-primitive table).
+    Token/unit avals price as 0 through :func:`output_bytes`."""
+    in_b = sum(
+        output_bytes(v.aval) for v in eqn.invars if hasattr(v, "aval")
+    )
+    out_b = sum(output_bytes(v.aval) for v in eqn.outvars)
+    return max(in_b, out_b)
 
 
 def output_bytes(aval) -> int:
@@ -161,6 +284,31 @@ HLO_CATEGORY["sort"] = "sort"
 HLO_CATEGORY["gather"] = "gather"
 HLO_CATEGORY["scatter"] = "scatter"
 HLO_CATEGORY["fusion"] = "fusion"
+
+#: HLO collective opcodes → the SAME comms-category vocabulary as
+#: COLLECTIVE_PRIMS (one home: the --hlo collective cross-check in
+#: analysis/comms.py reconciles per-category op counts across the two
+#: tables). Async pairs: the ``-start`` op carries the payload and
+#: counts; the ``-done`` op is completion plumbing and classifies as
+#: control (counting both would double every TPU collective).
+HLO_COLLECTIVE_OPS = {
+    "all-to-all": "all-to-all",
+    "all-to-all-start": "all-to-all",
+    "all-reduce": "reduction",
+    "all-reduce-start": "reduction",
+    "reduce-scatter": "reduce-scatter",
+    "reduce-scatter-start": "reduce-scatter",
+    "all-gather": "all-gather",
+    "all-gather-start": "all-gather",
+    "collective-permute": "permute",
+    "collective-permute-start": "permute",
+    "collective-broadcast": "broadcast",
+}
+for _op in HLO_COLLECTIVE_OPS:
+    HLO_CATEGORY[_op] = "collective"
+for _op in ("all-to-all-done", "all-reduce-done", "all-gather-done",
+            "reduce-scatter-done", "collective-permute-done"):
+    HLO_CATEGORY[_op] = "control"
 for _op in ("while", "conditional", "call", "tuple",
             "get-tuple-element", "parameter", "constant",
             "iota", "broadcast", "after-all", "partition-id",
@@ -224,6 +372,33 @@ def parse_hlo_categories(hlo_text: str) -> dict:
             continue
         type_str, opcode = m.groups()
         cat = hlo_category(opcode)
+        slot = out.setdefault(cat, {"ops": 0, "bytes": 0})
+        slot["ops"] += 1
+        slot["bytes"] += hlo_type_bytes(type_str)
+    return out
+
+
+def parse_hlo_collectives(hlo_text: str) -> dict:
+    """Per-COMMS-category ``{"ops": count, "bytes": output_bytes}``
+    over the collective instructions of an optimized-HLO dump — the
+    compiled-module side of the collective cross-check
+    (analysis/comms.py): categories here reconcile one-to-one against
+    the jaxpr walk's COLLECTIVE_PRIMS totals, and any category XLA
+    *introduced* (SPMD partitioner respecification) shows up as ops
+    the jaxpr side can't account for. Bytes are the instruction's
+    OUTPUT type — equal to the jaxpr operand estimate on XLA:CPU
+    (measured ratio 1.0, PERF.md §comms-lint); a backend typing the
+    exchange per-participant would show an S-factor, which is why the
+    cross-check reports the ratio instead of gating on it."""
+    out: dict = {}
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.match(line)
+        if m is None:
+            continue
+        type_str, opcode = m.groups()
+        cat = HLO_COLLECTIVE_OPS.get(opcode)
+        if cat is None:
+            continue
         slot = out.setdefault(cat, {"ops": 0, "bytes": 0})
         slot["ops"] += 1
         slot["bytes"] += hlo_type_bytes(type_str)

@@ -27,13 +27,17 @@ class EqnSite:
     """One equation plus where the walk found it.
 
     ``stack`` is a tuple of ``(enclosing_primitive_name, branch_index
-    or None)`` from outermost to innermost — e.g. a pad inside the
-    third branch of the class-ladder switch inside the wave while-loop
-    walks in with ``(("while", None), ("cond", 2))``. ``jaxpr`` is the
-    (sub-)jaxpr the equation belongs to, so a rule can ask whether an
-    equation's result is one of its jaxpr's OUTPUTS (a branch
-    returning a rebuilt buffer as its carry) versus an internal
-    temporary (a sort lane that never leaves the branch).
+    or None, enclosing_eqn)`` from outermost to innermost — e.g. a pad
+    inside the third branch of the class-ladder switch inside the wave
+    while-loop walks in with ``(("while", None, <while eqn>),
+    ("cond", 2, <switch eqn>))``. The enclosing eqn (round 13) is what
+    lets the comms rules read a switch's INDEX operand — "is this
+    collective under a shard-uniform switch?" needs the ``cond`` eqn
+    itself, not just its name. ``jaxpr`` is the (sub-)jaxpr the
+    equation belongs to, so a rule can ask whether an equation's
+    result is one of its jaxpr's OUTPUTS (a branch returning a rebuilt
+    buffer as its carry) versus an internal temporary (a sort lane
+    that never leaves the branch).
     """
 
     eqn: Any
@@ -47,7 +51,16 @@ class EqnSite:
     def in_branch(self) -> bool:
         """True when the equation sits inside a ``cond``/``switch``
         branch computation at any depth."""
-        return any(name == "cond" for name, _ in self.stack)
+        return any(name == "cond" for name, _, _ in self.stack)
+
+    def enclosing_conds(self):
+        """The ``cond``/``switch`` eqns this site is nested under,
+        outermost first, as ``(cond_eqn, branch_index)`` pairs."""
+        return [
+            (eqn, idx)
+            for name, idx, eqn in self.stack
+            if name == "cond"
+        ]
 
     def reaches_output(self) -> bool:
         """True when one of the equation's results is returned by its
@@ -88,7 +101,7 @@ class EqnSite:
     def branch_path(self) -> str:
         return "/".join(
             name if idx is None else f"{name}[{idx}]"
-            for name, idx in self.stack
+            for name, idx, _ in self.stack
         )
 
 
@@ -119,7 +132,197 @@ def iter_eqns(jaxpr, _stack: tuple = ()) -> Iterator[EqnSite]:
         yield EqnSite(eqn, _stack, jaxpr)
         name = eqn.primitive.name
         for sub, branch in _sub_jaxprs(eqn):
-            yield from iter_eqns(sub, _stack + ((name, branch),))
+            yield from iter_eqns(sub, _stack + ((name, branch, eqn),))
+
+
+# -- whole-jaxpr dataflow (the comms rules' shared analyses) ---------------
+#
+# Two questions the collective rules ask need more than one equation's
+# shapes: "is this switch's index shard-UNIFORM?" (a collective under a
+# shard-varying switch deadlocks — branches diverge across the mesh)
+# and "is this all_to_all's operand derived from the routing seam?"
+# (an unsorted operand ships unrouted candidates). Both are forward
+# dataflow marks over the whole (closed) jaxpr, sub-jaxprs included.
+#
+# Sub-jaxpr boundaries are mapped PRECISELY where jax fixes the
+# convention — ``cond`` (invars[0] is the index, operands map 1:1 to
+# every branch's invars, outvars positionally) and call-like
+# primitives with matching arity — and OVER-APPROXIMATED elsewhere
+# (scan/while carries: any marked operand marks all sub invars, any
+# marked sub outvar marks all eqn outvars). Over-approximation is in
+# the mark-MORE direction for both analyses, which errs toward
+# flagging in the uniformity rule (a "maybe-varying" switch index
+# flags) and toward NOT flagging in the seam rule (a "maybe-routed"
+# operand passes); the deliberate-regression tests pin that both
+# still catch the real defect shapes. The marking runs to fixpoint,
+# so taint that only develops through a loop-carry round trip is not
+# missed.
+
+#: collectives whose RESULT is identical on every shard regardless of
+#: operand variance — the uniformity analysis clears taint through
+#: these (the engines' pmax class agreement is exactly this: a
+#: shard-varying count goes in, a mesh-uniform class comes out).
+_UNIFORM_RESULT_COLLECTIVES = frozenset({
+    "psum", "psum2", "pmax", "pmin", "all_gather",
+    "all_gather_invariant",
+})
+
+#: call-like primitives whose sub-jaxpr I/O maps positionally.
+_CALL_PRIMS = frozenset({
+    "pjit", "closed_call", "core_call", "custom_jvp_call",
+    "custom_vjp_call", "remat", "checkpoint",
+})
+
+
+def _mark(marked: set, v) -> bool:
+    if not hasattr(v, "count"):  # Literal
+        return False
+    if id(v) in marked:
+        return False
+    marked.add(id(v))
+    return True
+
+
+def _flow(jaxpr, marked: set, *, seeds, clears: frozenset,
+          shard_map_seeds: bool) -> bool:
+    """One forward pass over ``jaxpr`` and its sub-jaxprs; returns
+    True when any new var was marked (the fixpoint driver re-runs
+    until False)."""
+    changed = False
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        tainted_in = any(
+            hasattr(v, "count") and id(v) in marked
+            for v in eqn.invars
+        )
+        subs = list(_sub_jaxprs(eqn))
+        for sub, _branch in subs:
+            if shard_map_seeds and name == "shard_map":
+                # entering the mesh region: every per-shard view is a
+                # taint source
+                for sv in sub.invars:
+                    changed |= _mark(marked, sv)
+            elif name == "cond":
+                for ev, sv in zip(eqn.invars[1:], sub.invars):
+                    if hasattr(ev, "count") and id(ev) in marked:
+                        changed |= _mark(marked, sv)
+            elif name in _CALL_PRIMS and len(sub.invars) == len(
+                eqn.invars
+            ):
+                for ev, sv in zip(eqn.invars, sub.invars):
+                    if hasattr(ev, "count") and id(ev) in marked:
+                        changed |= _mark(marked, sv)
+            elif tainted_in:
+                for sv in sub.invars:
+                    changed |= _mark(marked, sv)
+            changed |= _flow(
+                sub, marked, seeds=seeds, clears=clears,
+                shard_map_seeds=shard_map_seeds,
+            )
+            sub_out_marked = any(
+                hasattr(sv, "count") and id(sv) in marked
+                for sv in sub.outvars
+            )
+            # LOOP-CARRY FEEDBACK: in a while/scan body the outputs
+            # feed the next iteration's inputs, so a mark born INSIDE
+            # the body (an axis_index, a nested source) must taint the
+            # carried invars too — without this edge, taint that only
+            # develops through a loop round trip never reaches a
+            # switch index read from the carry (over-approx: all sub
+            # invars, since the carry position mapping is
+            # primitive-specific). The global fixpoint then re-runs
+            # the body with the carry tainted.
+            if name in ("while", "scan") and sub_out_marked:
+                for sv in sub.invars:
+                    changed |= _mark(marked, sv)
+            # sub outputs back to the eqn's outputs
+            if name == "cond" or (
+                name in _CALL_PRIMS
+                and len(sub.outvars) == len(eqn.outvars)
+            ):
+                for sv, ev in zip(sub.outvars, eqn.outvars):
+                    if hasattr(sv, "count") and id(sv) in marked:
+                        changed |= _mark(marked, ev)
+            elif sub_out_marked:
+                for ev in eqn.outvars:
+                    changed |= _mark(marked, ev)
+        if name in clears:
+            # result independent of operand variance (e.g. a psum is
+            # mesh-uniform no matter what went in)
+            continue
+        if seeds(eqn) or tainted_in:
+            for v in eqn.outvars:
+                changed |= _mark(marked, v)
+    return changed
+
+
+def _fixpoint(closed, *, seeds, clears=frozenset(),
+              shard_map_seeds=False) -> set:
+    marked: set = set()
+    while _flow(closed.jaxpr, marked, seeds=seeds, clears=clears,
+                shard_map_seeds=shard_map_seeds):
+        pass
+    return marked
+
+
+def shard_varying_vars(closed) -> set:
+    """ids of vars that may differ across shards: everything flowing
+    from a ``shard_map`` region's per-shard inputs or an
+    ``axis_index``, EXCEPT through the uniform-result collectives
+    (psum/pmax/pmin/all_gather), whose outputs every shard agrees on.
+    The complement — a var NOT in this set — is provably mesh-uniform,
+    which is what makes a ``lax.switch`` on it collective-safe."""
+    return _fixpoint(
+        closed,
+        seeds=lambda eqn: eqn.primitive.name == "axis_index",
+        clears=_UNIFORM_RESULT_COLLECTIVES,
+        shard_map_seeds=True,
+    )
+
+
+def seam_derived_vars(closed, kind: str) -> set:
+    """ids of vars data-dependent on the routing seam: ``kind="sort"``
+    marks forward from multi-key ``sort`` eqns (the sharded sort-merge
+    engine's (owner, fp) routing sort — ``num_keys >= 2`` excludes
+    incidental single-key value sorts), ``kind="scatter"`` from
+    scatter eqns (the hash engine's owner-position tile build). An
+    ``all_to_all`` operand outside this set never went through the
+    routing stage."""
+    if kind == "sort":
+        def seeds(eqn):
+            return (
+                eqn.primitive.name == "sort"
+                and eqn.params.get("num_keys", 1) >= 2
+            )
+    elif kind == "scatter":
+        def seeds(eqn):
+            return eqn.primitive.name.startswith("scatter")
+    else:
+        raise ValueError(f"unknown routing seam kind {kind!r}")
+    return _fixpoint(closed, seeds=seeds)
+
+
+class SiteWalk(list):
+    """The materialized equation walk of one closed jaxpr, plus the
+    lazily-computed whole-jaxpr dataflow marks the comms rules share
+    (one walk and at most one fixpoint per analysis per traced path —
+    rules never re-run the traversal)."""
+
+    def __init__(self, closed):
+        super().__init__(iter_eqns(closed.jaxpr))
+        self.closed = closed
+        self._marks: dict = {}
+
+    def shard_varying(self) -> set:
+        if "varying" not in self._marks:
+            self._marks["varying"] = shard_varying_vars(self.closed)
+        return self._marks["varying"]
+
+    def seam_derived(self, kind: str) -> set:
+        key = f"seam:{kind}"
+        if key not in self._marks:
+            self._marks[key] = seam_derived_vars(self.closed, kind)
+        return self._marks[key]
 
 
 def source_of(eqn) -> str:

@@ -19,7 +19,11 @@ memplan.write_memory_artifact) numbers through the same helpers but
 in its OWN sequence (``next_round(root, stems=("MEM",))`` —
 ``MEM_r01`` first): a MEM artifact is *derived from* a TRACE and
 names it in its ``trace`` field, so the cross-reference — not a
-shared counter — pairs it with a perf round.
+shared counter — pairs it with a perf round. ``COMM_r*.json``
+(comms-lint, tools/lint_comms.py) follows the same own-sequence
+pattern: a COMM artifact is the static communication contract at one
+commit, cross-referenced BY bench/lint artifacts
+(:func:`latest_comms_summary`) rather than sharing their counter.
 """
 
 from __future__ import annotations
@@ -171,6 +175,63 @@ def latest_lint_summary(root: str | None = None) -> dict | None:
             for name, (c, m) in sorted(per_fix.items())
         }
     return out
+
+
+def latest_comms_summary(root: str | None = None) -> dict | None:
+    """Cross-reference block for the newest ``COMM_r*.json``
+    (comms-lint, tools/lint_comms.py): artifact name, clean flag, the
+    producing SHA, and the per-fixture collective accounting the
+    static-vs-runtime reconciliation reads (per-wave peak bytes +
+    all_to_all row bytes, telemetry.shard_balance ``comms_static``).
+    bench.py and lint_kernels.py embed this beside the LINT
+    cross-reference (the PR 5 ``latest_lint_summary`` pattern). Best
+    effort with the same guarantees: a missing, hand-edited, or
+    truncated artifact degrades to None, never aborts the caller."""
+    path = latest_artifact("COMM", root)
+    if path is None:
+        return None
+    try:
+        with open(path) as fh:
+            report = json.load(fh)
+        comms = report.get("comms")
+        if not isinstance(comms, dict) or not comms:
+            return None
+        fixtures: dict = {}
+        for name, c in comms.items():
+            if not isinstance(c, dict):
+                continue
+            fixtures[str(name)] = {
+                "per_wave_peak_bytes": (
+                    int(c["per_wave_peak_bytes"])
+                    if "per_wave_peak_bytes" in c else None
+                ),
+                "all_to_all_row_bytes": (
+                    int(c["all_to_all_row_bytes"])
+                    if "all_to_all_row_bytes" in c else None
+                ),
+            }
+        prov = report.get("provenance")
+        comm_sha = (prov.get("git_sha")
+                    if isinstance(prov, dict) else None)
+    except (OSError, ValueError, TypeError, AttributeError, KeyError):
+        return None
+    if not fixtures:
+        return None
+    repo = repo_root() if root is None else root
+    head = _git_sha(repo)
+    dirty = _git_dirty(repo)
+    return {
+        "artifact": os.path.basename(path),
+        "clean": bool(report.get("clean")),
+        "git_sha": comm_sha,
+        "sha_matches_head": (
+            comm_sha == head
+            if comm_sha is not None and head is not None
+            and dirty is False
+            else None
+        ),
+        "fixtures": dict(sorted(fixtures.items())),
+    }
 
 
 def _git_dirty(root: str) -> bool | None:

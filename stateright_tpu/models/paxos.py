@@ -246,3 +246,54 @@ def paxos_model(cfg: PaxosModelCfg, network: Network | None = None) -> ActorMode
         .record_msg_in(record_returns)
         .record_msg_out(record_invocations)
     )
+
+
+def paxos_device_specs() -> dict:
+    """Device property specs for compiling the ACTOR paxos model
+    (``compile_actor_model(paxos_model(cfg), **paxos_device_specs(),
+    closure="reachable")``) — the same history-table/network-scan
+    idiom as ABD's specs (models/linearizable_register.py). The hand
+    encoding (models/paxos_tpu.py) stays the production path; the
+    compiled encoding exists so the kernel-lint registry holds the
+    compiled paxos codegen to the hand-encoding bar (ROADMAP
+    direction 5, analysis/registry.py)."""
+
+    def linearizable(ctx, jnp):
+        return (
+            ctx.history_value(
+                lambda h: int(h.serialized_history() is not None)
+            )
+            == 1
+        )
+
+    def value_chosen_vec(ctx, jnp):
+        return ctx.network_any(
+            lambda env: isinstance(env.msg, GetOk)
+            and env.msg.value != DEFAULT_VALUE
+        )
+
+    return dict(
+        properties={
+            "linearizable": linearizable,
+            "value chosen": value_chosen_vec,
+        }
+    )
+
+
+def paxos_compiled_encoded(cfg: PaxosModelCfg,
+                           network: Network | None = None):
+    """The compiled paxos encoding: the actor model through the
+    generic actor→encoding compiler, zero hand-written device code.
+    ``closure="reachable"`` (the harvest/bootstrap mode): paxos
+    ballots and the linearizability-tester history are bounded only by
+    system reachability, so the overapproximating fixpoint has no
+    protocol bound to converge on — the host explores once at compile
+    time, which is exactly the right trade for the small registry
+    fixture configs this exists for."""
+    from ..actor.compile import compile_actor_model
+
+    return compile_actor_model(
+        paxos_model(cfg, network),
+        **paxos_device_specs(),
+        closure="reachable",
+    )

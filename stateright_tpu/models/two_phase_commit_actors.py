@@ -1,0 +1,191 @@
+"""Actor-model two-phase commit — the COMPILED 2pc encoding's source.
+
+The flagship 2pc workload (models/two_phase_commit.py) is a plain
+``Model`` with a hand-written device encoding; the actor→encoding
+compiler (actor/compile.py) can't see it. This module reformulates the
+protocol as actors so 2pc joins the compiled path (ROADMAP direction
+5: the compiled path held to the hand-encoding bar — the kernel-lint
+registry runs the full codegen rule set over this encoding,
+analysis/registry.py ``compiled-2pc-actors-rm2``):
+
+* each RM arms two timers at start: ``prepare`` (WORKING → PREPARED,
+  announce to the TM) and ``abort`` (WORKING → ABORTED silently) — the
+  two spontaneous RM actions of the TLA+ original;
+* the TM tallies ``Prepared`` announcements and holds two timers:
+  ``commit`` fires only when every RM has prepared (broadcast
+  ``Commit``), re-arming itself otherwise (the re-arm-only firing is
+  pruned by ``is_no_op_with_timer``, so the option stays open at zero
+  state-space cost), and ``abort`` (broadcast ``Abort``) while
+  undecided;
+* RMs obey the decision: ``Commit`` lands only on PREPARED rows,
+  ``Abort`` on anything undecided.
+
+NOT count-comparable to ``TwoPhaseSys``: message passing is explicit
+here (the plain model's ``msgs`` set is a shared bag), so the spaces
+differ by construction — the properties, not the counts, are the
+shared contract. The model is deliberately history-free
+(``init_history=None``), which doubles as the regression fixture for
+the compile.py history-table sentinel fix (a ``None`` history value
+used to read as "un-harvested" and hard-truncate every delivery).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..actor import Actor, ActorModel, Cow, Id, Network, Out
+from ..actor.base import model_timeout
+from ..model import Expectation
+
+#: RM local states (int-encoded: actor domains stay tiny and the
+#: device property specs compare codes directly).
+RM_WORKING, RM_PREPARED, RM_ABORTED, RM_COMMITTED = 0, 1, 2, 3
+#: TM phase codes (TM local state is ``(phase, prepared_bitmask)``).
+TM_INIT, TM_COMMITTED, TM_ABORTED = 0, 1, 2
+
+
+@dataclass(frozen=True)
+class Prepared:
+    rm: int
+
+
+@dataclass(frozen=True)
+class Commit:
+    pass
+
+
+@dataclass(frozen=True)
+class Abort:
+    pass
+
+
+class RmActor(Actor):
+    """One resource manager: spontaneous prepare/abort via timers,
+    decision messages from the TM."""
+
+    def __init__(self, tm_id: Id, index: int):
+        self.tm_id = tm_id
+        self.index = index
+
+    def on_start(self, id: Id, out: Out) -> int:
+        out.set_timer("prepare", model_timeout())
+        out.set_timer("abort", model_timeout())
+        return RM_WORKING
+
+    def on_timeout(self, id: Id, state: Cow, timer, out: Out) -> None:
+        s = state.value
+        if timer == "prepare" and s == RM_WORKING:
+            out.send(self.tm_id, Prepared(self.index))
+            state.set(RM_PREPARED)
+        elif timer == "abort" and s == RM_WORKING:
+            state.set(RM_ABORTED)
+        # decided states: plain no-op, pruned
+
+    def on_msg(self, id: Id, state: Cow, src: Id, msg, out: Out) -> None:
+        s = state.value
+        if isinstance(msg, Commit) and s == RM_PREPARED:
+            state.set(RM_COMMITTED)
+        elif isinstance(msg, Abort) and s in (RM_WORKING, RM_PREPARED):
+            state.set(RM_ABORTED)
+
+
+class TmActor(Actor):
+    """The transaction manager: tallies Prepared, decides by timer."""
+
+    def __init__(self, rm_ids: list[Id]):
+        self.rm_ids = rm_ids
+
+    def on_start(self, id: Id, out: Out):
+        out.set_timer("commit", model_timeout())
+        out.set_timer("abort", model_timeout())
+        return (TM_INIT, 0)
+
+    def on_msg(self, id: Id, state: Cow, src: Id, msg, out: Out) -> None:
+        tm, mask = state.value
+        if isinstance(msg, Prepared) and tm == TM_INIT:
+            state.set((tm, mask | (1 << msg.rm)))
+
+    def on_timeout(self, id: Id, state: Cow, timer, out: Out) -> None:
+        tm, mask = state.value
+        full = (1 << len(self.rm_ids)) - 1
+        if timer == "commit":
+            if tm == TM_INIT and mask == full:
+                out.broadcast(self.rm_ids, Commit())
+                state.set((TM_COMMITTED, mask))
+            else:
+                # keep the commit option armed; the re-arm-only firing
+                # is pruned (is_no_op_with_timer)
+                out.set_timer("commit", model_timeout())
+        elif timer == "abort" and tm == TM_INIT:
+            out.broadcast(self.rm_ids, Abort())
+            state.set((TM_ABORTED, mask))
+
+
+def two_phase_actor_model(rm_count: int) -> ActorModel:
+    """``rm_count`` RM actors (ids 0..rm_count-1) + the TM (last id).
+    ``cfg`` is the RM count, so host properties can slice
+    ``actor_states[:cfg]``."""
+    tm = Id(rm_count)
+    model = ActorModel(cfg=rm_count, init_history=None)
+    model.add_actors(RmActor(tm, i) for i in range(rm_count))
+    model = model.actor(TmActor([Id(i) for i in range(rm_count)]))
+    return (
+        model.init_network(Network.new_unordered_nonduplicating())
+        .property(
+            Expectation.ALWAYS,
+            "consistent",
+            lambda m, s: not (
+                any(x == RM_ABORTED for x in s.actor_states[: m.cfg])
+                and any(
+                    x == RM_COMMITTED for x in s.actor_states[: m.cfg]
+                )
+            ),
+        )
+        .property(
+            Expectation.SOMETIMES,
+            "all commit",
+            lambda m, s: all(
+                x == RM_COMMITTED for x in s.actor_states[: m.cfg]
+            ),
+        )
+        .property(
+            Expectation.SOMETIMES,
+            "some abort",
+            lambda m, s: any(
+                x == RM_ABORTED for x in s.actor_states[: m.cfg]
+            ),
+        )
+    )
+
+
+def two_phase_actor_device_specs(rm_count: int) -> dict:
+    """Device property specs for ``compile_actor_model`` — the exact
+    counterparts of the host properties above (the compiler requires a
+    spec per host property)."""
+
+    def rm_codes(ctx, jnp):
+        # per-actor state code; the TM (last actor, tuple-state
+        # domain) maps to 0 and is sliced off
+        return ctx.actor_values(
+            lambda i, s: s if i < rm_count else 0
+        )[:rm_count]
+
+    def consistent(ctx, jnp):
+        v = rm_codes(ctx, jnp)
+        return ~(
+            jnp.any(v == RM_ABORTED) & jnp.any(v == RM_COMMITTED)
+        )
+
+    def all_commit(ctx, jnp):
+        return jnp.all(rm_codes(ctx, jnp) == RM_COMMITTED)
+
+    def some_abort(ctx, jnp):
+        return jnp.any(rm_codes(ctx, jnp) == RM_ABORTED)
+
+    return dict(
+        properties={
+            "consistent": consistent,
+            "all commit": all_commit,
+            "some abort": some_abort,
+        }
+    )

@@ -642,4 +642,13 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             chunk, mesh=mesh, in_specs=(specs,), out_specs=chunk_out,
             **sm_kw,
         )
+        # Tooling hook (analysis/comms.py): the shard_map-wrapped wave
+        # body, re-traceable on the GLOBAL carry shapes — the hash
+        # engine's analog of the sort-merge engines' ``_wave_body_sm``,
+        # so comms-lint prices this engine's scatter-routed all_to_all
+        # path too. Never called by the run loop: no behavioral change.
+        self._wave_body_sm = shard_map(
+            body, mesh=mesh, in_specs=(specs,), out_specs=specs,
+            **sm_kw,
+        )
         return jax.jit(seed_sm), jax.jit(chunk_sm, donate_argnums=0)

@@ -786,6 +786,7 @@ def shard_balance(events: list[dict], run: int | None = None,
 
     per_wave: list[dict] = []
     routed_total = recv_total = 0
+    bound_rows_total = 0  # sum of S x dest_cap over waves (static cap)
     worst_frontier = worst_cand = None  # (skew, wave)
     worst_fill = None  # (util, fill, cap, wave)
     skew_wsum = skew_weight = 0.0  # size-weighted frontier skew
@@ -819,6 +820,7 @@ def shard_balance(events: list[dict], run: int | None = None,
         per_wave.append(m)
         routed_total += routed
         recv_total += recv
+        bound_rows_total += len(rows) * cap
         if sum(fr) >= len(rows) and m["frontier_skew"] is not None:
             if worst_frontier is None or m["frontier_skew"] > \
                     worst_frontier[0]:
@@ -915,6 +917,32 @@ def shard_balance(events: list[dict], run: int | None = None,
         routed_bytes_total=(
             routed_total * int(tile_lanes) * 4
             if tile_lanes else None
+        ),
+        # Static-vs-runtime comms reconciliation (round 13, PERF.md
+        # §comms-lint): the static side of the routed-byte accounting.
+        # row_bytes is the per-row price comms-lint derives from the
+        # compiled all_to_all operand (dest_tile_lanes x 4 — the COMM
+        # artifact's all_to_all_row_bytes; tests pin the two equal),
+        # so measured routed bytes ARE routed_rows x row_bytes, and
+        # bytes_bound_total is the static per-wave ceiling (S x
+        # dest_cap rows every wave — what the all_to_all physically
+        # exchanges regardless of fill). bound_util says how much of
+        # the static exchange carried real rows: the estimate vs
+        # measured bound the reconciliation states.
+        comms_static=(
+            dict(
+                row_bytes=tile_row_bytes,
+                bound_rows_total=bound_rows_total,
+                bytes_bound_total=bound_rows_total * tile_row_bytes,
+                measured_routed_bytes=(
+                    routed_total * tile_row_bytes
+                ),
+                bound_util=(
+                    round(routed_total / bound_rows_total, 4)
+                    if bound_rows_total else None
+                ),
+            )
+            if tile_row_bytes else None
         ),
         dest_fill_worst=(
             dict(util=worst_fill[0], fill=worst_fill[1],

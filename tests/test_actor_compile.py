@@ -733,3 +733,45 @@ def test_abd_ordered_2c3s_exhaustive_host_pin():
     )
     assert ck.unique_state_count() == 1212979
     assert sorted(ck.discoveries()) == ["value chosen"]
+
+
+def test_compiled_2pc_actors_matches_host():
+    """The actor-model 2pc (models/two_phase_commit_actors.py — the
+    registry's compiled-2pc fixture, ROADMAP direction 5) through the
+    compiler: count + discovery parity with host BFS, and the
+    consistency property holds. Doubles as the regression test for
+    the history-table sentinel fix: this model is history-FREE
+    (init_history=None), and the old `.get(key) is not None` lookup
+    read the legitimate None history value as "un-harvested",
+    hard-truncating every delivery on the first wave."""
+    from stateright_tpu.models.two_phase_commit_actors import (
+        two_phase_actor_device_specs,
+        two_phase_actor_model,
+    )
+
+    model = two_phase_actor_model(2)
+    enc = compile_actor_model(
+        model, **two_phase_actor_device_specs(2)
+    )
+    assert_matches_host(model, enc, 306)
+
+
+def test_compiled_paxos_matches_host():
+    """The compiled paxos encoding (models/paxos.py
+    paxos_compiled_encoded — the registry's compiled-paxos fixture):
+    the actor paxos model through the compiler in reachable mode,
+    count + discovery parity with host BFS at the registry config."""
+    from stateright_tpu.models.paxos import (
+        PaxosModelCfg,
+        paxos_compiled_encoded,
+        paxos_model,
+    )
+
+    cfg = PaxosModelCfg(client_count=1, server_count=2, put_count=1)
+    model = paxos_model(cfg)
+    enc = paxos_compiled_encoded(cfg)
+    host = model.checker().spawn_bfs().join()
+    tpu = spawn_compiled(model, enc).join()
+    assert tpu.unique_state_count() == host.unique_state_count()
+    assert sorted(tpu.discoveries()) == sorted(host.discoveries())
+    tpu.assert_properties()

@@ -154,7 +154,11 @@ def main():
             print(f"  {name:36s} {h['wall_bytes'] / 1e6:9.2f} MB")
 
     if args.json is not None:
-        from stateright_tpu.artifacts import artifact_path, provenance
+        from stateright_tpu.artifacts import (
+            artifact_path,
+            latest_comms_summary,
+            provenance,
+        )
 
         report["provenance"] = provenance(
             lane=dict(
@@ -164,6 +168,16 @@ def main():
                 hlo=args.hlo,
             )
         )
+        # the newest comms-lint artifact, by name (round 13): a LINT
+        # round and the communication contract it was measured beside
+        # pair up without hand-matching. Best effort — None when no
+        # COMM artifact exists yet.
+        comms_ref = latest_comms_summary()
+        if comms_ref is not None:
+            report["provenance"]["comms"] = {
+                "artifact": comms_ref["artifact"],
+                "clean": comms_ref["clean"],
+            }
         path = (
             artifact_path("LINT", "json")
             if args.json == "auto"

@@ -98,6 +98,25 @@ def format_report(bal: dict, max_waves: int) -> str:
             f"({df['util']:.0%}, wave {df['wave']}) vs the lossless "
             "Bd cap"
         )
+    cs = bal.get("comms_static")
+    if cs is not None:
+        # static-vs-runtime comms reconciliation (comms-lint, PERF.md
+        # §comms-lint): measured routed rows x the static per-row
+        # price vs the per-wave all_to_all exchange ceiling.
+        # bound_util is None on a trace whose waves all report
+        # dest_cap=0 (truncated/foreign traces) — the producer admits
+        # the case, so the report must too.
+        util = (
+            f"= {cs['bound_util']:.1%} of"
+            if cs["bound_util"] is not None else "vs"
+        )
+        lines.append(
+            f"comms static: {cs['row_bytes']} B/row routed-tile "
+            f"price; measured {cs['measured_routed_bytes'] / 1e6:.2f}"
+            f" MB {util} the "
+            f"{cs['bytes_bound_total'] / 1e6:.2f} MB static "
+            "all_to_all exchange bound"
+        )
     vis = bal["visited_per_shard"]
     cap = bal["shard_capacity"]
     occ = (

@@ -31,7 +31,13 @@ Every lane also embeds its ``memory_plan`` totals (the resident-buffer
 ledger, stateright_tpu/memplan.py) and — on traced lanes, where the
 watermark polls — the run's device peak bytes, so BENCH artifacts
 land with memory numbers attached the way they land with balance
-numbers.
+numbers. Round 14 adds the LATENCY axis: every device lane embeds its
+host-side dispatch/sync-floor wall split (``latency_accounting`` —
+kept even untraced) and the per-lane compile-cache ledger delta
+(XLA compile-or-fetch count, persistent-cache disk hits, total cold
+wall — ``checkers.tpu.compile_ledger_totals``), and the provenance
+block carries the process totals, so BENCH_r06's warm/cold A/B is
+attributable from the artifact alone.
 """
 
 import argparse
@@ -533,10 +539,27 @@ def main():
             f"clean={comms_ref['clean']}"
         )
 
+    # Compile-cache ledger (round 14, checkers/tpu.py): per-lane
+    # DELTAS of the process-cumulative compile-or-fetch counters, so
+    # each lane's detail names what it paid (cold compiles vs disk
+    # hits vs nothing) — the warm/cold attribution the BENCH_r06 chip
+    # A/B reads; the provenance block carries the process totals.
+    from stateright_tpu.checkers.tpu import compile_ledger_totals
+
+    def _ledger_delta(before, after):
+        return {
+            k: (round(after[k] - before[k], 6)
+                if isinstance(after[k], float)
+                else after[k] - before[k])
+            for k in ("compiles", "disk_hits", "cold_compiles",
+                      "compile_sec", "stage_sec")
+        }
+
     detail = {}
     headline_name, headline_sps = None, 0.0
     loads = tpu_workloads(quick=args.quick)
     for i, (name, spawn, hybrid_spawn, expected) in enumerate(loads):
+        ledger_before = compile_ledger_totals()
         # ONE definition of "the traced lane" (the headline), shared
         # by the tracing block and the shard_balance attachment below
         lane_traced = tracer is not None and i == len(loads) - 1
@@ -578,6 +601,35 @@ def main():
             **({"shuffle_volume": checker.metrics["shuffle_volume"]}
                if "shuffle_volume" in checker.metrics else {}),
         }
+        # Latency split (round 14): the lane's host dispatch vs
+        # sync-floor wall — measured untraced too — plus the lane's
+        # compile-cache ledger delta (cold AND warm runs: both ran
+        # inside this lane's bracket). The accounting describes the
+        # LAST (warm) run, so the share divides by THAT run's own
+        # wall (checker.duration_sec()), not the best-of-N `sec`:
+        # mixing runs could report sync_share > 1.
+        lat = (checker.latency_accounting()
+               if hasattr(checker, "latency_accounting") else None)
+        if lat is not None:
+            run_wall = checker.duration_sec()
+            detail[name]["latency"] = {
+                **lat,
+                "run_wall_sec": round(run_wall, 4),
+                "sync_share": (round(lat["fetch_sec"] / run_wall, 4)
+                               if run_wall else None),
+            }
+        ledger = _ledger_delta(ledger_before, compile_ledger_totals())
+        detail[name]["compile_cache"] = ledger
+        if args.verbose or ledger["compiles"]:
+            _stderr(
+                f"     compile-cache: {ledger['compiles']} "
+                f"compile-or-fetch ({ledger['disk_hits']} disk, "
+                f"{ledger['cold_compiles']} cold, "
+                f"{ledger['compile_sec']:.2f}s)"
+                + (f"; sync floor {lat['fetch_sec']:.3f}s over "
+                   f"{lat['chunks']} chunk(s)"
+                   if lat is not None else "")
+            )
         # Memory ledger (round 12, stateright_tpu/memplan.py): every
         # lane embeds its resident/staging plan totals — the engines
         # compute the plan untraced too (eval_shape, no device work)
@@ -729,6 +781,17 @@ def main():
                             and "device_peak_bytes"
                             in detail[headline_name]
                             else {}),
+                        # the headline's dispatch/sync-floor split +
+                        # the PROCESS compile-cache totals (round 14):
+                        # hit-tier counts and the total cold-compile
+                        # wall, so warm/cold attribution reads off
+                        # the artifact alone
+                        **({"latency":
+                                detail[headline_name]["latency"]}
+                           if headline_name in detail
+                           and "latency" in detail[headline_name]
+                           else {}),
+                        "compile_cache": compile_ledger_totals(),
                         **({"lint": lint_ref}
                            if lint_ref is not None else {}),
                         **({"comms": comms_ref}

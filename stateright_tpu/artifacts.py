@@ -24,6 +24,10 @@ shared counter — pairs it with a perf round. ``COMM_r*.json``
 pattern: a COMM artifact is the static communication contract at one
 commit, cross-referenced BY bench/lint artifacts
 (:func:`latest_comms_summary`) rather than sharing their counter.
+``LAT_r*.json`` (latency summaries, tools/latency_report.py /
+telemetry.write_latency_artifact) follows MEM's pattern exactly:
+derived from a TRACE, names it in its ``trace`` field, numbers in
+its own sequence (``next_round(root, stems=("LAT",))``).
 """
 
 from __future__ import annotations

@@ -234,7 +234,42 @@ class Checker:
         self._finished_at = time.monotonic()
         self._done = True
         if tracer is not None:
+            self._emit_settlement_verdicts(tracer)
             tracer.end_run(error=None, **self._run_stats())
+
+    def _emit_settlement_verdicts(self, tracer) -> None:
+        """Round-14 verdict timeline, the exhaustion half: a property
+        with NO discovery settles only when the configured search
+        completes (the same completion semantics assert_properties
+        applies — bounded closures count as complete). Discovery
+        verdicts land earlier, at the engines' own settle points (the
+        device chunk loop, the host checkers' ``_discover``); this
+        run-end sweep covers the rest, so every property of a clean
+        run has exactly one ``verdict`` event and time-to-verdict is
+        a measured number per property. Error paths skip it (a run
+        that raised settled nothing it didn't already emit), and so
+        do CANCELLED runs — the hybrid racer's losing side returns
+        early with partial results, and a partial search has not
+        exhausted anything."""
+        if getattr(self, "cancelled", False):
+            return
+        discovered = set(self._discoveries) | set(
+            getattr(self, "_discovered_fps", None) or {}
+        )
+        metrics = getattr(self, "metrics", None)
+        waves = (metrics.get("waves")
+                 if isinstance(metrics, dict) else None)
+        for prop in self.model.properties():
+            if prop.name in discovered:
+                continue
+            tracer.event(
+                "verdict",
+                property=prop.name,
+                expectation=prop.expectation.name.lower(),
+                kind="exhaustion",
+                wave=(int(waves) if waves is not None else None),
+                depth=self._max_depth,
+            )
 
     def _lane_config(self) -> dict:
         """The run's lane description, embedded in the trace

@@ -111,7 +111,7 @@ class BfsChecker(ParentTraceMixin, Checker):
                         if ebits & (1 << i) and prop.condition(model, state):
                             ebits &= ~(1 << i)
             for name in hit:
-                self._discover(name, fp)
+                self._discover(name, fp, depth=depth)
 
             if self._all_discovered():
                 break
@@ -146,7 +146,7 @@ class BfsChecker(ParentTraceMixin, Checker):
             if is_terminal and ebits:
                 for i, prop in enumerate(props):
                     if ebits & (1 << i):
-                        self._discover(prop.name, fp)
+                        self._discover(prop.name, fp, depth=depth)
 
             if reporter is not None:
                 now = time.monotonic()
@@ -264,7 +264,7 @@ class BfsChecker(ParentTraceMixin, Checker):
                     for fp, ebits, depth, disc, succs, term in results:
                         self._max_depth = max(self._max_depth, depth)
                         for name in disc:
-                            self._discover(name, fp)
+                            self._discover(name, fp, depth=depth)
                         for next_state, next_fp in succs:
                             self._total_states += 1
                             if next_fp not in self.generated:
@@ -275,7 +275,7 @@ class BfsChecker(ParentTraceMixin, Checker):
                                      depth + 1)
                                 )
                         for name in term:
-                            self._discover(name, fp)
+                            self._discover(name, fp, depth=depth)
                     if self._all_discovered() or (
                         target_states is not None
                         and self._unique_states >= target_states

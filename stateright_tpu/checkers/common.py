@@ -30,10 +30,21 @@ class ParentTraceMixin:
         fps.reverse()
         return fps
 
-    def _discover(self, name: str, fp: int) -> None:
+    def _discover(self, name: str, fp: int,
+                  depth: Optional[int] = None) -> None:
         if name not in self._discoveries:
             from .. import telemetry
 
+            # The verdict lands BEFORE reconstruction (round 14):
+            # time-to-verdict is when the search settled the
+            # property, not when its path finished materializing —
+            # the reconstruction wall has its own span below.
+            prop = self.model.property_by_name(name)
+            telemetry.emit(
+                "verdict", property=name,
+                expectation=prop.expectation.name.lower(),
+                kind="discovery", wave=None, depth=depth,
+            )
             with telemetry.span("counterexample_reconstruction",
                                 property=name):
                 self._discoveries[name] = Path.from_fingerprints(

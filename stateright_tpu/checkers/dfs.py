@@ -36,6 +36,14 @@ class DfsChecker(Checker):
         if name not in self._discoveries:
             from .. import telemetry
 
+            # verdict before reconstruction (round 14): the settle
+            # moment, not the path-materialization moment
+            prop = self.model.property_by_name(name)
+            telemetry.emit(
+                "verdict", property=name,
+                expectation=prop.expectation.name.lower(),
+                kind="discovery", wave=None, depth=len(trace),
+            )
             with telemetry.span("counterexample_reconstruction",
                                 property=name):
                 self._discoveries[name] = Path.from_fingerprints(

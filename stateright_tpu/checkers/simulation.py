@@ -167,4 +167,12 @@ class SimulationChecker(Checker):
         self, name: str, steps: list, final_state: State
     ) -> None:
         if name not in self._discoveries:
+            from .. import telemetry
+
+            prop = self.model.property_by_name(name)
+            telemetry.emit(
+                "verdict", property=name,
+                expectation=prop.expectation.name.lower(),
+                kind="discovery", wave=None, depth=len(steps),
+            )
             self._discoveries[name] = Path(list(steps) + [(final_state, None)])

@@ -430,6 +430,15 @@ class TpuSimulationChecker(TpuBfsChecker):
         dt_hi = stats[off + n_props + n_props * LT :]
         for i, prop in enumerate(props):
             if disc_found[i]:
+                if prop.name not in self._discovered_fps:
+                    from .. import telemetry
+
+                    telemetry.emit(
+                        "verdict", property=prop.name,
+                        expectation=prop.expectation.name.lower(),
+                        kind="discovery", wave=None,
+                        depth=self._max_depth,
+                    )
                 self._discovered_fps[prop.name] = _fp_int(
                     disc_lo[i], disc_hi[i]
                 )

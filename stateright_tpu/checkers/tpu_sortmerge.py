@@ -1,7 +1,8 @@
 """The adaptive sort-merge wave engine: dedup without scatters, sized
 to the running wave.
 
-TPU microbenchmarks (v5e, ``tools/profile_sortmerge.py``) show the hash
+TPU microbenchmarks (v5e, round 2; re-runnable at current shapes via
+``tools/profile_stages.py --micro``) show the hash
 table engine's cost profile is inverted on TPU hardware: arbitrary-
 index scatter/gather — the heart of GPU-style open-addressing
 (ops/hashset.py) — runs ~10ns/row, and a 21-step binary search over a
@@ -32,7 +33,8 @@ model-checking layout:
 **Adaptive wave sizing (round 3).** The round-2 engine compiled ONE
 wave program at worst-case shapes, so every wave paid peak cost: the
 2pc rm=8 profile showed a flat ~365ms/wave whether the wave produced
-2 or 244,342 new states (tools/profile_sortmerge.py), dominated by a
+2 or 244,342 new states (the round-2 wave profile; per-wave walls now
+come from ``--trace=deep`` + tools/latency_report.py), dominated by a
 22M-row sort over the full F×K candidate tensor and a 4M-row payload
 gather. This engine instead compiles a LADDER of wave-body variants
 and dispatches per wave with ``lax.switch`` — still inside the

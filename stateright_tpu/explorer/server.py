@@ -25,6 +25,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path as FsPath
 from typing import Optional
 
+from .. import telemetry
 from ..checker import CheckerBuilder
 from ..fingerprint import fingerprint
 from ..model import Expectation
@@ -96,6 +97,35 @@ def status_view(checker, snapshot: Optional[Snapshot] = None) -> dict:
         "unique_state_count": checker.unique_state_count(),
         "max_depth": checker.max_depth(),
         "properties": get_properties(checker),
+        "recent_path": snapshot.recent_path() if snapshot else None,
+    }
+
+
+def _live_status_view(checker, snapshot: Optional[Snapshot]) -> dict:
+    """The HTTP handler's status snapshot: live counter/discovery
+    attributes only — no accessor, so no ``_ensure_run`` trigger and
+    no need for the checker lock. A status poll during an in-flight
+    ``run_to_completion`` must show incremental progress instead of
+    queueing behind the whole exhaustive search (and before the
+    handler lock existed, the accessor path could re-enter the
+    running search from another thread). Reads of live attributes
+    are GIL-atomic; the values are a consistent-enough snapshot for
+    a progress display."""
+    props = []
+    for prop in checker.model.properties():
+        disc = checker._discoveries.get(prop.name)
+        props.append([
+            _EXPECTATION[prop.expectation],
+            prop.name,
+            disc.encode() if disc is not None else None,
+        ])
+    return {
+        "done": checker.is_done(),
+        "model": type(checker.model).__name__,
+        "state_count": checker._total_states,
+        "unique_state_count": checker._unique_states,
+        "max_depth": checker._max_depth,
+        "properties": props,
         "recent_path": snapshot.recent_path() if snapshot else None,
     }
 
@@ -191,6 +221,13 @@ def serve(builder: CheckerBuilder, addr: str):
 def make_server(checker, snapshot, host: str, port: int) -> ThreadingHTTPServer:
     """Build (without starting) the HTTP server — separable for tests."""
 
+    # One lock serializes every handler section that touches checker
+    # state: the on-demand checker's dicts are not thread-safe under
+    # ThreadingHTTPServer's per-request threads, and the round-14
+    # cache-hit derivation (unique-count before/after) would misread
+    # a concurrent request's exploration as its own cache miss.
+    checker_lock = threading.Lock()
+
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):  # quiet
             pass
@@ -210,8 +247,25 @@ def make_server(checker, snapshot, host: str, port: int) -> ThreadingHTTPServer:
             self.end_headers()
             self.wfile.write(body)
 
+        # Request telemetry (round 14, the first metering brick for
+        # ROADMAP direction 4's resident service): every handler runs
+        # inside an ``explorer_request`` span — per-request wall plus
+        # the cache-hit state (whether the request was served entirely
+        # from already-explored states or pulled new ones into the
+        # on-demand search). The span API's no-op path keeps untraced
+        # serving cost-free; with a process tracer active each request
+        # lands as one span event in the TRACE artifact.
+
         def do_GET(self):
+            with telemetry.span(
+                "explorer_request", method="GET",
+                path=self.path.split("?", 1)[0],
+            ) as meta:
+                self._get(meta)
+
+        def _get(self, meta):
             if self.path in _UI_FILES:
+                meta["kind"] = "ui"
                 name, ctype = _UI_FILES[self.path]
                 data = (_UI_DIR / name).read_bytes()
                 self.send_response(200)
@@ -220,23 +274,55 @@ def make_server(checker, snapshot, host: str, port: int) -> ThreadingHTTPServer:
                 self.end_headers()
                 self.wfile.write(data)
             elif self.path == "/.status":
-                self._json(status_view(checker, snapshot))
+                # a status poll never explores: always a cache hit —
+                # and deliberately LOCK-FREE (live attributes only),
+                # so progress polls keep answering while a
+                # run_to_completion holds the checker lock
+                meta["kind"], meta["cache_hit"] = "status", True
+                self._json(_live_status_view(checker, snapshot))
             elif self.path.startswith("/.states"):
-                views, err = state_views(checker, self.path[len("/.states"):])
+                meta["kind"] = "states"
+                # ``_unique_states`` is a live attribute (no run
+                # trigger): unchanged across the handler means every
+                # browsed fingerprint was already explored (the lock
+                # keeps a concurrent request's exploration out of
+                # this request's delta)
+                with checker_lock:
+                    before = checker._unique_states
+                    views, err = state_views(
+                        checker, self.path[len("/.states"):]
+                    )
+                    meta["cache_hit"] = (
+                        checker._unique_states == before
+                    )
                 if err is not None:
+                    meta["error"] = err
                     self._err(err)
                 else:
+                    meta["states"] = len(views)
                     self._json(views)
             else:
+                meta["error"] = "not found"
                 self._err("not found")
 
         def do_POST(self):
-            if self.path == "/.runtocompletion":
-                checker.run_to_completion()
-                self.send_response(200)
-                self.send_header("Content-Length", "0")
-                self.end_headers()
-            else:
-                self._err("not found")
+            with telemetry.span(
+                "explorer_request", method="POST",
+                path=self.path.split("?", 1)[0],
+            ) as meta:
+                if self.path == "/.runtocompletion":
+                    meta["kind"] = "run_to_completion"
+                    with checker_lock:
+                        before = checker._unique_states
+                        checker.run_to_completion()
+                        meta["cache_hit"] = (
+                            checker._unique_states == before
+                        )
+                    self.send_response(200)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                else:
+                    meta["error"] = "not found"
+                    self._err("not found")
 
     return ThreadingHTTPServer((host, port), Handler)

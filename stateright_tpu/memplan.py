@@ -208,7 +208,8 @@ def _analysis_store() -> str:
 
 
 def compiled_memory_analysis(chunk_fn, carry_spec,
-                             cache_token) -> Optional[dict]:
+                             cache_token,
+                             on_build=None) -> Optional[dict]:
     """``memory_analysis()`` of an engine's compiled chunk program,
     via an AOT ``lower().compile()`` the persistent XLA compile cache
     dedups against the dispatch-path compile. Results are cached in
@@ -219,7 +220,19 @@ def compiled_memory_analysis(chunk_fn, carry_spec,
     REPORT the analysis caches its None (that answer is stable); a
     FAILED lower/compile returns None without caching, so a
     transient failure (interrupted process, device busy) doesn't
-    permanently disable the lane for that config."""
+    permanently disable the lane for that config.
+
+    ``on_build`` (round 14, the compile-cache ledger): called exactly
+    once per RESOLVED lookup as ``on_build(tier, wall_sec)`` —
+    ``"in_process"`` / ``"disk"`` for this module's result caches,
+    ``"aot"`` when the AOT lower+compile actually ran (the caller
+    refines that tier from its compile monitor: the AOT pass itself
+    may hit the persistent XLA cache). Not called on the degrade
+    paths (no jax, failed compile) — those produced nothing to
+    ledger."""
+    import time as _time
+
+    t0 = _time.monotonic()
     try:
         import jax
 
@@ -229,6 +242,8 @@ def compiled_memory_analysis(chunk_fn, carry_spec,
     except Exception:
         return None
     if key in _ANALYSIS_CACHE:
+        if on_build is not None:
+            on_build("in_process", _time.monotonic() - t0)
         return _ANALYSIS_CACHE[key]
     # disk: survives processes the way the XLA cache does
     store = _analysis_store()
@@ -237,6 +252,8 @@ def compiled_memory_analysis(chunk_fn, carry_spec,
             disk = json.load(fh)
         if key in disk:
             _ANALYSIS_CACHE[key] = disk[key]
+            if on_build is not None:
+                on_build("disk", _time.monotonic() - t0)
             return disk[key]
     except (OSError, ValueError):
         pass
@@ -244,6 +261,8 @@ def compiled_memory_analysis(chunk_fn, carry_spec,
         compiled = chunk_fn.lower(carry_spec).compile()
     except Exception:
         return None  # transient: retry on the next traced run
+    if on_build is not None:
+        on_build("aot", _time.monotonic() - t0)
     result = compiled_memory(compiled)
     _ANALYSIS_CACHE[key] = result
     try:

@@ -44,8 +44,9 @@ Each op ships two implementations, selected by the engines'
 * ``impl="xla"`` — a pure-XLA O(B log V + M) fallback for CPU and
   old-JAX paths: membership is a vectorized 2-limb binary search
   (log₂ V unrolled gather steps — fast on CPU where the sequential
-  gathers are cache-friendly, catastrophic on TPU per the
-  tools/profile_sortmerge.py microbenchmarks, which is exactly why
+  gathers are cache-friendly, catastrophic on TPU per the round-5
+  primitive microbenchmarks — PERF.md "Primitive costs", re-runnable
+  via ``tools/profile_stages.py --micro`` — which is exactly why
   the Pallas path exists); the merge computes winner destinations by
   binary search, scatters the ≤F winner flags, and assembles the
   merged array with one cumsum + two gathers — no sort anywhere.

@@ -40,6 +40,25 @@ on — PAPERS.md: arXiv 1801.05857, 1203.6806):
   the context-manager API (:func:`span` / :meth:`RunTracer.phase_acc`)
   used by checker.py and the host checkers. When no tracer is active
   every hook is a no-op.
+* **Latency events** (round 14, the latency observability layer —
+  where the *wall-clock* goes, the axis the counters above don't
+  cover): ``program_build`` events from the compile-cache ledger in
+  checkers/tpu.py (every build-or-fetch at the ``_programs`` cache
+  seam, the dispatch-path XLA compiles, and the AOT memory-analysis
+  compile — hit tier in_process / disk / cold with the measured cold
+  wall, via ``jax.monitoring``), per-property ``verdict`` events
+  (discovery vs exhaustion, settle wave/depth, wall since run start —
+  the time-to-verdict metric ROADMAP direction 4 declares
+  first-class), and a run-end ``latency_profile`` event the tracer
+  derives ITSELF in :meth:`RunTracer.end_run` from the run's chunk /
+  span / build events (time-to-first-wave, the dispatch / sync-floor
+  wall split and shares, compile attribution) — so every engine that
+  records chunks gets the profile with zero engine-side code.
+  :func:`latency_summary` derives the report tools/latency_report.py
+  renders (``LAT_r*.json``, own round sequence like MEM/COMM);
+  :func:`diff_traces` aligns ``latency_profile`` lanes and
+  per-property time-to-verdict under the threshold — sides without
+  latency events skip, so pre-round-14 baselines keep diffing.
 * **Memory events** (round 12, the memory observability layer —
   stateright_tpu/memplan.py): one schema-validated ``memory_plan``
   event per run (the resident-buffer ledger + per-ladder-class
@@ -120,6 +139,24 @@ SHARD_LOG_FIELDS = (
     "visited_total",   # this shard's visited count AFTER the wave
 )
 
+#: compile-cache hit tiers a ``program_build`` event may carry
+#: (the round-14 compile-cache ledger, checkers/tpu.py):
+#: ``in_process`` — served from a same-process cache (the engine's
+#: ``_programs`` cache, jit's executable cache, or the memory-analysis
+#: result cache) with no XLA work; ``disk`` — the persistent XLA
+#: compile cache loaded the executable (wall = retrieval);
+#: ``cold`` — a real backend compile (wall = the multi-second cost
+#: warm/cold A/Bs attribute); ``mixed`` — one seam covered both
+#: (e.g. seed cold + chunk disk in one window); ``unknown`` — the
+#: ``jax.monitoring`` hooks were unavailable, tier undecidable.
+BUILD_TIERS = ("in_process", "disk", "cold", "mixed", "unknown")
+
+#: what a ``verdict`` event settles as: ``discovery`` — the property
+#: found its example/counterexample state; ``exhaustion`` — the search
+#: completed without one (an always-property that HOLDS settles only
+#: here, which is why time-to-verdict != time-to-first-hit).
+VERDICT_KINDS = ("discovery", "exhaustion")
+
 _ACTIVE: Optional["RunTracer"] = None
 _ACTIVE_LOCK = threading.Lock()
 
@@ -130,9 +167,26 @@ def current_tracer() -> Optional["RunTracer"]:
     return _ACTIVE
 
 
+class _DiscardMeta(dict):
+    """The no-op span's meta sink: span bodies may attach fields
+    discovered mid-span (the Explorer request handlers' cache-hit
+    state) — with no tracer active, writes are discarded outright so
+    the shared instance never grows and the untraced hot loops (one
+    ``with _NULL_SPAN`` per explored state in the host checkers)
+    stay allocation-free."""
+
+    __slots__ = ()
+
+    def __setitem__(self, key, value):
+        pass
+
+
+_NULL_META = _DiscardMeta()
+
+
 class _NullSpan:
     def __enter__(self):
-        return self
+        return _NULL_META
 
     def __exit__(self, *exc):
         return False
@@ -267,17 +321,122 @@ class RunTracer:
                 dict(ev="phase_total", run=self._run_idx, phase=phase,
                      dur=round(dur, 6), count=count)
             )
+        prof = self._derive_latency_profile(stats.get("duration_sec"))
+        if prof is not None:
+            self._append(
+                dict(ev="latency_profile", run=self._run_idx,
+                     t=round(self._now(), 6), **prof)
+            )
         self.event("run_end", error=error,
                    **{k: v for k, v in stats.items()})
         self._run_open = False
+
+    def _derive_latency_profile(self, run_wall) -> Optional[dict]:
+        """The run-end wall-clock attribution (the round-14 latency
+        layer), derived here — the one place every engine passes
+        through — from the run's own chunk / span / ``program_build``
+        events, so any engine that records chunks gets the profile
+        with zero engine-side accumulation. None for runs without
+        chunk events (host checkers: their wall lives in spans and
+        phase totals already).
+
+        The lanes are ATTRIBUTIONS over one run wall, not a disjoint
+        partition: a cold chunk compile is counted in the compile
+        block AND physically sits inside chunk 0's ``dispatch_sec``
+        (``dispatch_net_sec`` is dispatch with the ledger-attributed
+        compile walls subtracted — the lane trace_diff compares, so a
+        forced cold compile flags as compile, not as dispatch).
+
+        The profile covers the WHOLE run — including auto-budget
+        retry attempts, whose recompiles and re-explored chunks are
+        genuinely where the run's wall went (``attempts`` counts
+        them, from chunks restarting at wave 0). The untraced
+        ``checker.latency_accounting()`` deliberately differs: it
+        resets per attempt and reports the FINAL one (the bench
+        lane's converged-budget number)."""
+        with self._lock:
+            evs = [e for e in self.events
+                   if e.get("run") == self._run_idx]
+        chunks = [e for e in evs if e["ev"] == "chunk"]
+        if not chunks:
+            return None
+        begin = next((e for e in evs if e["ev"] == "run_begin"), None)
+        t_run0 = (begin or {}).get("t", 0.0)
+        disp = sum(c["dispatch_sec"] for c in chunks)
+        fetch = sum(c["fetch_sec"] for c in chunks)
+        dev = sum(c.get("device_sec") or 0.0 for c in chunks)
+        chunk_wall = sum(c["t1"] - c["t0"] for c in chunks)
+        waves = sum(c.get("waves") or 0 for c in chunks)
+        if run_wall is None:
+            run_wall = max(
+                max((c["t1"] for c in chunks)) - t_run0, 0.0
+            )
+        builds = [e for e in evs if e["ev"] == "program_build"]
+        tiers: dict[str, int] = {}
+        for b in builds:
+            tiers[b["tier"]] = tiers.get(b["tier"], 0) + 1
+        cold = sum(b.get("cold_sec") or 0.0 for b in builds)
+        build_wall = sum(b.get("wall_sec") or 0.0 for b in builds)
+        chunk_compile = sum(
+            b.get("wall_sec") or 0.0 for b in builds
+            if b.get("program") == "chunk"
+        )
+        compile_span = sum(
+            s["dur"] for s in evs
+            if s["ev"] == "span" and s["phase"] == "compile"
+        )
+        return dict(
+            chunks=len(chunks),
+            waves=waves,
+            attempts=sum(
+                1 for c in chunks if c.get("wave0") == 0
+            ) or 1,
+            run_wall_sec=round(run_wall, 6),
+            # when the FIRST wave's results became host-visible: the
+            # end of chunk 0's blocking readback, relative to
+            # run_begin (covers compile + seed upload + first chunk)
+            time_to_first_wave_sec=round(chunks[0]["t1"] - t_run0, 6),
+            dispatch_sec=round(disp, 6),
+            dispatch_net_sec=round(max(disp - chunk_compile, 0.0), 6),
+            # the sync floor: host wall blocked at the per-chunk
+            # stats readback (at level="default" this includes the
+            # device wait hidden behind the sync — the honest number
+            # for "what the host paid at the sync seam")
+            fetch_sec=round(fetch, 6),
+            fetch_min_sec=round(
+                min(c["fetch_sec"] for c in chunks), 6
+            ),
+            device_sec=(round(dev, 6) if dev else None),
+            chunk_wall_sec=round(chunk_wall, 6),
+            # host wall OUTSIDE the chunk brackets: per-chunk host
+            # bookkeeping, reporter callbacks, compile/seed spans
+            interchunk_sec=round(max(run_wall - chunk_wall, 0.0), 6),
+            sync_share=(round(fetch / run_wall, 4)
+                        if run_wall else None),
+            dispatch_share=(round(disp / run_wall, 4)
+                            if run_wall else None),
+            overlap_share=(round(dev / chunk_wall, 4)
+                           if dev and chunk_wall else None),
+            compile=dict(
+                span_sec=round(compile_span, 6),
+                build_wall_sec=round(build_wall, 6),
+                cold_sec=round(cold, 6),
+                builds=tiers,
+                share=(round((compile_span + build_wall) / run_wall, 4)
+                       if run_wall else None),
+            ),
+        )
 
     # -- spans / accumulators -------------------------------------------
 
     @contextmanager
     def span(self, phase: str, **meta):
+        """Yields the span's meta dict: fields added to it inside the
+        block land on the emitted event (for meta only known mid-span,
+        e.g. a request handler's cache-hit state)."""
         t0 = self._now()
         try:
-            yield self
+            yield meta
         finally:
             t1 = self._now()
             self._append(
@@ -471,6 +630,14 @@ class RunTracer:
                              ts=us(ev["t1"]),
                              args=dict(bytes=ev["mem_bytes"]))
                     )
+                # sync-floor counter track (round 14): the per-chunk
+                # host-blocked wall next to the frontier curve, so a
+                # sync-floor regression is visible as a raised floor
+                out.append(
+                    dict(ph="C", pid=0, name="host_blocked_ms",
+                         ts=us(ev["t1"]),
+                         args=dict(ms=round(ev["fetch_sec"] * 1e3, 3)))
+                )
             elif kind == "wave":
                 args = {k: ev[k] for k in WAVE_LOG_FIELDS}
                 args["t_est"] = ev["t_est"]
@@ -489,6 +656,17 @@ class RunTracer:
                     dict(ph="C", pid=0, name="new_states",
                          ts=us(ev["t0"]),
                          args=dict(new=ev["new_states"]))
+                )
+            elif kind == "verdict":
+                # verdicts as global instants on the host track: the
+                # per-property settle moments read directly off the
+                # timeline (the time-to-verdict markers)
+                out.append(
+                    dict(ph="i", pid=0, tid=0, s="g",
+                         name=f"verdict {ev['property']}",
+                         ts=us(ev.get("t", 0.0)),
+                         args={k: v for k, v in ev.items()
+                               if k not in ("ev", "t")})
                 )
             elif kind in ("run_begin", "run_end", "phase_total"):
                 out.append(
@@ -553,6 +731,19 @@ _REQUIRED = {
                     "classes", "compiled", "total_bytes"),
     "memory_watermark": ("run", "source", "device_peak_bytes",
                          "headroom", "projection"),
+    # The latency observability layer (round 14). ``program_build`` —
+    # one compile-cache ledger row per build-or-fetch (checkers/
+    # tpu.py); ``verdict`` — one per property settle (device chunk
+    # loop, host _discover, and the run-end exhaustion sweep);
+    # ``latency_profile`` — the run-end wall attribution the tracer
+    # derives itself (RunTracer._derive_latency_profile). All three
+    # are whole new event types, so their contracts are required
+    # outright; pre-round-14 traces simply don't carry them.
+    "program_build": ("run", "program", "tier", "wall_sec"),
+    "verdict": ("run", "property", "expectation", "kind", "t"),
+    "latency_profile": ("run", "chunks", "waves", "run_wall_sec",
+                        "time_to_first_wave_sec", "dispatch_sec",
+                        "fetch_sec", "sync_share", "compile"),
 }
 
 
@@ -659,6 +850,18 @@ def validate_events(events: list[dict]) -> None:
                     f"{ev['resident_bytes']} != sum of resident "
                     f"entry bytes {tot}"
                 )
+        elif kind == "program_build":
+            if ev["tier"] not in BUILD_TIERS:
+                raise ValueError(
+                    f"event {i}: program_build tier {ev['tier']!r} "
+                    f"not in {BUILD_TIERS}"
+                )
+        elif kind == "verdict":
+            if ev["kind"] not in VERDICT_KINDS:
+                raise ValueError(
+                    f"event {i}: verdict kind {ev['kind']!r} "
+                    f"not in {VERDICT_KINDS}"
+                )
 
 
 def _runs(events: list[dict]) -> list[int]:
@@ -669,7 +872,8 @@ def _run_view(events: list[dict], run: int) -> dict:
     view: dict = dict(run=run, begin=None, end=None, waves=[],
                       chunks=[], spans=[], phase_totals={},
                       shard_waves={}, memory_plan=None,
-                      memory_watermark=None)
+                      memory_watermark=None, latency_profile=None,
+                      builds=[], verdicts=[])
     for ev in events:
         if ev.get("run") != run:
             continue
@@ -678,8 +882,13 @@ def _run_view(events: list[dict], run: int) -> dict:
             view["begin"] = ev
         elif kind == "run_end":
             view["end"] = ev
-        elif kind in ("memory_plan", "memory_watermark"):
+        elif kind in ("memory_plan", "memory_watermark",
+                      "latency_profile"):
             view[kind] = ev  # one per run; last occurrence wins
+        elif kind == "program_build":
+            view["builds"].append(ev)
+        elif kind == "verdict":
+            view["verdicts"].append(ev)
         elif kind == "wave":
             view["waves"].append(ev)
         elif kind == "shard_wave":
@@ -1011,6 +1220,83 @@ def memory_summary(events: list[dict], run: int | None = None,
     )
 
 
+# -- latency observability: the derived ledger/floor/verdict summary -----
+
+
+def latency_summary(events: list[dict], run: int | None = None,
+                    ) -> Optional[dict]:
+    """Derive one run's latency view from its ``latency_profile`` /
+    ``program_build`` / ``verdict`` events and the host-phase spans —
+    the data behind tools/latency_report.py and the ``LAT_r*.json``
+    artifacts. Returns None when the run carries no latency events (a
+    pre-round-14 trace) — latency_report exits 2 on that.
+
+    ``run`` defaults to the LAST run in the event stream (bench/CLI
+    trace warm-run-last, so the default view is the warm one).
+    Verdict walls are re-based to the run's own start (``t_since_run``)
+    so time-to-verdict reads per run, not per process."""
+    runs = _runs(events)
+    if not runs:
+        return None
+    view = _run_view(events, runs[-1] if run is None else run)
+    prof = view["latency_profile"]
+    builds = view["builds"]
+    verdicts = view["verdicts"]
+    if prof is None and not builds and not verdicts:
+        return None
+    lane = (view["begin"] or {}).get("lane") or {}
+    t0 = (view["begin"] or {}).get("t", 0.0)
+    vrows = [
+        dict(
+            {k: v for k, v in ev.items()
+             if k not in ("ev", "run", "t")},
+            t_since_run=round(ev["t"] - t0, 6),
+        )
+        for ev in verdicts
+    ]
+    phases = {
+        k: round(v, 6) for k, v in _phase_durations(view).items()
+    }
+    return dict(
+        run=view["run"],
+        engine=lane.get("engine"),
+        lane={k: lane[k] for k in
+              ("engine", "model", "encoding", "capacity",
+               "frontier_capacity", "cand_capacity", "n_shards",
+               "waves_per_sync", "track_paths", "merge_impl")
+              if k in lane},
+        profile=_strip_ev(prof),
+        builds=[_strip_ev(b) for b in builds],
+        verdicts=vrows,
+        phases=phases,
+        error=(view["end"] or {}).get("error"),
+    )
+
+
+def write_latency_artifact(summary: dict, root: str | None = None,
+                           ) -> str:
+    """Write one auto-numbered ``LAT_r*.json`` artifact (the latency
+    summary of one traced run, tools/latency_report.py's ``--json``
+    output). LAT numbers in its OWN round sequence (``LAT_r01`` first)
+    like MEM/COMM: a LAT artifact is *derived from* a TRACE and names
+    it in its ``trace`` field, so the cross-reference — not a shared
+    counter — pairs it with a perf round."""
+    from .artifacts import artifact_path, next_round, provenance, \
+        repo_root
+
+    root = repo_root() if root is None else root
+    path = artifact_path(
+        "LAT", "json", root=root,
+        round=next_round(root, stems=("LAT",)),
+    )
+    doc = dict(summary)
+    doc.setdefault("provenance", provenance())
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
 #: wave counters trace_diff requires to MATCH between the two sides —
 #: two traces of the same workload must explore the same space.
 DIFF_COUNTERS = ("frontier_rows", "candidates", "new_states",
@@ -1170,6 +1456,105 @@ def _memory_diff(va: dict, vb: dict, threshold: float) -> dict:
                 regressions=regressions)
 
 
+#: latency_profile lanes _latency_diff compares (flat float fields;
+#: the compile block gets its own lanes below). ``dispatch_net_sec``
+#: — not raw dispatch — is the regression lane: a forced cold compile
+#: physically sits inside chunk 0's dispatch, and the ledger
+#: subtraction is what lets the diff attribute it to compile instead.
+LATENCY_DIFF_LANES = (
+    "time_to_first_wave_sec",
+    "dispatch_net_sec",
+    "fetch_sec",
+    "chunk_wall_sec",
+    "interchunk_sec",
+    "run_wall_sec",
+)
+
+
+def _latency_diff(va: dict, vb: dict, threshold: float,
+                  min_sec: float) -> dict:
+    """Latency alignment between two runs (the round-14 layer): the
+    ``latency_profile`` wall lanes and the compile attribution compare
+    RELATIVE under ``threshold``, and per-property time-to-verdict
+    lanes ride along — with the verdict KIND (discovery vs exhaustion)
+    treated as a counter: two runs of one workload must settle every
+    property the same way, so a kind flip is a divergence, not a
+    timing delta.
+
+    A side with NO latency events at all (a pre-round-14 baseline
+    trace) is simply not comparable on this axis: the diff skips it
+    rather than failing the gate, so A/Bs against committed old
+    baselines keep working — the memory diff's compatibility contract.
+
+    The regression rule differs from the phase table's on purpose:
+    a lane regresses when ``b - a > max(min_sec, threshold * a)`` —
+    the relative bar everywhere, but an ABSOLUTE ``min_sec`` growth is
+    enough on a near-zero baseline (a 0.3 s injected sync stall on a
+    10 ms warm fetch floor, a multi-second cold compile against a
+    0-second warm ledger: both must flag, and pure a>=min_sec gating
+    would skip exactly those)."""
+    pa, pb = va["latency_profile"], vb["latency_profile"]
+    lanes: dict = {}
+    regressions: list[str] = []
+    divergences: list[dict] = []
+
+    def lane(name, a, b):
+        if a is None or b is None:
+            return
+        rel = (b - a) / a if a > 0 else (
+            float("inf") if b > 0 else 0.0
+        )
+        lanes[name] = dict(
+            a=round(a, 6), b=round(b, 6), delta=round(b - a, 6),
+            rel=round(rel, 4) if rel != float("inf") else None,
+        )
+        if b - a > max(min_sec, threshold * a):
+            regressions.append(name)
+
+    if pa is not None and pb is not None:
+        for name in LATENCY_DIFF_LANES:
+            lane(name, pa.get(name), pb.get(name))
+        ca, cb = pa.get("compile") or {}, pb.get("compile") or {}
+        lane("compile_cold_sec", ca.get("cold_sec"),
+             cb.get("cold_sec"))
+        lane("compile_total_sec",
+             (ca.get("span_sec", 0.0) + ca.get("build_wall_sec", 0.0)
+              if ca else None),
+             (cb.get("span_sec", 0.0) + cb.get("build_wall_sec", 0.0)
+              if cb else None))
+
+    # per-property time-to-verdict: last settle per property wins
+    # (auto-budget retries re-settle inside one run; the final
+    # attempt's verdict is the run's answer)
+    def vmap_of(view):
+        t0 = (view["begin"] or {}).get("t", 0.0)
+        out = {}
+        for ev in view["verdicts"]:
+            out[ev["property"]] = (ev["kind"],
+                                   round(ev["t"] - t0, 6))
+        return out
+
+    va_v, vb_v = vmap_of(va), vmap_of(vb)
+    if va_v and vb_v:
+        for prop in sorted(set(va_v) | set(vb_v)):
+            if (prop in va_v) != (prop in vb_v):
+                divergences.append(dict(
+                    field="verdict_present", property=prop,
+                    a=prop in va_v, b=prop in vb_v,
+                ))
+                continue
+            (ka, ta), (kb, tb) = va_v[prop], vb_v[prop]
+            if ka != kb:
+                divergences.append(dict(
+                    field="verdict_kind", property=prop, a=ka, b=kb,
+                ))
+                continue
+            lane(f"verdict:{prop}", ta, tb)
+
+    return dict(divergences=divergences, lanes=lanes,
+                regressions=regressions)
+
+
 def diff_traces(
     a_events: list[dict],
     b_events: list[dict],
@@ -1192,8 +1577,12 @@ def diff_traces(
       ``memory`` — the memory-counter alignment (:func:`_memory_diff`:
         plan shapes exact, measured temp/live bytes under
         ``threshold``),
-      ``ok`` — True iff no divergence and no regression (timing OR
-        memory).
+      ``latency`` — the latency alignment (:func:`_latency_diff`:
+        latency_profile wall lanes + per-property time-to-verdict
+        under ``threshold``; verdict-kind flips are divergences;
+        sides without latency events skip),
+      ``ok`` — True iff no divergence and no regression (timing,
+        memory, OR latency).
 
     ``run_a``/``run_b`` default to the LAST run in each file (bench
     traces warm-run-last)."""
@@ -1236,6 +1625,7 @@ def diff_traces(
             regressions.append(phase)
 
     memory = _memory_diff(va, vb, threshold)
+    latency = _latency_diff(va, vb, threshold, min_sec)
     return dict(
         run_a=va["run"], run_b=vb["run"],
         waves_a=len(va["waves"]), waves_b=len(vb["waves"]),
@@ -1243,11 +1633,14 @@ def diff_traces(
         phases=phases,
         regressions=regressions,
         memory=memory,
+        latency=latency,
         threshold=threshold,
         min_sec=min_sec,
         ok=(not divergences and not regressions
             and not memory["divergences"]
-            and not memory["regressions"]),
+            and not memory["regressions"]
+            and not latency["divergences"]
+            and not latency["regressions"]),
     )
 
 
@@ -1304,11 +1697,33 @@ def format_diff(report: dict) -> str:
             f"{name:28s} {p['a']:10d} {p['b']:10d} "
             f"{p['delta']:+10d} {rel:>8s}{flag}"
         )
+    lat = report.get("latency") or {}
+    if lat.get("divergences"):
+        lines.append(
+            f"VERDICT DIVERGENCE ({len(lat['divergences'])} "
+            "mismatches) — the two runs settled properties "
+            "differently:"
+        )
+        for d in lat["divergences"][:10]:
+            lines.append(
+                f"  {d['field']:16s} {d.get('property', ''):24s} "
+                f"A={d['a']} B={d['b']}"
+            )
+    for name, p in (lat.get("lanes") or {}).items():
+        rel = "n/a" if p["rel"] is None else f"{p['rel']:+.1%}"
+        flag = ("  <-- REGRESSION"
+                if name in lat.get("regressions", ()) else "")
+        lines.append(
+            f"{name:28s} {p['a']:10.4f} {p['b']:10.4f} "
+            f"{p['delta']:+10.4f} {rel:>8s}{flag}"
+        )
     mem_regs = mem.get("regressions") or []
+    lat_regs = lat.get("regressions") or []
     verdict = "OK" if report["ok"] else (
         "FAIL: wave divergence" if report["divergences"]
         else "FAIL: memory-plan divergence" if mem.get("divergences")
-        else f"FAIL: {len(report['regressions']) + len(mem_regs)} "
+        else "FAIL: verdict divergence" if lat.get("divergences")
+        else f"FAIL: {len(report['regressions']) + len(mem_regs) + len(lat_regs)} "
              f"lane(s) past +{report['threshold']:.0%}"
     )
     lines.append(f"verdict: {verdict}")

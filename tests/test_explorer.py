@@ -121,6 +121,62 @@ def test_http_round_trip():
         server.shutdown()
 
 
+def test_request_telemetry_spans():
+    """Round-14 metering brick (ROADMAP direction 4): every Explorer
+    request handler runs inside an ``explorer_request`` span — one
+    span event per request with the per-request wall and the
+    cache-hit state (whether the request stayed inside the already-
+    explored space or pulled new states into the on-demand search).
+    Untraced serving pays only the shared no-op span."""
+    from stateright_tpu.telemetry import RunTracer, validate_events
+
+    model = TwoPhaseSys(rm_count=2)
+    checker = _checker(model)
+    server = make_server(checker, Snapshot(), "127.0.0.1", 0)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    tr = RunTracer()
+    try:
+        with tr.activate():
+            tr.begin_run(lane=dict(engine="explorer"))
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/.status"
+            ) as r:
+                json.loads(r.read())
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/.states/"
+            ) as r:
+                views = json.loads(r.read())
+            fp = views[0]["fingerprint"]
+            # first browse of this fp explores (cache miss)...
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/.states/{fp}"
+            ) as r:
+                json.loads(r.read())
+            # ...the same browse again is served from explored space
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/.states/{fp}"
+            ) as r:
+                json.loads(r.read())
+            tr.end_run()
+    finally:
+        server.shutdown()
+    validate_events(tr.events)
+    spans = [e for e in tr.events
+             if e["ev"] == "span" and e["phase"] == "explorer_request"]
+    assert len(spans) == 4
+    assert all(s["dur"] >= 0 and s["method"] == "GET" for s in spans)
+    by_path = {}
+    for s in spans:
+        by_path.setdefault(s["path"], []).append(s)
+    assert by_path["/.status"][0]["kind"] == "status"
+    assert by_path["/.status"][0]["cache_hit"] is True
+    browse = by_path[f"/.states/{fp}"]
+    assert [s["cache_hit"] for s in browse] == [False, True]
+    assert all("states" in s for s in browse)
+
+
 def test_actor_model_svg_in_state_views():
     """ActorModel renders sequence-diagram SVG into Explorer views
     (model.rs:476-640 counterpart)."""

@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Latency report: the human-readable view of a run's wall-clock
+telemetry — where the time went.
+
+Reads a ``TRACE_r*.jsonl`` run-telemetry artifact whose run carries
+the round-14 latency events (``program_build`` / ``verdict`` /
+``latency_profile`` — any traced run on round >= 14 code) and renders
+the three tables ROADMAP direction 4's latency-per-query story and
+the pending BENCH_r06 warm/cold A/B read from:
+
+* **compile-cache ledger** — every program build-or-fetch with its
+  hit tier (in_process / disk / cold) and measured wall, so
+  warm-vs-cold start attribution is exact per run (the cold wall is
+  the number a resident service amortizes away),
+* **dispatch / sync-floor split** — time-to-first-wave, the host
+  dispatch wall vs the host-blocked-at-sync wall (the ~106 ms
+  per-chunk floor of PERF.md §sync-floor) with shares of the run
+  wall, and the compile attribution,
+* **property verdict timeline** — per-property time-to-verdict:
+  discovery vs exhaustion, settle wave/depth, wall since run start —
+  plus the counterexample-reconstruction wall split (parent-log
+  drain vs host decode) from the host-phase spans.
+
+The derived summary comes from ``telemetry.latency_summary`` (the
+same block bench lanes embed), so this report and those artifacts
+cannot disagree. ``--json`` additionally writes an auto-numbered
+``LAT_r*.json`` artifact (its own round sequence — ``LAT_r01`` first
+— cross-referenced to the TRACE it was derived from; numbering via
+stateright_tpu/artifacts.py).
+
+Usage:
+  python tools/latency_report.py TRACE_r20.jsonl
+  python tools/latency_report.py TRACE_r20.jsonl --run 0
+  python tools/latency_report.py TRACE_r20.jsonl --json
+
+Exit status: 0 (report printed), 2 bad input / no latency events in
+the trace (a pre-round-14 artifact).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _sec(x) -> str:
+    if x is None:
+        return "-"
+    return f"{x:,.4f} s" if x >= 0.001 else f"{x * 1e3:,.3f} ms"
+
+
+def format_report(summary: dict) -> str:
+    lines = [
+        f"latency report: run #{summary['run']}, "
+        f"engine {summary['engine']}",
+    ]
+    lane = summary.get("lane") or {}
+    if lane:
+        lines.append(
+            "lane: " + ", ".join(
+                f"{k}={lane[k]}" for k in sorted(lane)
+            )
+        )
+    if summary.get("error"):
+        lines.append(f"RUN ERROR: {summary['error']}")
+
+    builds = summary.get("builds") or []
+    if builds:
+        lines.append("")
+        lines.append("compile-cache ledger:")
+        lines.append(
+            f"  {'program':<16s} {'tier':<11s} {'key':<13s} "
+            f"{'wall':>12s} {'cold':>12s}"
+        )
+        for b in builds:
+            lines.append(
+                f"  {b['program']:<16s} {b['tier']:<11s} "
+                f"{(b.get('key') or '-'):<13s} "
+                f"{_sec(b.get('wall_sec')):>12s} "
+                f"{_sec(b.get('cold_sec')):>12s}"
+            )
+
+    prof = summary.get("profile")
+    if prof:
+        comp = prof.get("compile") or {}
+        lines.append("")
+        lines.append(
+            f"wall split ({prof['chunks']} chunk(s), "
+            f"{prof['waves']} waves, run wall "
+            f"{_sec(prof['run_wall_sec'])}):"
+        )
+
+        def share(x):
+            return f" ({x:.1%})" if x is not None else ""
+
+        lines.append(
+            f"  time to first wave:  "
+            f"{_sec(prof['time_to_first_wave_sec'])}"
+        )
+        lines.append(
+            f"  host dispatch:       {_sec(prof['dispatch_sec'])}"
+            f"{share(prof.get('dispatch_share'))}"
+            + (f"  [net of compile: "
+               f"{_sec(prof['dispatch_net_sec'])}]"
+               if prof.get("dispatch_net_sec")
+               != prof.get("dispatch_sec") else "")
+        )
+        lines.append(
+            f"  sync floor (fetch):  {_sec(prof['fetch_sec'])}"
+            f"{share(prof.get('sync_share'))}  "
+            f"[min/chunk {_sec(prof.get('fetch_min_sec'))}]"
+        )
+        if prof.get("device_sec") is not None:
+            lines.append(
+                f"  device wait (deep):  {_sec(prof['device_sec'])}"
+                + (f" ({prof['overlap_share']:.1%} of chunk wall)"
+                   if prof.get("overlap_share") is not None else "")
+            )
+        lines.append(
+            f"  between chunks:      {_sec(prof['interchunk_sec'])}"
+        )
+        lines.append(
+            f"  compile:             span {_sec(comp.get('span_sec'))}"
+            f" + builds {_sec(comp.get('build_wall_sec'))}"
+            f" (cold {_sec(comp.get('cold_sec'))})"
+            f"{share(comp.get('share'))}"
+            + (f"  tiers {comp['builds']}"
+               if comp.get("builds") else "")
+        )
+
+    verdicts = summary.get("verdicts") or []
+    if verdicts:
+        lines.append("")
+        lines.append("time to verdict:")
+        lines.append(
+            f"  {'property':<28s} {'expectation':<12s} "
+            f"{'settled':<11s} {'wave':>5s} {'depth':>5s} "
+            f"{'wall':>12s}"
+        )
+        for v in verdicts:
+            lines.append(
+                f"  {v['property']:<28s} {v['expectation']:<12s} "
+                f"{v['kind']:<11s} "
+                f"{v['wave'] if v.get('wave') is not None else '-':>5} "
+                f"{v['depth'] if v.get('depth') is not None else '-':>5} "
+                f"{_sec(v['t_since_run']):>12s}"
+            )
+
+    phases = summary.get("phases") or {}
+    cex = {k: v for k, v in phases.items()
+           if k.startswith("cex_")
+           or k == "counterexample_reconstruction"}
+    if cex:
+        lines.append(
+            "counterexample reconstruction: "
+            + ", ".join(
+                f"{k.replace('cex_', '')} {_sec(v)}"
+                for k, v in sorted(cex.items())
+            )
+        )
+    if "property_check" in phases:
+        lines.append(
+            f"host property checks: {_sec(phases['property_check'])}"
+        )
+    return "\n".join(lines)
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="compile-ledger / sync-floor / time-to-verdict "
+        "report over a TRACE"
+    )
+    ap.add_argument("trace", help="TRACE_r*.jsonl artifact")
+    ap.add_argument(
+        "--run", type=int, default=None,
+        help="run index inside the trace (default: the last run)",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="also write an auto-numbered LAT_r*.json artifact "
+        "(beside the trace's repo artifacts)",
+    )
+    ap.add_argument(
+        "--root", default=None,
+        help="artifact directory for --json (default: the repo root)",
+    )
+    args = ap.parse_args()
+
+    from stateright_tpu.telemetry import (
+        latency_summary,
+        load_trace,
+        validate_events,
+        write_latency_artifact,
+    )
+
+    try:
+        events = load_trace(args.trace)
+        validate_events(events)
+    except (OSError, ValueError) as exc:
+        print(f"latency_report: bad input: {exc}", file=sys.stderr)
+        sys.exit(2)
+
+    runs = sorted({e["run"] for e in events
+                   if e["ev"] == "run_begin"})
+    if args.run is not None and args.run not in runs:
+        print(
+            f"latency_report: run {args.run} not in this trace "
+            f"(runs: {runs})",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+
+    summary = latency_summary(events, run=args.run)
+    if summary is None:
+        print(
+            "latency_report: no latency events in this trace — trace "
+            "a run on round >= 14 code "
+            "(program_build/verdict/latency_profile land "
+            "automatically on traced runs)",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+    print(format_report(summary))
+    if args.json:
+        summary = dict(summary, trace=os.path.basename(args.trace))
+        path = write_latency_artifact(summary, root=args.root)
+        print(f"\nwrote {path}")
+
+
+if __name__ == "__main__":
+    main()

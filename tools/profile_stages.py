@@ -9,7 +9,8 @@ space and ``keep_final_carry`` set, so the final carry's frontier is a
 genuine mid-growth wave's new-state set and the visited array holds
 the genuine prefix. Then re-run each wave stage in isolation on that
 data, amortized over REPS in-jit repetitions (the axon tunnel hides
-per-dispatch execution; see tools/profile_sortmerge.py).
+per-dispatch execution, so each measured op runs inside one jitted
+fori_loop with a full-reduction fold that defeats DCE).
 
 Usage:
   python tools/profile_stages.py --paxos 4
@@ -19,11 +20,24 @@ Usage:
   python tools/profile_stages.py --paxos 4 --wave-wall      # out-of-stage
                                   # wall + per-HLO-category attribution
                                   # (stateright_tpu/wavewall.py)
+  python tools/profile_stages.py --micro    # primitive costs at engine
+                                  # row counts, synthetic keys (the
+                                  # retired profile_sortmerge.py's
+                                  # post-round-10 successor)
+
+Per-wave WALL times for a real run come from ``--trace=deep`` +
+tools/latency_report.py these days — this tool is for isolating
+stages, not timing runs.
 """
 
 import argparse
 import os
+import sys
 import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 REPS = 8
 
@@ -540,6 +554,95 @@ def wave_profile(kind, n, caps):
         prev = u
 
 
+def micro():
+    """--micro: primitive microbench at engine row counts on
+    SYNTHETIC keys (folded in from the retired
+    tools/profile_sortmerge.py, round 14 — its sort#1/2/3 labels
+    timed the per-wave visited re-sort the round-10 streaming merge
+    killed). These rows price the CURRENT stage seams' primitives
+    without engine data: the B-row 3-lane candidate sort, the
+    streaming binary-search membership into the sorted visited
+    prefix, the O(V + NF) linear merge append, and the winner-fetch
+    row gather. ``stage_profile`` times the same seams on REAL
+    mid-run data; use this one to separate primitive cost from
+    data-shape effects."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from stateright_tpu.ops.merge import member_sorted, merge_sorted
+
+    key = jax.random.PRNGKey(0)
+
+    def rnd(shape, i=0):
+        return jax.random.bits(jax.random.fold_in(key, i), shape,
+                               dtype=jnp.uint32)
+
+    V, B, NF, W = 1 << 21, 1 << 20, 1 << 19, 19
+    acc0 = jnp.zeros(1, jnp.uint32)
+    # 2-limb sorted visited prefix (the (hi, lo) key order the
+    # engines keep)
+    v_hi, v_lo = jax.jit(
+        lambda h, l: lax.sort((h, l), num_keys=2)
+    )(rnd((V,), 1), rnd((V,), 2))
+    b_hi, b_lo = rnd((B,), 3), rnd((B,), 4)
+    s_hi, s_lo = jax.jit(
+        lambda h, l: lax.sort((h, l), num_keys=2)
+    )(b_hi, b_lo)
+    w_hi, w_lo = s_hi[:NF], s_lo[:NF]
+    print(f"\n## primitive microbench (V={V}, B={B}, NF={NF}, "
+          f"per-op ms, in-loop amortized over {REPS} reps)")
+    rows = {}
+
+    def s_csort(i, a):
+        kh, kl, acc = a
+        kh = kh.at[0].set(kh[0] ^ (i.astype(jnp.uint32) & 1))
+        pos = jnp.arange(1, B + 1, dtype=jnp.uint32)
+        o_hi, o_lo, o_pos = lax.sort((kh, kl, pos), num_keys=2)
+        acc = acc.at[0].add(_fold(o_hi) + _fold(o_lo) + _fold(o_pos))
+        return kh, kl, acc
+
+    rows[f"cand sort3 (B={B})"] = _timed(s_csort, (b_hi, b_lo, acc0))
+
+    def s_member(i, a):
+        vh, vl, qh, ql, acc = a
+        vl = vl.at[0].set(vl[0] ^ (i.astype(jnp.uint32) & 1))
+        m = member_sorted(vl, vh, ql, qh, impl="xla")
+        acc = acc.at[0].add(_fold(m))
+        return vh, vl, qh, ql, acc
+
+    rows[f"member binsearch (V={V} | B={B})"] = _timed(
+        s_member, (v_hi, v_lo, s_hi, s_lo, acc0)
+    )
+
+    def s_append(i, a):
+        vh, vl, wh, wl, acc = a
+        vl = vl.at[0].set(vl[0] ^ (i.astype(jnp.uint32) & 1))
+        m_lo, m_hi = merge_sorted(vl, vh, wl, wh, impl="xla")
+        acc = acc.at[0].add(_fold(m_lo) + _fold(m_hi))
+        return vh, vl, wh, wl, acc
+
+    rows[f"linear merge (V={V}+{NF})"] = _timed(
+        s_append, (v_hi, v_lo, w_hi, w_lo, acc0)
+    )
+
+    pay = rnd((B, W), 5)
+    idx = jnp.arange(NF, dtype=jnp.uint32) % jnp.uint32(B)
+
+    def s_gather(i, a):
+        py, nf, acc = a
+        nf = (nf + i.astype(jnp.uint32)) % jnp.uint32(B)
+        acc = acc.at[0].add(_fold(py[nf]))
+        return py, nf, acc
+
+    rows[f"fetch gather ({NF} rows W={W} from {B})"] = _timed(
+        s_gather, (pay, idx, acc0)
+    )
+
+    for k, v in rows.items():
+        print(f"  {k:44s} {v:9.2f} ms")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--paxos", type=int)
@@ -547,6 +650,12 @@ def main():
     ap.add_argument("--target", type=int)
     ap.add_argument("--wave-profile", action="store_true")
     ap.add_argument("--wave-wall", action="store_true")
+    ap.add_argument(
+        "--micro", action="store_true",
+        help="primitive microbench at engine row counts on synthetic "
+        "keys (no model needed; the retired profile_sortmerge.py's "
+        "successor)",
+    )
     ap.add_argument(
         "--trace", nargs="?", const="default",
         choices=("default", "deep"), default=None,
@@ -559,6 +668,10 @@ def main():
     import jax
 
     print(f"backend: {jax.devices()}")
+
+    if args.micro:
+        micro()
+        return
 
     # Structural sizes from the one shared table (capacity from the
     # pinned state counts, frontier from measured wave peaks);

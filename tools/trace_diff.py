@@ -28,6 +28,19 @@ artifact and this tool is the comparison —
   counter divergence), while MEASURED bytes — compiled temp bytes,
   the live watermark peak — compare relative under ``--threshold``,
   so jax-version allocator skew doesn't false-positive.
+* **latency alignment** (round 14) — traces carrying
+  ``latency_profile`` / ``verdict`` events additionally compare the
+  wall-attribution lanes (time-to-first-wave, dispatch net of
+  compile, the sync-floor fetch total, compile cold wall) and
+  per-property time-to-verdict. These lanes regress when
+  ``B - A > max(--min-sec, --threshold * A)`` — the absolute floor
+  matters: a multi-second forced cold compile against a 0-second
+  warm ledger, or an injected host stall on a millisecond fetch
+  floor, must flag even though the A side is under the relative
+  noise gate. A property that settles by discovery on one side and
+  exhaustion on the other is a DIVERGENCE (the runs answered the
+  property differently). Sides without latency events skip the
+  block, so pre-round-14 baselines keep diffing.
 * **regression threshold** — exit nonzero when any phase at least
   ``--min-sec`` long on the A side grew by more than ``--threshold``
   (relative), or on any wave divergence.

@@ -538,6 +538,18 @@ def main():
             f"comms ref: {comms_ref['artifact']} "
             f"clean={comms_ref['clean']}"
         )
+    # CKPT cross-reference (the durability layer, same best-effort
+    # contract): the newest crash-matrix artifact — whether the
+    # kill/fault/torn/stale cells all landed on recover-or-refuse at
+    # the referenced SHA (tools/crash_matrix.py).
+    from stateright_tpu.artifacts import latest_ckpt_summary
+
+    ckpt_ref = latest_ckpt_summary()
+    if ckpt_ref is not None:
+        _stderr(
+            f"ckpt ref: {ckpt_ref['artifact']} "
+            f"clean={ckpt_ref['clean']}"
+        )
 
     # Compile-cache ledger (round 14, checkers/tpu.py): per-lane
     # DELTAS of the process-cumulative compile-or-fetch counters, so
@@ -796,6 +808,8 @@ def main():
                            if lint_ref is not None else {}),
                         **({"comms": comms_ref}
                            if comms_ref is not None else {}),
+                        **({"ckpt": ckpt_ref}
+                           if ckpt_ref is not None else {}),
                     }
                 ),
                 "detail": detail,

@@ -238,6 +238,51 @@ def latest_comms_summary(root: str | None = None) -> dict | None:
     }
 
 
+def latest_ckpt_summary(root: str | None = None) -> dict | None:
+    """Cross-reference block for the newest ``CKPT_r*.json``
+    (crash-matrix, tools/crash_matrix.py): artifact name, clean flag
+    (every matrix cell landed on recover-or-refuse-loudly), the
+    producing SHA, and the per-cell verdicts. bench.py embeds this
+    beside the LINT/COMM cross-references. Best effort with the same
+    guarantees: a missing, hand-edited, or truncated artifact
+    degrades to None, never aborts the caller."""
+    path = latest_artifact("CKPT", root)
+    if path is None:
+        return None
+    try:
+        with open(path) as fh:
+            report = json.load(fh)
+        cells = report.get("cells")
+        if not isinstance(cells, dict) or not cells:
+            return None
+        cell_verdicts = {
+            str(name): str(c.get("verdict"))
+            for name, c in cells.items() if isinstance(c, dict)
+        }
+        prov = report.get("provenance")
+        ckpt_sha = (prov.get("git_sha")
+                    if isinstance(prov, dict) else None)
+    except (OSError, ValueError, TypeError, AttributeError, KeyError):
+        return None
+    if not cell_verdicts:
+        return None
+    repo = repo_root() if root is None else root
+    head = _git_sha(repo)
+    dirty = _git_dirty(repo)
+    return {
+        "artifact": os.path.basename(path),
+        "clean": bool(report.get("clean")),
+        "git_sha": ckpt_sha,
+        "sha_matches_head": (
+            ckpt_sha == head
+            if ckpt_sha is not None and head is not None
+            and dirty is False
+            else None
+        ),
+        "cells": dict(sorted(cell_verdicts.items())),
+    }
+
+
 def _git_dirty(root: str) -> bool | None:
     """True when the working tree has uncommitted changes, False when
     clean, None when git can't answer."""

@@ -52,6 +52,20 @@ class HybridChecker(Checker):
         self._device_kwargs = device_kwargs
         #: which engine completed first ("host" | "device")
         self.winner: Optional[str] = None
+        #: staged snapshot path (:meth:`resume_from`): the DEVICE side
+        #: resumes from it — a host DFS has no snapshot to restore, so
+        #: a resumed race is "resumed device vs fresh host", which
+        #: preserves the racer's contract (both sides explore the same
+        #: space to the same answers).
+        self._resume_path = None
+        self._resume_kw: dict = {}
+
+    def resume_from(self, path: str, **kw) -> None:
+        """Stage a device-engine snapshot for the next run (see
+        checkpoint.resume_from; validation happens at run time, on
+        the device checker the race constructs)."""
+        self._resume_path = path
+        self._resume_kw = kw
 
     def _run(self, reporter: Optional[Reporter] = None) -> None:
         from .tpu_sortmerge import SortMergeTpuBfsChecker
@@ -60,6 +74,8 @@ class HybridChecker(Checker):
         device = SortMergeTpuBfsChecker(
             self.builder, **self._device_kwargs
         )
+        if self._resume_path is not None:
+            device.resume_from(self._resume_path, **self._resume_kw)
         stop_host = threading.Event()
         stop_device = threading.Event()
         host.cancel_event = stop_host
@@ -87,14 +103,28 @@ class HybridChecker(Checker):
         t.start()
         device_error = None
         try:
-            device._ensure_run(reporter)
-        except Exception as exc:
-            device_error = exc
-        if device_error is None and not device.cancelled and claim(
-            "device"
-        ):
-            stop_host.set()
-        t.join()
+            try:
+                device._ensure_run(reporter)
+            except Exception as exc:
+                device_error = exc
+            if device_error is None and not device.cancelled and claim(
+                "device"
+            ):
+                stop_host.set()
+            t.join()
+        finally:
+            # The loser must be cancelled AND joined on EVERY exit
+            # path — including a BaseException out of the device side
+            # (KeyboardInterrupt, a supervisor-exhausted injected
+            # fault). A stale host thread that outlives _run keeps
+            # emitting telemetry into whatever run opens next (a
+            # RESUMED run's trace would interleave a dead race's
+            # events), and its eventual completion could race the
+            # winner bookkeeping. The join is safe here: stop_host is
+            # set, and the host checks its cancel event per DFS pop.
+            if t.is_alive():
+                stop_host.set()
+                t.join()
         if self.winner is None:
             # Both failed (or the device failed and the host errored) —
             # a side only claims after completing without an exception.

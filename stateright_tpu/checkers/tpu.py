@@ -40,12 +40,14 @@ inside ``shard_map``.
 from __future__ import annotations
 
 import hashlib
+import os
 import threading
 import time
 from typing import Any, Optional
 
 import numpy as np
 
+from .. import faultinject
 from ..checker import Checker, CheckerBuilder
 from ..encoding import EncodedModel, has_trivial_boundary
 from ..model import Expectation
@@ -428,6 +430,8 @@ class TpuBfsChecker(Checker):
         waves_per_sync: int = 64,
         cand_capacity: Optional[int] = None,
         probe_rounds: int = 16,
+        checkpoint_every: Optional[int] = None,
+        checkpoint_path: Optional[str] = None,
     ):
         super().__init__(builder)
         if builder._symmetry is not None:
@@ -496,6 +500,34 @@ class TpuBfsChecker(Checker):
         #: the untraced dispatch/sync wall split (``_run`` fills it;
         #: :meth:`latency_accounting` summarizes for bench.py).
         self._lat = None
+        # -- checkpoint/resume (stateright_tpu/checkpoint.py) -----------
+        #: snapshot the chunk carry every N chunks at the existing
+        #: per-chunk sync (None = checkpointing off). The supervisor
+        #: (checkpoint.supervised_run) then retries a failed chunk —
+        #: device error, injected fault, OOM — from the last snapshot
+        #: instead of dying.
+        if checkpoint_every is not None and checkpoint_every < 1:
+            raise ValueError(
+                f"checkpoint_every must be >= 1: {checkpoint_every}"
+            )
+        self.checkpoint_every = checkpoint_every
+        self.checkpoint_path = checkpoint_path or (
+            "stateright_tpu.ckpt" if checkpoint_every else None
+        )
+        #: bounded-retry budget of the fault supervisor, and the base
+        #: of its exponential backoff (tests shrink it).
+        self.max_fault_retries = 3
+        self.retry_backoff_sec = 0.5
+        #: staged (manifest, buffers) from :meth:`resume_from`; the
+        #: next ``_run_attempt`` builds its carry from these instead
+        #: of the seed program.
+        self._resume = None
+        self._resume_path = None
+        self._last_snapshot = None
+        #: sharded engines record their carry PartitionSpecs here at
+        #: program build (rides the program cache) so a resume can
+        #: place snapshot buffers with the exact mesh sharding.
+        self._carry_pspecs = None
 
     # -- results ----------------------------------------------------------
 
@@ -827,6 +859,55 @@ class TpuBfsChecker(Checker):
     # -- host orchestration ------------------------------------------------
 
     def _run(self, reporter: Optional[Reporter] = None) -> None:
+        """One engine run, supervised (stateright_tpu/checkpoint.py):
+        with checkpointing or a staged resume configured, a failed
+        chunk retries from the last snapshot with bounded backoff;
+        otherwise this is a plain pass-through to ``_run_attempt``."""
+        from .. import checkpoint
+
+        checkpoint.supervised_run(self, reporter)
+
+    def resume_from(self, path: str, **kw) -> dict:
+        """Stage a snapshot (checkpoint.resume_from) so the next run
+        restores the chunk carry instead of seeding — on the SAME
+        layout by direct upload, on a different sort-merge shard
+        count/capacity through the (owner, fp) re-route. Returns the
+        snapshot manifest; raises the named Snapshot* errors on
+        corruption/staleness/incompatibility."""
+        from .. import checkpoint
+
+        return checkpoint.resume_from(self, path, **kw)
+
+    def _checkpoint_family(self) -> str:
+        """Snapshot-compatibility family: engines whose visited
+        structures are interconvertible under the (owner, fp)
+        re-route share a family (the sort-merge engines override)."""
+        return "hash"
+
+    def _reset_for_resume(self) -> None:
+        """Discard one failed attempt's partial results before the
+        supervisor retries from a snapshot. Programs are KEPT (the
+        fault was at runtime, not in the compiled shapes; an OOM
+        degrade clears them itself); discoveries re-derive from the
+        snapshot's cumulative discovery lanes."""
+        self._discovered_fps.clear()
+        self._discoveries.clear()
+        self._total_states = 0
+        self._unique_states = 0
+        self._max_depth = 0
+        self.metrics = {}
+        self.generated = None
+        self._final_tables = None
+
+    def _degrade_memory_lean(self) -> bool:
+        """Supervisor hook after repeated OOMs: shrink towards a
+        memory-lean configuration before the next retry. The base
+        hash engine has no lean mode (False = nothing degraded); the
+        sort-merge engines shrink ``flat_budget_bytes``, flipping
+        their big classes into CHUNKED mode."""
+        return False
+
+    def _run_attempt(self, reporter: Optional[Reporter] = None) -> None:
         import jax.numpy as jnp
 
         from .. import telemetry
@@ -935,14 +1016,49 @@ class TpuBfsChecker(Checker):
         # measured tier and cold wall (telemetry ``program_build``).
         ledger_pending = (tracer is not None
                           and getattr(self, "_fresh_build", False))
-        snap = _monitor_snapshot() if ledger_pending else None
-        with telemetry.span("seed_upload"):
-            carry = seed_fn(jnp.asarray(init))  # the run's one upload
-        if ledger_pending:
-            self._emit_program_build("seed", snap)
+        resume = self._resume
+        prev_waves = 0
+        if resume is not None:
+            # Restore (stateright_tpu/checkpoint.py): the staged
+            # snapshot buffers become the initial carry — the seed
+            # program never runs, the chunk loop continues from the
+            # snapshot's wave. Consumed here so a later fault's
+            # supervisor retry re-stages from disk explicitly.
+            from .. import checkpoint as _ckpt
+
+            self._resume = None
+            manifest, buffers = resume
+            import jax as _jax
+
+            spec = _jax.eval_shape(
+                seed_fn,
+                _jax.ShapeDtypeStruct((n0, W), jnp.uint32),
+            )
+            with telemetry.span("restore_upload"):
+                carry = _ckpt.build_resume_carry(
+                    self, manifest, buffers, spec
+                )
+            prev_waves = int(manifest["wave"])
+            if tracer is not None:
+                tracer.event(
+                    "restore",
+                    path=os.path.basename(
+                        self._resume_path or ""
+                    ) or None,
+                    wave=int(manifest["wave"]),
+                    depth=int(manifest["depth"]),
+                    unique=int(manifest["unique"]),
+                    from_shards=int(manifest.get("n_shards", 1)),
+                    to_shards=int(getattr(self, "n_shards", 1)),
+                )
+        else:
+            snap = _monitor_snapshot() if ledger_pending else None
+            with telemetry.span("seed_upload"):
+                carry = seed_fn(jnp.asarray(init))  # the one upload
+            if ledger_pending:
+                self._emit_program_build("seed", snap)
 
         chunk_idx = 0
-        prev_waves = 0
         verdicts_seen: set = set()
         deep = tracer is not None and tracer.level == "deep"
         # Live watermarks: device bytes-in-use polled ONLY at the
@@ -952,6 +1068,10 @@ class TpuBfsChecker(Checker):
         mem_peak = None
         mem_src = None
         mem_polls = 0
+        # chunks executed THIS attempt (checkpoint cadence + the
+        # fault-injection sites key on it; restarts at 0 on a resumed
+        # attempt so an armed once-only fault can't re-trip itself)
+        chunk_no = 0
         while True:
             if self.cancel_event is not None and self.cancel_event.is_set():
                 self.cancelled = True
@@ -965,6 +1085,10 @@ class TpuBfsChecker(Checker):
             out = chunk_fn(carry)
             carry, stats = out[0], out[1]
             shard_log = out[2] if len(out) > 2 else None
+            # fault-injection seam: a device error surfacing between
+            # the async dispatch and the stats readback (no-op with
+            # nothing armed — stateright_tpu/faultinject.py)
+            faultinject.fire("mid_chunk", chunk_no)
             t_disp = time.monotonic()  # async dispatch returns here
             if chunk_snap is not None:
                 # the chunk program's compile-or-fetch is synchronous
@@ -1120,6 +1244,27 @@ class TpuBfsChecker(Checker):
                         tracer, mem_peak, mem_src, mem_polls
                     )
                 raise RuntimeError(overflow_msg)
+            # Checkpoint at THE EXISTING sync (the stats readback
+            # above already blocked — the carry download piggybacks,
+            # no new sync point): every ``checkpoint_every`` chunks,
+            # the whole chunk carry lands as an atomic snapshot
+            # (stateright_tpu/checkpoint.py). Never on a completed or
+            # overflowed chunk — a clean completion needs no snapshot
+            # and an overflowed carry is not a resume point.
+            if (self.checkpoint_every and not done
+                    and (chunk_no + 1) % self.checkpoint_every == 0):
+                from .. import checkpoint as _ckpt
+
+                _ckpt.write_snapshot(
+                    self, carry, self.checkpoint_path,
+                    chunk=chunk_no, wave=int(s[4]),
+                    depth=int(s[3]), unique=int(s[8]),
+                )
+            # fault-injection seam: the chunk boundary — AFTER the
+            # snapshot write, so an injected kill here proves the
+            # committed-snapshot sequencing a real preemption sees
+            faultinject.fire("chunk_boundary", chunk_no)
+            chunk_no += 1
             if not done:
                 self._maybe_warn_occupancy(self.metrics["occupancy"])
             if done:
@@ -1226,8 +1371,14 @@ class TpuBfsChecker(Checker):
         if cache_key not in _CHUNK_CACHE:
             self._fresh_build = True
             programs = self._build_programs(n0)
+            # _carry_pspecs rides the cache like _build_info: a
+            # cache-hit instance never ran _build_programs, but a
+            # resume into it must still place snapshot buffers with
+            # the mesh shardings the programs were built for.
             _CHUNK_CACHE[cache_key] = (
-                programs, getattr(self, "_build_info", None)
+                programs,
+                getattr(self, "_build_info", None),
+                getattr(self, "_carry_pspecs", None),
             )
         else:
             self._fresh_build = False
@@ -1238,7 +1389,8 @@ class TpuBfsChecker(Checker):
                     wall_sec=round(time.monotonic() - t0, 6),
                     cold_sec=0.0,
                 )
-        programs, self._build_info = _CHUNK_CACHE[cache_key]
+        programs, self._build_info, self._carry_pspecs = \
+            _CHUNK_CACHE[cache_key]
         return programs
 
     def _emit_program_build(self, program: str, snap: tuple) -> None:

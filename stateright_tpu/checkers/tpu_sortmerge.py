@@ -556,8 +556,30 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             return None
         try:
             with open(path) as fh:
-                return json.load(fh).get(self._budget_key())
-        except (OSError, ValueError):
+                data = json.load(fh)
+        except ValueError as exc:
+            # Torn-write hardening: a truncated/corrupt store (a crash
+            # mid-write from a pre-atomic version, disk-level
+            # truncation) must not raise at engine START — fall back
+            # to the growth heuristic with one line saying why (the
+            # next clean run's _save_budget rewrites the store
+            # atomically). Parse-guard IS the checksum here: the
+            # store is JSON, and torn JSON does not parse.
+            import warnings
+
+            warnings.warn(
+                f"auto-budget store {path} is corrupt ({exc}); "
+                "falling back to default budgets (the store rewrites "
+                "on the next clean run)",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+            return None
+        except OSError:
+            return None
+        try:
+            return data.get(self._budget_key())
+        except AttributeError:
             return None
 
     def _save_budget(self) -> None:
@@ -733,6 +755,44 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         self._max_depth = 0
         self.metrics = {}
         self.generated = None
+
+    def _checkpoint_family(self) -> str:
+        # Both sort-merge engines carry the same sorted-prefix visited
+        # structure, so their snapshots interconvert under the
+        # (owner, fp) re-route (checkpoint.reshard_sortmerge).
+        return "sortmerge"
+
+    def _degrade_memory_lean(self) -> bool:
+        """Supervisor OOM hook (checkpoint.supervised_run): quarter
+        the flat budget so the padded-residency gates flip the big
+        classes into CHUNKED memory-lean mode on the next attempt
+        (the successor tensor is never materialized; winners
+        recompute at fetch). Programs rebuild — flat_budget_bytes is
+        cache-keyed, so the degraded shapes are a new entry."""
+        new_budget = max(self.flat_budget_bytes // 4, 1 << 22)
+        if new_budget >= self.flat_budget_bytes:
+            return False
+        import warnings
+
+        from .. import telemetry
+
+        warnings.warn(
+            f"repeated OOM under supervision: flat_budget_bytes "
+            f"{self.flat_budget_bytes} -> {new_budget} (CHUNKED "
+            "memory-lean classes engage where the gate trips; "
+            "programs recompile)",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+        telemetry.emit(
+            "oom_degrade", engine=type(self).__name__,
+            flat_budget_bytes_old=int(self.flat_budget_bytes),
+            flat_budget_bytes=int(new_budget),
+        )
+        self.flat_budget_bytes = new_budget
+        self._programs = None
+        self.memory_plan = None
+        return True
 
     def _use_sparse(self) -> bool:
         if self.sparse is not None:

@@ -1,0 +1,736 @@
+"""Checkpoint/resume with elastic re-shard: durability for the wave
+engines.
+
+The memory ledger (memplan.py) already declares exactly which buffers
+constitute a run — every engine's chunk carry is a named pytree the
+seed program's ``eval_shape`` spec pins. This module is the other half
+ROADMAP direction 1(c) names: *serialize* that declared carry at the
+existing per-chunk sync (the stats readback already blocked — no new
+device syncs, just a piggybacked download at the same seam), and
+*restore* it so a preemption, OOM, or crash costs one chunk of
+progress instead of the whole search (the elastic/preemptible
+execution framing of arXiv:1203.6806's checking-as-a-cloud-service).
+
+**Snapshot format.** One file: an ``.npz`` container holding every
+chunk-carry leaf (visited ``vkeys``/hash tables, frontier, ebits, the
+parent log, counters, and the cumulative discovery lanes) plus a JSON
+manifest under the reserved ``__manifest__`` entry — version, git
+SHA, encoding fingerprint, engine family, shard count, per-shard
+capacities, wave/depth/unique at capture, the persisted auto-budget
+state, and a per-buffer CRC-32. Writes are atomic: temp file →
+flush → fsync → ``os.replace`` — a crash mid-write leaves the
+previous snapshot intact, and a genuinely torn file (truncation,
+bit rot) fails the zip/CRC checks and raises
+:class:`SnapshotCorruptError` on load. No pickle anywhere
+(``allow_pickle`` stays False): a snapshot is data, not code.
+
+**Resume** (:func:`resume_from`):
+
+* SAME configuration — direct upload: every leaf shape-checked
+  against the current seed program's ``eval_shape`` spec, trace-gated
+  leaves (the wave/shard logs) synthesized to match the resuming
+  run's tracer state, sharded leaves placed with the engine's own
+  ``PartitionSpec``\\ s;
+* DIFFERENT shard count / capacity (the sort-merge family) — elastic
+  **re-shard**: per-shard visited prefixes, frontier blocks, and
+  parent-log entries are concatenated and re-routed host-side through
+  the exact (owner, fp) ordering the mesh wave's routing sort uses
+  (owner = ``fp_lo % S``, keys ordered ``(hi, lo)`` — the
+  ``lax.sort`` seam of parallel/engine_sortmerge.py, as
+  ``np.lexsort``), then re-uploaded at the new layout. Shard count
+  becomes a resume-time choice, not a run-time constant; single-chip
+  ⇄ sharded conversions ride the same path (single-chip is the
+  S=1 layout). The hash-table family resumes same-config only
+  (re-inserting an open-addressed table is a different primitive) and
+  refuses loudly otherwise.
+
+Staleness is refused, never guessed around: a manifest whose encoding
+fingerprint disagrees with the target checker, or whose git SHA
+differs from HEAD (override with ``allow_sha_mismatch=True`` when you
+know the carry layout didn't change), raises
+:class:`SnapshotStaleError` — the fault-injection matrix
+(stateright_tpu/faultinject.py + tools/crash_matrix.py) pins all four
+failure modes on recover-or-refuse-loudly, none on silent wrong
+answers.
+
+**Supervision** (:func:`supervised_run`): engines route ``_run``
+through here. With checkpointing configured, a failed chunk — device
+error, injected fault, OOM — retries from the last snapshot with
+bounded exponential backoff instead of dying; repeated OOMs degrade
+the sort-merge engines to their CHUNKED memory-lean classes
+(``_degrade_memory_lean``) before the next attempt. Engine overflow
+errors are NOT supervised: the auto-budget retry (tpu_sortmerge.py)
+owns those, one layer out.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import warnings
+import zlib
+from typing import Any, Optional
+
+import numpy as np
+
+SNAPSHOT_VERSION = 1
+
+_SENT = 0xFFFFFFFF
+
+#: trace-gated carry leaves resume may synthesize (zeros) when the
+#: snapshot and the resuming run disagree on tracer state or
+#: waves_per_sync — their content is telemetry, rewritten inside the
+#: chunk before any row is read.
+_SYNTH_LEAVES = frozenset({"wlog", "slog", "swave", "wv_pairs"})
+
+
+class SnapshotError(RuntimeError):
+    """Base of every named checkpoint/resume refusal."""
+
+
+class SnapshotCorruptError(SnapshotError):
+    """The snapshot file is torn or corrupt (failed zip read, missing
+    buffer, or a per-buffer CRC mismatch)."""
+
+
+class SnapshotStaleError(SnapshotError):
+    """The manifest doesn't match the resuming checker (wrong encoding
+    fingerprint or wrong git SHA)."""
+
+
+class SnapshotIncompatibleError(SnapshotError):
+    """The snapshot can't be restored into this engine configuration
+    (family mismatch, track_paths flip, a target capacity too small
+    for the carried state, or a hash-family re-shard)."""
+
+
+# -- identity -------------------------------------------------------------
+
+
+def encoding_fingerprint(checker) -> str:
+    """The stable identity of what a snapshot's carry MEANS: the
+    encoding (class, declared cache key, width, action count) plus the
+    property list and eventually-bit seed. Two checkers with equal
+    fingerprints interpret the same carry identically; anything else
+    is a stale snapshot, not a resumable one."""
+    enc = checker.encoded
+    key_fn = getattr(enc, "cache_key", None)
+    ident = repr(key_fn()) if key_fn is not None else ""
+    props = tuple(
+        (p.name, p.expectation.name)
+        for p in checker.model.properties()
+    )
+    return (
+        f"{type(enc).__name__}/{ident}/W{enc.width}/K{enc.max_actions}"
+        f"/props{props!r}/ebits{checker._eventually_bits_init()}"
+    )
+
+
+def _git_sha() -> Optional[str]:
+    from .artifacts import _git_sha as sha, repo_root
+
+    return sha(repo_root())
+
+
+def _engine_kind(checker) -> str:
+    return "sharded" if getattr(checker, "mesh", None) is not None \
+        else "single"
+
+
+# -- file format ----------------------------------------------------------
+
+
+def _write_file(path: str, manifest: dict, buffers: dict) -> None:
+    """Atomic snapshot write: temp + fsync + rename. The manifest
+    rides the same npz as a reserved uint8 entry so one rename commits
+    both (a separate sidecar file could tear independently), and
+    carries its own CRC-32 (``manifest_crc32``) — buffer bytes are
+    covered by the per-buffer checksums, this covers the metadata
+    region itself."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    manifest = dict(manifest)
+    manifest.pop("manifest_crc32", None)
+    manifest["manifest_crc32"] = (
+        zlib.crc32(json.dumps(manifest, sort_keys=True).encode())
+        & 0xFFFFFFFF
+    )
+    m = json.dumps(manifest, sort_keys=True).encode()
+    try:
+        with open(tmp, "wb") as fh:
+            np.savez(
+                fh,
+                __manifest__=np.frombuffer(m, dtype=np.uint8),
+                **buffers,
+            )
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def _read_raw(path: str) -> tuple[dict, dict]:
+    """Parse the container WITHOUT checksum verification (the
+    stale-manifest injection helper rewrites manifests through this;
+    everyone else goes through :func:`load_snapshot`)."""
+    if not os.path.exists(path):
+        raise SnapshotError(f"no snapshot at {path}")
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            if "__manifest__" not in z.files:
+                raise SnapshotCorruptError(
+                    f"{path}: no manifest entry — not a snapshot, or "
+                    "torn before the manifest landed"
+                )
+            manifest = json.loads(bytes(z["__manifest__"].tobytes()))
+            buffers = {
+                k: np.array(z[k]) for k in z.files
+                if k != "__manifest__"
+            }
+    except SnapshotError:
+        raise
+    except Exception as exc:
+        # zipfile.BadZipFile, ValueError, OSError, EOFError, json
+        # decode errors — every torn-file shape lands here, named.
+        raise SnapshotCorruptError(
+            f"{path}: torn or corrupt snapshot ({type(exc).__name__}: "
+            f"{exc})"
+        ) from exc
+    return manifest, buffers
+
+
+def _crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+def load_snapshot(path: str) -> tuple[dict, dict]:
+    """Read + verify a snapshot: container integrity, version, and the
+    manifest's per-buffer CRC-32 over the loaded bytes. Raises the
+    named errors; never returns partially-verified data."""
+    manifest, buffers = _read_raw(path)
+    declared_crc = manifest.pop("manifest_crc32", None)
+    actual_crc = (
+        zlib.crc32(json.dumps(manifest, sort_keys=True).encode())
+        & 0xFFFFFFFF
+    )
+    if declared_crc != actual_crc:
+        raise SnapshotCorruptError(
+            f"{path}: manifest failed its CRC-32 check (bit rot or "
+            "a hand edit — the metadata region is checksummed too)"
+        )
+    version = manifest.get("version")
+    if version != SNAPSHOT_VERSION:
+        raise SnapshotIncompatibleError(
+            f"{path}: snapshot version {version} != reader "
+            f"{SNAPSHOT_VERSION}"
+        )
+    declared = manifest.get("buffers") or {}
+    for name, meta in declared.items():
+        if name not in buffers:
+            raise SnapshotCorruptError(
+                f"{path}: buffer {name!r} declared in manifest but "
+                "missing from the container"
+            )
+        arr = buffers[name]
+        if list(arr.shape) != list(meta["shape"]) \
+                or str(arr.dtype) != meta["dtype"]:
+            raise SnapshotCorruptError(
+                f"{path}: buffer {name!r} is {arr.dtype}"
+                f"{list(arr.shape)}, manifest declares {meta['dtype']}"
+                f"{meta['shape']}"
+            )
+        if _crc(arr) != int(meta["crc32"]):
+            raise SnapshotCorruptError(
+                f"{path}: buffer {name!r} failed its CRC-32 check "
+                "(bit rot or a torn write)"
+            )
+    for name in buffers:
+        if name not in declared:
+            raise SnapshotCorruptError(
+                f"{path}: undeclared buffer {name!r} in the container"
+            )
+    return manifest, buffers
+
+
+# -- capture --------------------------------------------------------------
+
+
+def write_snapshot(checker, carry, path: str, *, chunk: int,
+                   wave: int, depth: int, unique: int) -> dict:
+    """Serialize one chunk carry to an atomic on-disk snapshot. Called
+    at the existing per-chunk sync (checkers/tpu.py) — the stats
+    readback already blocked, so the carry download adds transfer, not
+    a sync point. Returns the manifest; emits a ``checkpoint``
+    telemetry event."""
+    from . import telemetry
+
+    t0 = time.monotonic()
+    buffers = {k: np.asarray(v) for k, v in carry.items()}
+    total = int(sum(b.nbytes for b in buffers.values()))
+    manifest = dict(
+        version=SNAPSHOT_VERSION,
+        created_at=time.time(),
+        git_sha=_git_sha(),
+        engine=type(checker).__name__,
+        family=checker._checkpoint_family(),
+        kind=_engine_kind(checker),
+        encoding=encoding_fingerprint(checker),
+        width=int(checker.encoded.width),
+        n_shards=int(getattr(checker, "n_shards", 1)),
+        capacity=int(checker.capacity),
+        frontier_capacity=int(checker.frontier_capacity),
+        track_paths=bool(checker.track_paths),
+        waves_per_sync=int(checker.waves_per_sync),
+        chunk=int(chunk),
+        wave=int(wave),
+        depth=int(depth),
+        unique=int(unique),
+        budget=dict(
+            cand_capacity=checker.cand_capacity,
+            pair_width=getattr(checker, "pair_width", None),
+            auto_budget=bool(getattr(checker, "auto_budget", False)),
+        ),
+        merge_impl=getattr(checker, "merge_impl", None),
+        snapshot_bytes=total,
+        buffers={
+            k: dict(shape=list(b.shape), dtype=str(b.dtype),
+                    crc32=_crc(b))
+            for k, b in buffers.items()
+        },
+    )
+    _write_file(path, manifest, buffers)
+    checker._last_snapshot = path
+    telemetry.emit(
+        "checkpoint", path=os.path.basename(path), chunk=int(chunk),
+        wave=int(wave), depth=int(depth), unique=int(unique),
+        snapshot_bytes=total,
+        wall_sec=round(time.monotonic() - t0, 6),
+    )
+    return manifest
+
+
+# -- resume ---------------------------------------------------------------
+
+
+def resume_from(checker, path: str, *,
+                allow_sha_mismatch: bool = False) -> dict:
+    """Validate a snapshot against ``checker`` and stage it for the
+    next run: the engine's ``_run_attempt`` builds its initial carry
+    from the staged buffers instead of the seed program. Re-shards
+    through the (owner, fp) seam when the sort-merge target's layout
+    differs; refuses loudly (named errors) on corruption, staleness,
+    or an incompatible target. Returns the manifest."""
+    manifest, buffers = load_snapshot(path)
+
+    enc_fp = encoding_fingerprint(checker)
+    if manifest.get("encoding") != enc_fp:
+        raise SnapshotStaleError(
+            f"{path}: snapshot encodes "
+            f"{manifest.get('encoding')!r}, this checker expects "
+            f"{enc_fp!r} — a snapshot is only resumable into the "
+            "same model/encoding"
+        )
+    head = _git_sha()
+    snap_sha = manifest.get("git_sha")
+    if (snap_sha is not None and head is not None
+            and snap_sha != head and not allow_sha_mismatch):
+        raise SnapshotStaleError(
+            f"{path}: snapshot was written at git {snap_sha[:12]}, "
+            f"HEAD is {head[:12]} — the carry layout may have "
+            "changed; pass allow_sha_mismatch=True (CLI: "
+            "--resume-any-sha) to resume anyway"
+        )
+    family = checker._checkpoint_family()
+    if manifest.get("family") != family:
+        raise SnapshotIncompatibleError(
+            f"{path}: snapshot is from the {manifest.get('family')!r} "
+            f"engine family, this checker is {family!r} — the visited "
+            "structures are not interconvertible"
+        )
+    if bool(manifest.get("track_paths")) != bool(checker.track_paths):
+        raise SnapshotIncompatibleError(
+            f"{path}: snapshot track_paths="
+            f"{manifest.get('track_paths')}, checker "
+            f"track_paths={checker.track_paths} — the parent log "
+            "exists on one side only"
+        )
+
+    same_layout = (
+        int(manifest.get("n_shards", 1))
+        == int(getattr(checker, "n_shards", 1))
+        and int(manifest.get("capacity")) == int(checker.capacity)
+        and int(manifest.get("frontier_capacity"))
+        == int(checker.frontier_capacity)
+        and manifest.get("kind") == _engine_kind(checker)
+    )
+    if not same_layout:
+        if family != "sortmerge":
+            raise SnapshotIncompatibleError(
+                f"{path}: shard/capacity re-layout (snapshot "
+                f"S={manifest.get('n_shards')} "
+                f"C={manifest.get('capacity')}, target "
+                f"S={getattr(checker, 'n_shards', 1)} "
+                f"C={checker.capacity}) is supported on the "
+                "sort-merge family only — an open-addressed hash "
+                "table re-shards by re-insertion, which this engine "
+                "does not implement; resume on the original layout"
+            )
+        buffers = reshard_sortmerge(manifest, buffers, checker)
+
+    checker._resume = (manifest, buffers)
+    checker._resume_path = path
+    # remembered for the supervisor's retry re-stage: a run resumed
+    # with allow_sha_mismatch must recover under the same policy
+    checker._resume_allow_sha = allow_sha_mismatch
+    return manifest
+
+
+def reshard_sortmerge(manifest: dict, buffers: dict,
+                      checker) -> dict:
+    """The elastic re-shard: rebuild the sort-merge carry at the
+    target (shard count, per-shard capacity) layout by re-routing
+    every row through the (owner, fp) seam the mesh wave's routing
+    sort already defines — owner = ``fp_lo % S`` (the all_to_all
+    destination function, parallel/engine_sortmerge.py
+    ``seed_local``/``make_wave``), keys ordered ``(hi, lo)`` (the
+    ``lax.sort(num_keys=2)`` the incrementally-sorted visited
+    invariant is built on). Host-side ``np.lexsort`` implements the
+    identical ordering, so the rebuilt per-shard prefixes satisfy the
+    engine's sorted invariant by construction.
+
+    Handles single-chip ⇄ sharded in both directions (single-chip is
+    the S=1 layout with scalar counters) and capacity changes at the
+    same shard count. Raises :class:`SnapshotIncompatibleError` when
+    the carried state does not fit the target layout (per-shard
+    visited/frontier/parent-log overflow) — loudly, before any device
+    work."""
+    W = int(manifest["width"])
+    track_paths = bool(manifest["track_paths"])
+    S_a = int(manifest.get("n_shards", 1))
+    C_a = int(manifest["capacity"])
+    F_a = int(manifest["frontier_capacity"])
+    kind_a = manifest.get("kind", "single")
+    C_pad_a = C_a + F_a
+    L_a = C_a + F_a if track_paths else 0
+
+    S_b = int(getattr(checker, "n_shards", 1))
+    C_b = int(checker.capacity)
+    F_b = int(checker.frontier_capacity)
+    kind_b = _engine_kind(checker)
+    C_pad_b = C_b + F_b
+    L_b = C_b + F_b if track_paths else 0
+
+    # -- extract the global state from the source layout ------------------
+    if kind_a == "sharded":
+        u_src = buffers["u_loc"].astype(np.int64).reshape(-1)
+        n_src = buffers["n_loc"].astype(np.int64).reshape(-1)
+        pl_src = buffers["pl_n"].astype(np.int64).reshape(-1)
+    else:
+        u_src = np.array([int(buffers["new"])], np.int64)
+        n_src = np.array([int(buffers["n_frontier"])], np.int64)
+        pl_src = np.array(
+            [int(buffers["pl_n"])] if track_paths else [0], np.int64
+        )
+
+    vkeys = buffers["vkeys"]
+    keys_lo = np.concatenate([
+        vkeys[0, s * C_pad_a: s * C_pad_a + int(u_src[s])]
+        for s in range(S_a)
+    ])
+    keys_hi = np.concatenate([
+        vkeys[1, s * C_pad_a: s * C_pad_a + int(u_src[s])]
+        for s in range(S_a)
+    ])
+
+    frontier = buffers["frontier"]
+    ebits = buffers["ebits"]
+    fr_cols = np.concatenate([
+        frontier[:, s * F_a: s * F_a + int(n_src[s])]
+        for s in range(S_a)
+    ], axis=1)
+    fr_ebits = np.concatenate([
+        ebits[s * F_a: s * F_a + int(n_src[s])] for s in range(S_a)
+    ])
+
+    if track_paths:
+        plog = buffers["plog"]
+        pl_entries = np.concatenate([
+            plog[:, s * L_a: s * L_a + int(pl_src[s])]
+            for s in range(S_a)
+        ], axis=1)
+    else:
+        pl_entries = np.zeros((4, 0), np.uint32)
+
+    # -- route by the (owner, fp) seam -------------------------------------
+    from .ops.fingerprint import fingerprint_u32v
+
+    key_owner = (keys_lo % np.uint32(max(S_b, 1))).astype(np.int64)
+    fr_lo, fr_hi = fingerprint_u32v(fr_cols.T, np)
+    fr_owner = (fr_lo % np.uint32(max(S_b, 1))).astype(np.int64)
+    pl_owner = (
+        pl_entries[2] % np.uint32(max(S_b, 1))
+    ).astype(np.int64)
+
+    vkeys_t = np.full((2, S_b * C_pad_b), _SENT, np.uint32)
+    frontier_t = np.zeros((W, S_b * F_b), np.uint32)
+    ebits_t = np.zeros(S_b * F_b, np.uint32)
+    fval_t = np.zeros(S_b * F_b, bool)
+    plog_t = np.zeros((4, S_b * L_b), np.uint32)
+    u_t = np.zeros(S_b, np.uint32)
+    n_t = np.zeros(S_b, np.uint32)
+    pl_t = np.zeros(S_b, np.uint32)
+    for d in range(S_b):
+        sel = key_owner == d
+        kl, kh = keys_lo[sel], keys_hi[sel]
+        if kl.size > C_b:
+            raise SnapshotIncompatibleError(
+                f"re-shard: shard {d} of {S_b} would own {kl.size:,} "
+                f"visited keys but per-shard capacity is {C_b:,} — "
+                "raise the target capacity"
+            )
+        order = np.lexsort((kl, kh))  # (hi, lo): the routing sort
+        vkeys_t[0, d * C_pad_b: d * C_pad_b + kl.size] = kl[order]
+        vkeys_t[1, d * C_pad_b: d * C_pad_b + kl.size] = kh[order]
+        u_t[d] = kl.size
+
+        fsel = fr_owner == d
+        n_d = int(fsel.sum())
+        if n_d > F_b:
+            raise SnapshotIncompatibleError(
+                f"re-shard: shard {d} of {S_b} would own {n_d:,} "
+                f"frontier rows but frontier_capacity is {F_b:,} — "
+                "raise the target frontier_capacity"
+            )
+        cols = fr_cols[:, fsel]
+        eb = fr_ebits[fsel]
+        # deterministic per-shard order (row order never affects
+        # exploration — any order covers the same states — but a
+        # stable layout keeps re-shard bit-reproducible)
+        forder = np.lexsort((fr_lo[fsel], fr_hi[fsel]))
+        frontier_t[:, d * F_b: d * F_b + n_d] = cols[:, forder]
+        ebits_t[d * F_b: d * F_b + n_d] = eb[forder]
+        fval_t[d * F_b: d * F_b + n_d] = True
+        n_t[d] = n_d
+
+        if track_paths:
+            psel = pl_owner == d
+            p_d = int(psel.sum())
+            if p_d > L_b:
+                raise SnapshotIncompatibleError(
+                    f"re-shard: shard {d} of {S_b} would own "
+                    f"{p_d:,} parent-log entries but the per-shard "
+                    f"log holds {L_b:,} — raise the target capacity"
+                )
+            plog_t[:, d * L_b: d * L_b + p_d] = pl_entries[:, psel]
+            pl_t[d] = p_d
+
+    def src(name, default):
+        b = buffers.get(name)
+        return np.array(b) if b is not None else default
+
+    out = dict(
+        vkeys=vkeys_t,
+        plog=plog_t,
+        frontier=frontier_t,
+        fval=fval_t,
+        ebits=ebits_t,
+        depth=np.int32(buffers["depth"]),
+        wchunk=np.int32(0),
+        waves=np.uint32(buffers["waves"]),
+        gen_lo=np.uint32(buffers["gen_lo"]),
+        gen_hi=np.uint32(buffers["gen_hi"]),
+        new=np.uint32(buffers["new"]),
+        disc_found=np.array(buffers["disc_found"], bool),
+        disc_lo=np.uint32(buffers["disc_lo"]),
+        disc_hi=np.uint32(buffers["disc_hi"]),
+        overflow=np.bool_(buffers["overflow"]),
+        f_overflow=np.bool_(buffers["f_overflow"]),
+        c_overflow=np.bool_(buffers["c_overflow"]),
+        e_overflow=np.bool_(buffers["e_overflow"]),
+        done=np.bool_(buffers["done"]),
+        max_cand=src("max_cand", np.uint32(0)),
+    )
+    if kind_b == "sharded":
+        out.update(
+            pl_n=pl_t,
+            n_loc=n_t,
+            u_loc=u_t,
+            sent_lo=src("sent_lo", np.uint32(0)),
+            sent_hi=src("sent_hi", np.uint32(0)),
+        )
+    else:
+        # single-chip target: S_b is 1 (no n_shards attr → 1), so the
+        # "per-shard" blocks above are one dense block already
+        out.update(
+            pl_n=np.uint32(pl_t.sum()),
+            n_frontier=np.uint32(n_t.sum()),
+            max_tile_cand=src("max_tile_cand", np.uint32(0)),
+            max_rowen=src("max_rowen", np.uint32(0)),
+        )
+    return out
+
+
+def build_resume_carry(checker, manifest: dict, buffers: dict,
+                       spec: dict) -> dict:
+    """Assemble the initial device carry for a resumed run from staged
+    snapshot buffers, against the CURRENT seed program's eval_shape
+    ``spec`` (so a restore can never hand the compiled chunk program a
+    carry it wasn't built for): snapshot leaves are shape/dtype
+    checked, trace-gated leaves are synthesized to the resuming run's
+    shapes, and sharded leaves are placed with the engine's
+    ``PartitionSpec``\\ s when available (plain arrays otherwise — jit
+    re-shards uncommitted inputs)."""
+    synth = set(_SYNTH_LEAVES)
+    if checker._checkpoint_family() == "hash":
+        # the hash engine's u_loc is a trace-only metric lane
+        synth.add("u_loc")
+    carry_np: dict[str, np.ndarray] = {}
+    for name in spec:
+        leaf = spec[name]
+        want_shape = tuple(int(s) for s in leaf.shape)
+        want_dtype = np.dtype(leaf.dtype)
+        have = buffers.get(name)
+        if name == "wchunk":
+            carry_np[name] = np.zeros(want_shape, want_dtype)
+            continue
+        if have is not None and tuple(have.shape) == want_shape:
+            carry_np[name] = np.asarray(have, dtype=want_dtype)
+            continue
+        if name in synth:
+            carry_np[name] = np.zeros(want_shape, want_dtype)
+            continue
+        raise SnapshotIncompatibleError(
+            f"resume: carry leaf {name!r} expects "
+            f"{want_dtype}{list(want_shape)}, snapshot has "
+            + (f"{have.dtype}{list(have.shape)}" if have is not None
+               else "no such buffer")
+            + " — the engine configuration differs from the snapshot"
+        )
+
+    # The restored leaves must be JAX-OWNED copies: the chunk program
+    # donates its carry (donate_argnums=0), and on CPU a zero-copy
+    # device_put/asarray of a numpy buffer can ALIAS the host memory
+    # — donating an aliased buffer lets XLA reuse memory numpy still
+    # references (observed as off-by-a-few duplicate counts and
+    # occasional runtime crashes on the first resumed chunk). One
+    # explicit on-device copy per leaf severs the alias; resume pays
+    # it once.
+    pspecs = getattr(checker, "_carry_pspecs", None)
+    mesh = getattr(checker, "mesh", None)
+    import jax
+    import jax.numpy as jnp
+
+    if pspecs is not None and mesh is not None:
+        from jax.sharding import NamedSharding
+
+        out = {}
+        for k, v in carry_np.items():
+            if k in pspecs:
+                arr = jax.device_put(
+                    v, NamedSharding(mesh, pspecs[k])
+                )
+                out[k] = jnp.copy(arr)
+            else:
+                out[k] = jnp.copy(jnp.asarray(v))
+        return out
+    return {k: jnp.copy(jnp.asarray(v)) for k, v in carry_np.items()}
+
+
+# -- supervision ----------------------------------------------------------
+
+
+def is_supervised_fault(exc: BaseException) -> bool:
+    """Whether the supervisor may retry this failure from a snapshot:
+    injected faults, OOMs, and runtime errors surfacing from the XLA
+    dispatch/readback path. Engine overflow errors (plain
+    RuntimeErrors with sizing advice) are NOT supervised — the
+    auto-budget retry owns those, and re-running them from a snapshot
+    would loop."""
+    from .faultinject import InjectedFault
+
+    if isinstance(exc, (InjectedFault, MemoryError)):
+        return True
+    name = type(exc).__name__
+    if name in ("XlaRuntimeError", "JaxRuntimeError", "InternalError"):
+        return True
+    s = str(exc)
+    return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s
+
+
+def _is_oom(exc: BaseException) -> bool:
+    if isinstance(exc, MemoryError):
+        return True
+    s = str(exc)
+    return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s
+
+
+def supervised_run(checker, reporter=None) -> None:
+    """The retry loop around one engine run (``checker._run`` routes
+    here): a supervised fault with checkpointing configured retries
+    from the last snapshot (or from the seed when the fault landed
+    before the first snapshot) with bounded exponential backoff;
+    after two OOM-classified failures the engine degrades to its
+    CHUNKED memory-lean classes before the next attempt. Unsupervised
+    errors — and supervised ones past ``max_fault_retries`` — raise
+    through unchanged."""
+    from . import telemetry
+
+    attempts = 0
+    ooms = 0
+    while True:
+        try:
+            return checker._run_attempt(reporter)
+        except Exception as exc:
+            if not is_supervised_fault(exc):
+                raise
+            snap = (getattr(checker, "_last_snapshot", None)
+                    or getattr(checker, "_resume_path", None))
+            retries = getattr(checker, "max_fault_retries", 3)
+            if (not checker.checkpoint_every and snap is None) \
+                    or attempts >= retries:
+                raise
+            attempts += 1
+            oom = _is_oom(exc)
+            if oom:
+                ooms += 1
+            delay = min(
+                getattr(checker, "retry_backoff_sec", 0.5)
+                * (2 ** (attempts - 1)),
+                30.0,
+            )
+            warnings.warn(
+                f"supervised recovery: {type(exc).__name__} on chunk "
+                f"execution ({exc}); retry {attempts}/{retries} from "
+                + (f"snapshot {os.path.basename(snap)}" if snap
+                   else "the seed")
+                + f" after {delay:.2f}s backoff",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            telemetry.emit(
+                "fault_recovery",
+                attempt=attempts,
+                error=f"{type(exc).__name__}: {exc}",
+                snapshot=(os.path.basename(snap) if snap else None),
+                backoff_sec=round(delay, 3),
+                oom=oom,
+            )
+            if ooms >= 2:
+                checker._degrade_memory_lean()
+            time.sleep(delay)
+            checker._reset_for_resume()
+            if snap is not None:
+                resume_from(
+                    checker, snap,
+                    # the caller's staleness policy carries over: a
+                    # run started with allow_sha_mismatch must not
+                    # die on the same check mid-recovery
+                    allow_sha_mismatch=getattr(
+                        checker, "_resume_allow_sha", False
+                    ),
+                )

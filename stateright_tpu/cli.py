@@ -49,10 +49,55 @@ def _network(args: list[str], index: int) -> Network:
     return Network.from_name(name)
 
 
+#: runtime flags popped by main() and applied at the one reporting
+#: seam every check lane shares (_report): checkpoint/resume
+#: (stateright_tpu/checkpoint.py) and the waves-per-sync override
+#: (sets the chunk cadence — and therefore the checkpoint cadence —
+#: without a per-lane knob).
+_RUNTIME: dict = dict(
+    checkpoint_every=None, checkpoint_path=None, resume=False,
+    resume_any_sha=False, waves_per_sync=None,
+)
+
+
+def _apply_runtime(checker) -> None:
+    """Apply the popped runtime flags to a freshly-spawned checker
+    (before its first join). Device engines only: the flags configure
+    the chunk loop, which host checkers don't have."""
+    cfg = _RUNTIME
+    if not (cfg["checkpoint_every"] or cfg["resume"]
+            or cfg["waves_per_sync"]):
+        return
+    if not hasattr(checker, "_run_attempt"):
+        raise SystemExit(
+            "--checkpoint-every/--resume/--waves-per-sync need a "
+            "device engine: use a check-tpu lane"
+        )
+    if cfg["waves_per_sync"]:
+        checker.waves_per_sync = cfg["waves_per_sync"]
+    path = cfg["checkpoint_path"] or "stateright_tpu.ckpt"
+    if cfg["checkpoint_every"]:
+        checker.checkpoint_every = cfg["checkpoint_every"]
+        checker.checkpoint_path = path
+    if cfg["resume"]:
+        manifest = checker.resume_from(
+            path, allow_sha_mismatch=cfg["resume_any_sha"]
+        )
+        print(
+            f"resuming from {path}: wave {manifest['wave']}, depth "
+            f"{manifest['depth']}, {manifest['unique']:,} unique "
+            f"states (snapshot S={manifest['n_shards']})"
+        )
+
+
 def _report(checker, out=None) -> None:
     """The one reporting path every check lane shares: the reference-
     format ``Reporter`` (report.rs:60-98) — no lane formats privately
-    (tests/test_report.py pins the format through this seam)."""
+    (tests/test_report.py pins the format through this seam). Also
+    the seam the popped runtime flags (checkpoint/resume) land on:
+    every check lane passes its checker through here before the first
+    join."""
+    _apply_runtime(checker)
     checker.report(WriteReporter(out if out is not None else sys.stdout))
 
 
@@ -426,6 +471,15 @@ def _usage(model: str | None = None) -> None:
         " + TRACE_r*.trace.json run telemetry (tools/trace_diff.py "
         "compares two)"
     )
+    print(
+        "       --checkpoint-every=N [--checkpoint-path=P] on "
+        "check-tpu lanes snapshots the chunk carry every N chunks "
+        "(atomic; supervised fault retry); --resume restores from "
+        "the snapshot — elastically, onto a different shard count "
+        "on the sort-merge engines (--resume-any-sha skips the "
+        "git-SHA staleness refusal; --waves-per-sync=N sets the "
+        "chunk cadence)"
+    )
 
 
 def _pop_trace_flag(argv: list[str]) -> tuple[str | None, list[str]]:
@@ -442,9 +496,46 @@ def _pop_trace_flag(argv: list[str]) -> tuple[str | None, list[str]]:
     return level, rest
 
 
+def _pop_runtime_flags(argv: list[str]) -> list[str]:
+    """Strip the checkpoint/resume flags from anywhere in argv into
+    :data:`_RUNTIME` (the durability layer,
+    stateright_tpu/checkpoint.py): ``--checkpoint-every=N`` (snapshot
+    the chunk carry every N chunks + supervised fault retry),
+    ``--checkpoint-path=PATH`` (default ``stateright_tpu.ckpt``),
+    ``--resume`` (restore from the checkpoint path — elastic: a
+    sort-merge snapshot resumes onto a different shard count),
+    ``--resume-any-sha`` (skip the git-SHA staleness refusal), and
+    ``--waves-per-sync=N`` (chunk cadence override — the knob that
+    sets how much progress one snapshot covers)."""
+    rest = []
+    for a in argv:
+        if a.startswith("--checkpoint-every="):
+            _RUNTIME["checkpoint_every"] = int(a.split("=", 1)[1])
+        elif a.startswith("--checkpoint-path="):
+            _RUNTIME["checkpoint_path"] = a.split("=", 1)[1]
+        elif a == "--resume":
+            _RUNTIME["resume"] = True
+        elif a == "--resume-any-sha":
+            _RUNTIME["resume"] = True
+            _RUNTIME["resume_any_sha"] = True
+        elif a.startswith("--waves-per-sync="):
+            _RUNTIME["waves_per_sync"] = int(a.split("=", 1)[1])
+        else:
+            rest.append(a)
+    return rest
+
+
 def main(argv: list[str] | None = None) -> None:
     argv = sys.argv[1:] if argv is None else argv
+    # reset per invocation: main() is re-entered in-process (tests,
+    # embedders) and a previous call's checkpoint/resume flags must
+    # not leak into a lane that never asked for them
+    _RUNTIME.update(
+        checkpoint_every=None, checkpoint_path=None, resume=False,
+        resume_any_sha=False, waves_per_sync=None,
+    )
     trace_level, argv = _pop_trace_flag(argv)
+    argv = _pop_runtime_flags(argv)
     if not argv or argv[0] not in _MODELS:
         _usage()
         return

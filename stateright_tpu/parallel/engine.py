@@ -78,6 +78,7 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         cand_capacity: Optional[int] = None,
         bucket_capacity: Optional[int] = None,
         probe_rounds: int = 16,
+        **kwargs,
     ):
         import jax
         from jax.sharding import Mesh
@@ -106,6 +107,7 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
             waves_per_sync=waves_per_sync,
             cand_capacity=cand_capacity,
             probe_rounds=probe_rounds,
+            **kwargs,
         )
         self.total_capacity = capacity * self.n_shards
         self.bucket_capacity = bucket_capacity
@@ -631,6 +633,9 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         from jax import lax as _lax
 
         sm_kw = {} if hasattr(_lax, "pvary") else {"check_rep": False}
+        # Checkpoint/resume (stateright_tpu/checkpoint.py): a resumed
+        # run places snapshot buffers with these exact shardings.
+        self._carry_pspecs = dict(specs)
         chunk_out = (
             (specs, P(), P_shard) if trace_log else (specs, P())
         )

@@ -1302,6 +1302,11 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
         # (its named workaround). Newer jax type-checks varying-ness
         # instead, which the pvary/pcast promotions satisfy.
         sm_kw = {} if hasattr(lax, "pvary") else {"check_rep": False}
+        # Checkpoint/resume (stateright_tpu/checkpoint.py): a resumed
+        # run places its snapshot buffers with these exact shardings —
+        # kept beside the programs (rides the program cache via
+        # _lookup_programs) so restore and carry layout can't drift.
+        self._carry_pspecs = dict(specs)
         chunk_out = (
             (specs, P(), P_shard) if trace_log else (specs, P())
         )

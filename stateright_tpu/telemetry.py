@@ -385,12 +385,23 @@ class RunTracer:
             s["dur"] for s in evs
             if s["ev"] == "span" and s["phase"] == "compile"
         )
+        restores = [e for e in evs if e["ev"] == "restore"]
         return dict(
             chunks=len(chunks),
             waves=waves,
             attempts=sum(
                 1 for c in chunks if c.get("wave0") == 0
             ) or 1,
+            # set on a run restored from a snapshot: its wave stream
+            # (and every wall below) covers the search FROM this wave
+            # — time_to_first_wave is time to the first RESUMED
+            # wave's visibility, not the killed process's first wave
+            # (tools/latency_report.py prints it; the resumed-trace
+            # report tests pin that nothing here misattributes)
+            resumed_from_wave=(
+                min(int(r.get("wave") or 0) for r in restores)
+                if restores else None
+            ),
             run_wall_sec=round(run_wall, 6),
             # when the FIRST wave's results became host-visible: the
             # end of chunk 0's blocking readback, relative to
@@ -744,6 +755,19 @@ _REQUIRED = {
     "latency_profile": ("run", "chunks", "waves", "run_wall_sec",
                         "time_to_first_wave_sec", "dispatch_sec",
                         "fetch_sec", "sync_share", "compile"),
+    # The durability layer (checkpoint/resume, stateright_tpu/
+    # checkpoint.py + faultinject.py): ``checkpoint`` — one atomic
+    # snapshot written at the per-chunk sync; ``restore`` — a run
+    # began from a snapshot instead of the seed (its wave stream
+    # starts at ``wave``, which the resume-aware trace_diff alignment
+    # reads); ``fault_injected`` — a deterministic harness fault
+    # fired; ``fault_recovery`` — the supervisor retried from a
+    # snapshot after a supervised failure.
+    "checkpoint": ("run", "path", "chunk", "wave", "depth",
+                   "snapshot_bytes"),
+    "restore": ("run", "wave", "depth", "from_shards", "to_shards"),
+    "fault_injected": ("run", "site", "chunk", "action"),
+    "fault_recovery": ("run", "attempt", "error"),
 }
 
 
@@ -873,7 +897,7 @@ def _run_view(events: list[dict], run: int) -> dict:
                       chunks=[], spans=[], phase_totals={},
                       shard_waves={}, memory_plan=None,
                       memory_watermark=None, latency_profile=None,
-                      builds=[], verdicts=[])
+                      builds=[], verdicts=[], restores=[])
     for ev in events:
         if ev.get("run") != run:
             continue
@@ -889,6 +913,8 @@ def _run_view(events: list[dict], run: int) -> dict:
             view["builds"].append(ev)
         elif kind == "verdict":
             view["verdicts"].append(ev)
+        elif kind == "restore":
+            view["restores"].append(ev)
         elif kind == "wave":
             view["waves"].append(ev)
         elif kind == "shard_wave":
@@ -1315,6 +1341,31 @@ SHARD_DIFF_COUNTERS = tuple(
 )
 
 
+def _resume_wave(view: dict) -> Optional[int]:
+    """The wave a resumed run restarted from (its ``restore`` event),
+    or None for an uninterrupted run. Waves BELOW this are expected
+    absent from the resumed side — they ran in the killed process,
+    whose trace died with it — so the diff alignment compares the
+    overlap only; every counter in the overlap (including the running
+    ``unique_total``, which carries the pre-kill history) must still
+    match the baseline exactly."""
+    rs = view.get("restores") or []
+    if not rs:
+        return None
+    return min(int(r.get("wave") or 0) for r in rs)
+
+
+def _missing_ok(i: int, in_a: bool, in_b: bool,
+                rw_a: Optional[int], rw_b: Optional[int]) -> bool:
+    """Whether wave ``i`` being on one side only is explained by the
+    other side's resume point (pre-resume waves are expected absent)."""
+    if not in_a and rw_a is not None and i < rw_a:
+        return True
+    if not in_b and rw_b is not None and i < rw_b:
+        return True
+    return False
+
+
 def _shard_divergences(va: dict, vb: dict) -> list[dict]:
     """Shard-aware wave alignment (the mesh observability layer): for
     every wave BOTH sides have per-shard rows for, the multisets of
@@ -1329,8 +1380,11 @@ def _shard_divergences(va: dict, vb: dict) -> list[dict]:
     sa, sb = va["shard_waves"], vb["shard_waves"]
     if not sa and not sb:
         return out
+    rw_a, rw_b = _resume_wave(va), _resume_wave(vb)
     for i in sorted(set(sa) | set(sb)):
         if (i in sa) != (i in sb):
+            if _missing_ok(i, i in sa, i in sb, rw_a, rw_b):
+                continue  # pre-resume wave: expected absent
             out.append(
                 dict(wave=i, field="shard_present",
                      a=i in sa, b=i in sb)
@@ -1594,8 +1648,18 @@ def diff_traces(
     divergences = []
     wa = {w["wave"]: w for w in va["waves"]}
     wb = {w["wave"]: w for w in vb["waves"]}
+    # Resume-aware alignment (the durability layer): a RESUMED run's
+    # wave stream legitimately begins at its restore wave — the
+    # pre-kill waves died with the killed process's trace. Waves both
+    # sides have must still match on EVERY counter, and the running
+    # unique_total carries the pre-kill history, so "zero counter
+    # divergence over the overlap" is exactly the kill/resume parity
+    # proof (tools/crash_matrix.py's CKPT artifact verdict).
+    rw_a, rw_b = _resume_wave(va), _resume_wave(vb)
     for i in sorted(set(wa) | set(wb)):
         if i not in wa or i not in wb:
+            if _missing_ok(i, i in wa, i in wb, rw_a, rw_b):
+                continue  # pre-resume wave: expected absent
             divergences.append(
                 dict(wave=i, field="present",
                      a=i in wa, b=i in wb)
@@ -1626,9 +1690,19 @@ def diff_traces(
 
     memory = _memory_diff(va, vb, threshold)
     latency = _latency_diff(va, vb, threshold, min_sec)
+    if (rw_a is None) != (rw_b is None):
+        # One side resumed mid-run: its walls cover a PARTIAL search
+        # (plus a fresh process's compile fetches), so timing/byte
+        # lanes are not comparable to the uninterrupted side — only
+        # the counters are, and those stay fully enforced above. The
+        # lanes still print; the regression flags are cleared.
+        regressions = []
+        memory["regressions"] = []
+        latency["regressions"] = []
     return dict(
         run_a=va["run"], run_b=vb["run"],
         waves_a=len(va["waves"]), waves_b=len(vb["waves"]),
+        resume_wave_a=rw_a, resume_wave_b=rw_b,
         divergences=divergences,
         phases=phases,
         regressions=regressions,
@@ -1650,6 +1724,14 @@ def format_diff(report: dict) -> str:
         f"({report['waves_a']} waves) vs run B#{report['run_b']} "
         f"({report['waves_b']} waves)",
     ]
+    for side in ("a", "b"):
+        rw = report.get(f"resume_wave_{side}")
+        if rw is not None:
+            lines.append(
+                f"run {side.upper()} RESUMED at wave {rw}: "
+                "pre-resume waves excluded from alignment; timing "
+                "lanes informational (partial-run walls)"
+            )
     if report["divergences"]:
         lines.append(
             f"WAVE DIVERGENCE ({len(report['divergences'])} "

@@ -1,0 +1,365 @@
+#!/usr/bin/env python
+"""Crash matrix: the end-to-end proof that checkpoint/resume recovers.
+
+Runs every cell of the fault-injection matrix
+(stateright_tpu/faultinject.py) against one workload and verdicts each
+as **recovered** (kill or device fault → resumed/retried to the exact
+baseline count) or **refused** (torn snapshot, stale manifest → the
+named Snapshot* error) — the contract is recover-or-refuse-loudly,
+never a silent wrong answer:
+
+* ``kill`` — a SUBPROCESS runs the real CLI check lane with
+  ``--checkpoint-every`` and an armed ``STPU_FAULTS`` process kill at
+  a seeded chunk boundary (``os._exit(137)``, no cleanup — a real
+  preemption), then a second subprocess ``--resume``\\ s from the
+  snapshot; the resumed run's final count must equal the baseline's;
+* ``device_fault`` — in-process: an injected mid-chunk exception under
+  supervision (checkpoint.supervised_run) must self-recover from the
+  last snapshot to the identical count in ONE join;
+* ``torn_truncate`` / ``torn_flip`` — a valid snapshot damaged on disk
+  must be detected (``SnapshotCorruptError``) at resume;
+* ``stale_sha`` / ``stale_encoding`` — a rewritten manifest must be
+  refused (``SnapshotStaleError``) at resume.
+
+``--trace`` additionally runs the baseline and the resumed half
+traced (``TRACE_r*`` artifacts land in the repo root) and embeds the
+``tools/trace_diff.py`` verdict: the resumed run's wave stream must
+align with the uninterrupted baseline at ZERO counter divergence
+(telemetry's resume-aware alignment — pre-kill waves died with the
+killed process, the overlap must match exactly).
+
+``--json`` writes an auto-numbered ``CKPT_r*.json`` artifact (its own
+round sequence like MEM/LAT/COMM, via stateright_tpu/artifacts.py)
+carrying the per-cell verdicts, the snapshot byte size vs the memory
+ledger's predicted resident bytes, and the trace-diff block.
+bench.py embeds the newest CKPT artifact beside LINT/COMM
+(``artifacts.latest_ckpt_summary``).
+
+Usage:
+  python tools/crash_matrix.py                       # 2pc rm=4, fast
+  python tools/crash_matrix.py --workload paxos --count 4 --trace --json
+  python tools/crash_matrix.py --seed 7 --json
+
+Exit status: 0 all cells recover-or-refuse, 1 any cell failed,
+2 bad input.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_DONE_RE = re.compile(r"Done\. states=(\d+), unique=(\d+)")
+
+
+def _cli_env(extra_faults=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    if extra_faults:
+        env["STPU_FAULTS"] = extra_faults
+    else:
+        env.pop("STPU_FAULTS", None)
+    return env
+
+def _run_cli(args, faults=None, timeout=1800):
+    """One CLI subprocess; returns (returncode, unique_count|None,
+    new TRACE basenames)."""
+    before = set(glob.glob(os.path.join(REPO, "TRACE_r*.jsonl")))
+    proc = subprocess.run(
+        [sys.executable, "-m", "stateright_tpu"] + args,
+        cwd=REPO, env=_cli_env(faults),
+        capture_output=True, text=True, timeout=timeout,
+    )
+    unique = None
+    m = _DONE_RE.search(proc.stdout)
+    if m:
+        unique = int(m.group(2))
+    after = set(glob.glob(os.path.join(REPO, "TRACE_r*.jsonl")))
+    traces = sorted(os.path.basename(p) for p in after - before)
+    return proc, unique, traces
+
+
+def _spawn(workload, count, wps, **kw):
+    if workload == "2pc":
+        from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+        import math
+
+        capacity = 1 << max(10, math.ceil(2.6 * count + 1.5))
+        return TwoPhaseSys(rm_count=count).checker().spawn_tpu_sortmerge(
+            capacity=capacity,
+            frontier_capacity=max(256, capacity // 4),
+            cand_capacity="auto",
+            waves_per_sync=wps,
+            **kw,
+        )
+    from stateright_tpu.models.paxos import PaxosModelCfg, paxos_model
+    from stateright_tpu.models.paxos_tpu import STRUCTURAL_SIZES
+
+    return (
+        paxos_model(PaxosModelCfg(client_count=count, server_count=3))
+        .checker()
+        .spawn_tpu_sortmerge(
+            track_paths=count <= 2,
+            cand_capacity="auto",
+            waves_per_sync=wps,
+            **STRUCTURAL_SIZES[count],
+            **kw,
+        )
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="fault-injection crash matrix over the "
+        "checkpoint/resume path"
+    )
+    ap.add_argument("--workload", choices=("2pc", "paxos"),
+                    default="2pc")
+    ap.add_argument("--count", type=int, default=4,
+                    help="model size (2pc RMs / paxos clients; "
+                    "default 4)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="seeds the kill/fault chunk choice "
+                    "(faultinject.chunk_for_seed)")
+    ap.add_argument("--chunks-hint", type=int, default=5,
+                    help="upper bound fed to the seeded chunk pick "
+                    "(keep below the workload's real chunk count)")
+    ap.add_argument("--waves-per-sync", type=int, default=2,
+                    help="chunk cadence for every cell (default 2: "
+                    "many boundaries to kill at)")
+    ap.add_argument("--trace", action="store_true",
+                    help="trace the baseline + resumed runs and embed "
+                    "the trace_diff zero-divergence verdict")
+    ap.add_argument("--json", action="store_true",
+                    help="write an auto-numbered CKPT_r*.json "
+                    "artifact")
+    ap.add_argument("--root", default=None,
+                    help="artifact directory for --json (default: "
+                    "the repo root)")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from stateright_tpu import faultinject
+    from stateright_tpu.checkpoint import (
+        SnapshotCorruptError,
+        SnapshotStaleError,
+        load_snapshot,
+    )
+
+    wl_cli = {"2pc": "2pc", "paxos": "paxos"}[args.workload]
+    wps = args.waves_per_sync
+    kill_chunk = 1 + faultinject.chunk_for_seed(
+        args.seed, max(args.chunks_hint - 1, 1)
+    )
+    tmp = tempfile.mkdtemp(prefix="stpu_crash_matrix_")
+    snap = os.path.join(tmp, "matrix.ckpt")
+    cells: dict = {}
+    ok = True
+
+    def cell(name, verdict, **detail):
+        nonlocal ok
+        good = verdict in ("recovered", "refused")
+        if not good:
+            ok = False
+        cells[name] = dict(verdict=verdict, **detail)
+        print(f"  {name:16s} {verdict:10s} "
+              + " ".join(f"{k}={v}" for k, v in detail.items()))
+
+    print(f"crash matrix: {args.workload} count={args.count} "
+          f"seed={args.seed} kill_chunk={kill_chunk} "
+          f"waves_per_sync={wps}")
+
+    # -- baseline (subprocess CLI, optionally traced) ---------------------
+    base_args = [wl_cli, "check-tpu", str(args.count),
+                 f"--waves-per-sync={wps}"]
+    if args.trace:
+        base_args.append("--trace")
+    proc, baseline, base_traces = _run_cli(base_args)
+    if proc.returncode != 0 or baseline is None:
+        print(proc.stdout)
+        print(proc.stderr, file=sys.stderr)
+        print("crash_matrix: baseline run failed", file=sys.stderr)
+        sys.exit(2)
+    print(f"  baseline count: {baseline:,}"
+          + (f" (trace {base_traces})" if base_traces else ""))
+
+    # -- cell: process kill at a chunk boundary + resume ------------------
+    proc, _, _ = _run_cli(
+        base_args[:3] + [f"--waves-per-sync={wps}",
+                         "--checkpoint-every=1",
+                         f"--checkpoint-path={snap}"],
+        faults=f"kill@chunk_boundary:{kill_chunk}",
+    )
+    if proc.returncode != faultinject.KILL_EXIT_CODE:
+        cell("kill", "no_kill", returncode=proc.returncode,
+             note="run completed before the seeded kill chunk — "
+             "lower --chunks-hint")
+    elif not os.path.exists(snap):
+        cell("kill", "no_snapshot", returncode=proc.returncode)
+    else:
+        resume_args = base_args[:3] + [
+            f"--waves-per-sync={wps}", "--resume",
+            f"--checkpoint-path={snap}",
+        ]
+        if args.trace:
+            resume_args.append("--trace")
+        proc2, resumed, res_traces = _run_cli(resume_args)
+        if proc2.returncode != 0 or resumed != baseline:
+            print(proc2.stdout)
+            print(proc2.stderr, file=sys.stderr)
+            cell("kill", "count_mismatch", baseline=baseline,
+                 resumed=resumed, returncode=proc2.returncode)
+        else:
+            cell("kill", "recovered", kill_chunk=kill_chunk,
+                 baseline=baseline, resumed=resumed,
+                 **({"trace": res_traces[0]} if res_traces else {}))
+        if args.trace and base_traces and res_traces:
+            from stateright_tpu.telemetry import (
+                diff_traces,
+                load_trace,
+                validate_events,
+            )
+
+            a = load_trace(os.path.join(REPO, base_traces[0]))
+            b = load_trace(os.path.join(REPO, res_traces[0]))
+            validate_events(a)
+            validate_events(b)
+            rep = diff_traces(a, b)
+            cells["kill"]["trace_diff"] = dict(
+                baseline=base_traces[0],
+                resumed=res_traces[0],
+                resume_wave=rep["resume_wave_b"],
+                counter_divergences=len(rep["divergences"]),
+                ok=rep["ok"],
+            )
+            if rep["divergences"] or not rep["ok"]:
+                ok = False
+                cells["kill"]["verdict"] = "trace_divergence"
+            print(f"  trace_diff: {base_traces[0]} vs "
+                  f"{res_traces[0]} — "
+                  f"{len(rep['divergences'])} counter divergences, "
+                  f"resumed at wave {rep['resume_wave_b']}, "
+                  f"{'OK' if rep['ok'] else 'FAIL'}")
+
+    # -- snapshot bytes vs the memory ledger ------------------------------
+    snapshot_bytes = plan_bytes = None
+    if os.path.exists(snap):
+        manifest, _ = load_snapshot(snap)
+        snapshot_bytes = manifest.get("snapshot_bytes")
+
+    # -- cell: mid-chunk device fault, supervised self-recovery -----------
+    c = _spawn(args.workload, args.count, wps,
+               checkpoint_every=1,
+               checkpoint_path=os.path.join(tmp, "devfault.ckpt"))
+    c.retry_backoff_sec = 0.01
+    faultinject.arm("raise", "mid_chunk", kill_chunk)
+    import warnings as _warnings
+
+    try:
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore")
+            c.join()
+        n = c.unique_state_count()
+        if c.memory_plan:
+            plan_bytes = c.memory_plan.get("resident_bytes")
+        cell("device_fault",
+             "recovered" if n == baseline else "count_mismatch",
+             count=n)
+    except Exception as exc:
+        cell("device_fault", "raised",
+             error=f"{type(exc).__name__}: {exc}")
+    finally:
+        faultinject.disarm_all()
+
+    # -- cells: torn snapshot ---------------------------------------------
+    for mode in ("truncate", "flip"):
+        name = f"torn_{mode}"
+        if not os.path.exists(snap):
+            cell(name, "no_snapshot")
+            continue
+        bad = os.path.join(tmp, f"{name}.ckpt")
+        shutil.copy(snap, bad)
+        faultinject.corrupt_snapshot(bad, mode, seed=args.seed)
+        try:
+            _spawn(args.workload, args.count, wps).resume_from(bad)
+            cell(name, "undetected")
+        except SnapshotCorruptError as exc:
+            cell(name, "refused", error=type(exc).__name__)
+        except Exception as exc:
+            cell(name, "wrong_error",
+                 error=f"{type(exc).__name__}: {exc}")
+
+    # -- cells: stale manifest --------------------------------------------
+    for field in ("git_sha", "encoding"):
+        name = f"stale_{field.replace('git_', '')}"
+        if not os.path.exists(snap):
+            cell(name, "no_snapshot")
+            continue
+        bad = os.path.join(tmp, f"{name}.ckpt")
+        shutil.copy(snap, bad)
+        faultinject.stale_manifest(bad, field)
+        try:
+            _spawn(args.workload, args.count, wps).resume_from(bad)
+            cell(name, "undetected")
+        except SnapshotStaleError as exc:
+            cell(name, "refused", error=type(exc).__name__)
+        except Exception as exc:
+            cell(name, "wrong_error",
+                 error=f"{type(exc).__name__}: {exc}")
+
+    print(f"verdict: {'CLEAN' if ok else 'FAIL'} "
+          f"({sum(1 for c in cells.values() if c['verdict'] in ('recovered', 'refused'))}"
+          f"/{len(cells)} cells recover-or-refuse)")
+    if snapshot_bytes is not None:
+        print(f"snapshot bytes: {snapshot_bytes:,}"
+              + (f" (memplan resident: {plan_bytes:,})"
+                 if plan_bytes else ""))
+
+    if args.json:
+        from stateright_tpu.artifacts import (
+            artifact_path,
+            next_round,
+            provenance,
+        )
+
+        root = args.root or REPO
+        path = artifact_path(
+            "CKPT", "json", root=root,
+            round=next_round(root, stems=("CKPT",)),
+        )
+        doc = dict(
+            workload=args.workload,
+            count=args.count,
+            seed=args.seed,
+            kill_chunk=kill_chunk,
+            waves_per_sync=wps,
+            baseline_unique=baseline,
+            snapshot_bytes=snapshot_bytes,
+            memplan_resident_bytes=plan_bytes,
+            cells=cells,
+            clean=ok,
+            provenance=provenance(),
+        )
+        with open(path, "w") as fh:
+            json.dump(doc, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote {path}")
+
+    shutil.rmtree(tmp, ignore_errors=True)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -92,6 +92,12 @@ def format_report(summary: dict) -> str:
             f"{prof['waves']} waves, run wall "
             f"{_sec(prof['run_wall_sec'])}):"
         )
+        if prof.get("resumed_from_wave") is not None:
+            lines.append(
+                f"  RESUMED from wave {prof['resumed_from_wave']}: "
+                "walls cover the resumed half only (time to first "
+                "wave = first wave AFTER the restore)"
+            )
 
         def share(x):
             return f" ({x:.1%})" if x is not None else ""

@@ -168,6 +168,54 @@ def tpu_workloads(quick=False):
 
         return spawn
 
+    # The COMPILED pair (ROADMAP direction 5: bench lanes over the
+    # compiled encodings, beside their hand-encoding lanes): the
+    # actor-model 2pc and actor paxos through the generic
+    # actor->encoding compiler, zero hand device code, at the
+    # host-parity-pinned registry configs. These lanes are what makes
+    # any residual compiled-vs-hand throughput gap CHASEABLE — the
+    # hand lanes ("2pc rm=N", "paxos Nc/3s") are the denominators,
+    # and every lane's detail carries the lint/comms artifact names
+    # the codegen contract was verified under.
+    def twopc_actors(rm, **kw):
+        def spawn():
+            from stateright_tpu.actor.compile import (
+                compile_actor_model,
+            )
+            from stateright_tpu.models.two_phase_commit_actors import (
+                two_phase_actor_device_specs,
+                two_phase_actor_model,
+            )
+
+            model = two_phase_actor_model(rm)
+            enc = compile_actor_model(
+                model, **two_phase_actor_device_specs(rm)
+            )
+            return model.checker().spawn_tpu_sortmerge(
+                encoded=enc, track_paths=False,
+                cand_capacity="auto", **kw,
+            )
+
+        return spawn
+
+    def paxos_compiled(clients, servers, **kw):
+        def spawn():
+            from stateright_tpu.models.paxos import (
+                paxos_compiled_encoded,
+            )
+
+            cfg = PaxosModelCfg(
+                client_count=clients, server_count=servers,
+                put_count=1,
+            )
+            enc = paxos_compiled_encoded(cfg)
+            return paxos_model(cfg).checker().spawn_tpu_sortmerge(
+                encoded=enc, track_paths=False,
+                cand_capacity="auto", **kw,
+            )
+
+        return spawn
+
     loads = [
         (
             # Driver config `2pc check 3` (examples/2pc.rs:153-154).
@@ -176,6 +224,27 @@ def tpu_workloads(quick=False):
             twopc(3, hybrid=True, capacity=1 << 10,
                   frontier_capacity=1 << 8),
             288,
+        ),
+        (
+            # The compiled 2pc lane beside its hand lanes (the
+            # registry fixture scaled one RM up; host-parity pinned
+            # in tests/test_actor_compile.py — 306 at rm=2, 3,846
+            # at rm=3).
+            "2pc-actors rm=3 (compiled)",
+            twopc_actors(3, capacity=1 << 13,
+                         frontier_capacity=1 << 11),
+            None,
+            3846,
+        ),
+        (
+            # The compiled paxos lane beside the hand paxos lanes
+            # (the registry config: reachable-mode harvest, count
+            # host-parity pinned).
+            "paxos 2c/2s (compiled)",
+            paxos_compiled(2, 2, capacity=1 << 9,
+                           frontier_capacity=1 << 7),
+            None,
+            111,
         ),
         (
             # Driver config `increment_lock` (examples/increment_lock.rs

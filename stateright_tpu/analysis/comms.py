@@ -96,11 +96,16 @@ def _mesh(n_shards: int):
 
 def comms_fixture_params(reconciliation: bool = True) -> list:
     """The wave-body fixture matrix: (engine, traced) x the default
-    2pc-rm3/S=2 config, plus the rm=5/S=8 reconciliation config."""
+    2pc-rm3/S=2 config, plus the rm=5/S=8 reconciliation config,
+    plus the TIERED sort-merge chunk program (round 16,
+    stateright_tpu/tier.py — the deferred-commit wave + commit path
+    holds to the same five comms rules: the commit's v-ladder switch
+    must stay collective-free, its termination psums scalar-only)."""
     out = []
     for engine in ("sortmerge", "hash"):
         for traced in (False, True):
             out.append(dict(engine=engine, traced=traced))
+    out.append(dict(engine="sortmerge", traced=True, tiered=True))
     if reconciliation:
         out.append(dict(
             engine="sortmerge", traced=True,
@@ -110,12 +115,14 @@ def comms_fixture_params(reconciliation: bool = True) -> list:
 
 
 def comms_fixture_name(engine: str, traced: bool,
-                       config: Optional[dict] = None) -> str:
+                       config: Optional[dict] = None,
+                       tiered: bool = False) -> str:
     cfg = config or {}
     rm = cfg.get("rm_count", 3)
     s = cfg.get("n_shards", COMMS_WAVE_SHARDS)
     return (
         f"comms(2pc-rm{rm},{engine},S{s}"
+        + (",tiered" if tiered else "")
         + (",traced" if traced else "")
         + ")"
     )
@@ -123,7 +130,8 @@ def comms_fixture_name(engine: str, traced: bool,
 
 def trace_comms_fixture(engine: str = "sortmerge",
                         traced: bool = False,
-                        config: Optional[dict] = None) -> dict:
+                        config: Optional[dict] = None,
+                        tiered: bool = False) -> dict:
     """Build one sharded engine on a real S-shard mesh and trace its
     full wave body (the ``_wave_body_sm`` hook both engines expose)
     on the seed program's carry shapes — abstract (``eval_shape``), no
@@ -150,7 +158,7 @@ def trace_comms_fixture(engine: str = "sortmerge",
     )
     if config:
         cfg.update(config)
-    name = comms_fixture_name(engine, traced, cfg)
+    name = comms_fixture_name(engine, traced, cfg, tiered)
     rm = cfg.pop("rm_count")
     mesh = _mesh(cfg.pop("n_shards"))
     builder = TwoPhaseSys(rm_count=rm).checker()
@@ -171,6 +179,49 @@ def trace_comms_fixture(engine: str = "sortmerge",
     init = jnp.asarray(checker.encoded.init_vecs())
     seed_fn, _chunk_fn = checker._build_programs(init.shape[0])
     carry_shapes = jax.eval_shape(seed_fn, init)
+    if tiered:
+        # The TIERED chunk program (stateright_tpu/tier.py): the
+        # deferred-commit carry adds the pend/hot staging lanes and
+        # the tier-shaped trace logs, and the program takes the
+        # host's keep mask as a second, shard-split input. Traced as
+        # one (carry, keep) pytree arg so the --hlo pass's
+        # single-operand lower() keeps working.
+        from ..telemetry import SHARD_LOG_LANES as SL
+        from ..telemetry import WAVE_LOG_LANES as WL
+
+        tier_fn = checker._build_programs(
+            init.shape[0], tiered=True
+        )
+        S = checker.n_shards
+        F = checker.frontier_capacity
+        sds = jax.ShapeDtypeStruct
+        ct = dict(carry_shapes)
+        ct["pend_keys"] = sds((2, S * F), jnp.uint32)
+        if checker.track_paths:
+            ct["pend_par"] = sds((2, S * F), jnp.uint32)
+        ct["pend_n"] = sds((S,), jnp.uint32)
+        ct["pend_valid"] = sds((), jnp.bool_)
+        ct["h_loc"] = sds((S,), jnp.uint32)
+        if traced:
+            ct["wlog"] = sds((1, WL), jnp.uint32)
+            ct["pstash"] = sds((8,), jnp.uint32)
+            ct["slog"] = sds((S, SL), jnp.uint32)
+            ct["swave"] = sds((S * SL,), jnp.uint32)
+        keep = sds((S * F,), jnp.bool_)
+
+        def fn(args):
+            return tier_fn(args[0], args[1])
+
+        carry = (ct, keep)
+        return dict(
+            name=name,
+            closed=jax.make_jaxpr(fn)(carry),
+            fn=fn,
+            carry=carry,
+            seam=seam,
+            lane=checker._lane_config(),
+            n_shards=int(mesh.devices.size),
+        )
     fn = checker._wave_body_sm
     return dict(
         name=name,

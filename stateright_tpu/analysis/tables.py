@@ -206,6 +206,11 @@ COMMS_BYTE_BUDGETS = {
     "comms(2pc-rm3,hash,S2)": 75_000,
     "comms(2pc-rm3,hash,S2,traced)": 75_000,
     "comms(2pc-rm5,sortmerge,S8,traced)": 300_000,
+    # the TIERED chunk program (round 16, stateright_tpu/tier.py):
+    # the same wave body plus the commit phase's scalar psums/pmax —
+    # measured per-wave peak 57,452 B vs the untiered 57,436 B
+    # (+16 B = one conf psum + the h_loc pmax), same ~30% headroom
+    "comms(2pc-rm3,sortmerge,S2,tiered,traced)": 75_000,
 }
 
 

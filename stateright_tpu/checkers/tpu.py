@@ -506,11 +506,17 @@ class TpuBfsChecker(Checker):
         #: (checkpoint.supervised_run) then retries a failed chunk —
         #: device error, injected fault, OOM — from the last snapshot
         #: instead of dying.
-        if checkpoint_every is not None and checkpoint_every < 1:
+        if checkpoint_every == "auto":
+            # cadence picked from the measured snapshot write wall vs
+            # chunk wall (checkpoint.auto_cadence, target <=5%
+            # overhead); starts at every-chunk until both walls exist
+            pass
+        elif checkpoint_every is not None and checkpoint_every < 1:
             raise ValueError(
                 f"checkpoint_every must be >= 1: {checkpoint_every}"
             )
         self.checkpoint_every = checkpoint_every
+        self._ckpt_auto_every = 1
         self.checkpoint_path = checkpoint_path or (
             "stateright_tpu.ckpt" if checkpoint_every else None
         )
@@ -1072,10 +1078,23 @@ class TpuBfsChecker(Checker):
         # fault-injection sites key on it; restarts at 0 on a resumed
         # attempt so an armed once-only fault can't re-trip itself)
         chunk_no = 0
+        self._tier_mem = None
         while True:
             if self.cancel_event is not None and self.cancel_event.is_set():
                 self.cancelled = True
                 return
+            # Tiered visited set (stateright_tpu/tier.py): once the
+            # hot ceiling is crossed (or a resumed snapshot carries
+            # cold runs), the sort-merge engines take the run over —
+            # spill at this sync, then the deferred-commit tiered
+            # chunk loop to completion. No-op (None) on every other
+            # engine and below the ceiling.
+            took = self._tier_takeover(carry, n0, chunk_no, reporter)
+            if took is not None:
+                carry, s = took
+                if self.cancelled:
+                    return
+                break
             t0 = time.monotonic()
             chunk_snap = _monitor_snapshot() if ledger_pending else None
             # Sharded engines return a third output when traced: the
@@ -1187,30 +1206,7 @@ class TpuBfsChecker(Checker):
             )
             if mem_peak is not None:
                 self.metrics["device_peak_bytes"] = mem_peak
-            overflow_msg = None
-            if bool(s[1]):
-                overflow_msg = (
-                    f"visited table overflow (capacity={self.capacity}); "
-                    "re-run with a larger capacity"
-                )
-            elif bool(s[2]):
-                overflow_msg = (
-                    f"frontier overflow: a wave produced more than "
-                    f"{F} new states; re-run with a larger "
-                    "frontier_capacity"
-                )
-            elif bool(s[9]):
-                overflow_msg = self._cand_overflow_message()
-            elif bool(s[10]):
-                overflow_msg = (
-                    "encoding-bound overflow: a successor was pruned by "
-                    "an internal encoding bound (e.g. a compiled envelope "
-                    "count reached 128, a declared FIFO queue bound, or "
-                    "an un-harvested history transition) — the state "
-                    "space would be silently truncated. Bound the model "
-                    "(boundary/closure bounds) or use an encoding with "
-                    "wider fields."
-                )
+            overflow_msg = self._overflow_message(s)
             if overflow_msg is not None:
                 # Surface the engine-variant peak metrics (e.g.
                 # max_wave_candidates) before raising — the overflow
@@ -1252,13 +1248,17 @@ class TpuBfsChecker(Checker):
             # overflowed chunk — a clean completion needs no snapshot
             # and an overflowed carry is not a resume point.
             if (self.checkpoint_every and not done
-                    and (chunk_no + 1) % self.checkpoint_every == 0):
+                    and (chunk_no + 1) % self._ckpt_cadence() == 0):
                 from .. import checkpoint as _ckpt
 
+                t_ck = time.monotonic()
                 _ckpt.write_snapshot(
                     self, carry, self.checkpoint_path,
                     chunk=chunk_no, wave=int(s[4]),
                     depth=int(s[3]), unique=int(s[8]),
+                )
+                self._note_snapshot_wall(
+                    time.monotonic() - t_ck, t1 - t0
                 )
             # fault-injection seam: the chunk boundary — AFTER the
             # snapshot write, so an injected kill here proves the
@@ -1295,6 +1295,44 @@ class TpuBfsChecker(Checker):
             self._final_carry = carry
         self._consume_extra_stats(s[11 + 3 * n_props :])
         self._record_discoveries(s, props, reconstruct=True)
+
+    def _overflow_message(self, s) -> Optional[str]:
+        """The engine's overflow verdict from one chunk's packed stats
+        (one home — the tiered takeover loop raises through the same
+        messages the untiered chunk loop does)."""
+        if bool(s[1]):
+            return (
+                f"visited table overflow (capacity={self.capacity}); "
+                "re-run with a larger capacity"
+            )
+        if bool(s[2]):
+            return (
+                f"frontier overflow: a wave produced more than "
+                f"{self.frontier_capacity} new states; re-run with a "
+                "larger frontier_capacity"
+            )
+        if bool(s[9]):
+            return self._cand_overflow_message()
+        if bool(s[10]):
+            return (
+                "encoding-bound overflow: a successor was pruned by "
+                "an internal encoding bound (e.g. a compiled envelope "
+                "count reached 128, a declared FIFO queue bound, or "
+                "an un-harvested history transition) — the state "
+                "space would be silently truncated. Bound the model "
+                "(boundary/closure bounds) or use an encoding with "
+                "wider fields."
+            )
+        return None
+
+    def _tier_takeover(self, carry, n0, chunk_no, reporter):
+        """Tiered-visited-set hook (stateright_tpu/tier.py), called at
+        the top of every chunk iteration: None = stay on the untiered
+        chunk loop. The sort-merge engines override — once the hot
+        ceiling is crossed or resumed cold runs exist, they spill and
+        run the deferred-commit tiered loop to completion, returning
+        the final ``(carry, stats)``."""
+        return None
 
     def _record_discoveries(self, s, props, reconstruct=False) -> None:
         """Parse the cumulative discovery lanes out of a chunk's packed
@@ -1511,20 +1549,70 @@ class TpuBfsChecker(Checker):
             total_bytes=int(resident_bytes + class_peak + merge_peak),
         )
 
+    def _ckpt_cadence(self) -> int:
+        """The effective chunks-per-snapshot: the literal
+        ``checkpoint_every``, or — at ``"auto"`` — the cadence
+        :func:`checkpoint.auto_cadence` derived from the measured
+        snapshot and chunk walls (every chunk until both exist)."""
+        if self.checkpoint_every == "auto":
+            return self._ckpt_auto_every
+        return int(self.checkpoint_every)
+
+    def _note_snapshot_wall(self, snap_sec: float,
+                            chunk_sec: float) -> None:
+        """Feed the measured walls into the auto-cadence policy
+        (``checkpoint_every="auto"``): re-derive the cadence after
+        every snapshot so it tracks the run's real chunk wall. A
+        cadence change lands as a ``checkpoint_cadence`` event."""
+        if self.checkpoint_every != "auto":
+            return
+        from .. import checkpoint as _ckpt
+        from .. import telemetry
+
+        new = _ckpt.auto_cadence(snap_sec, chunk_sec)
+        if new != self._ckpt_auto_every:
+            telemetry.emit(
+                "checkpoint_cadence",
+                old=int(self._ckpt_auto_every), new=int(new),
+                snapshot_sec=round(snap_sec, 6),
+                chunk_sec=round(chunk_sec, 6),
+            )
+            self._ckpt_auto_every = new
+
     def _emit_memory_watermark(self, tracer, peak, source,
                                polls) -> None:
         """The run-end watermark event: device peak bytes (from the
         per-chunk polls), visited/budget headroom, and the capacity
         projection — the numbers the tiered-visited-set and
-        HBM-staging decisions (ROADMAP directions 1b/2b) read."""
+        HBM-staging decisions (ROADMAP directions 1b/2b) read.
+        ``cold_tier_bytes`` (round 16) prices the host-DRAM cold tier
+        so capacity headroom accounts for BOTH tiers; the tiered
+        takeover loop's own polls merge in through ``_tier_mem``."""
+        tmem = getattr(self, "_tier_mem", None)
+        if tmem is not None:
+            t_peak, t_src, t_polls = tmem
+            if t_peak is not None:
+                peak = (t_peak if peak is None
+                        else max(int(peak), int(t_peak)))
+                source = source or t_src
+            polls = int(polls) + int(t_polls)
+        tier = self._tier_headroom()
         tracer.event(
             "memory_watermark",
             source=source,
             device_peak_bytes=(None if peak is None else int(peak)),
             polls=int(polls),
+            cold_tier_bytes=(None if tier is None
+                             else tier.get("cold_bytes_total")),
             headroom=self._memory_headroom(),
             projection=self._memory_projection(),
         )
+
+    def _tier_headroom(self):
+        """Cold-tier accounting for the watermark/headroom views
+        (None on engines without a tiered visited set, and on
+        sort-merge runs that never spilled)."""
+        return None
 
     def _visited_bytes_per_row(self) -> int:
         """Logical device bytes per visited entry: two uint32 key-limb
@@ -1546,6 +1634,7 @@ class TpuBfsChecker(Checker):
             visited_used_bytes=int(u * bpr),
             visited_capacity_bytes=int(cap * bpr),
             budget=self._budget_headroom(),
+            tier=self._tier_headroom(),
         )
 
     def _budget_headroom(self):

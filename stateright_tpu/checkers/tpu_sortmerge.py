@@ -154,6 +154,12 @@ from .tpu import (
 
 _SENT = 0xFFFFFFFF
 
+#: compiled TIERED chunk programs (stateright_tpu/tier.py), keyed by
+#: the untiered program identity + the tier marker — a separate cache
+#: from tpu._CHUNK_CACHE so tiered builds never touch the untiered
+#: entries (or their _build_info/_carry_pspecs riders).
+_TIER_CACHE: dict = {}
+
 
 def payload_width(W: int, track_paths: bool) -> int:
     """Lanes of the packed candidate payload (see payload_pack)."""
@@ -466,6 +472,9 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         pair_width: int | None = None,
         mask_budget_cells: int = 1 << 23,
         merge_impl: str | None = None,
+        tier_hot_rows=None,
+        tier_budget_bytes: int | None = None,
+        tier_max_runs: int = 8,
         **kwargs,
     ):
         #: ``cand_capacity="auto"`` (VERDICT r4 item 7): size the
@@ -501,6 +510,26 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         #: JAX); "pallas_interpret" runs the kernel under the Pallas
         #: interpreter — the tier-1 CPU gate for the kernel itself.
         self.merge_impl = resolve_impl(merge_impl)
+        #: Tiered visited set (stateright_tpu/tier.py, ROADMAP 1b):
+        #: None = off (the all-resident engine, byte-identical to
+        #: round 15); an int = the hot-tier ladder ceiling in visited
+        #: rows (tests force it tiny to spill repeatedly); "auto" =
+        #: decided by the memplan capacity projection against
+        #: ``tier_budget_bytes`` (memplan.decide_hot_rows — the
+        #: projection is exactly the split signal). Host-side only:
+        #: the untiered chunk programs compile byte-identically; the
+        #: tiered program is a second, separately-keyed program built
+        #: lazily at the first spill.
+        self.tier_hot_rows = tier_hot_rows
+        self.tier_budget_bytes = tier_budget_bytes
+        self.tier_max_runs = tier_max_runs
+        #: the live ColdStore while a tiered run is in flight, and
+        #: the resume-staged tier state (checkpoint.resume_from)
+        self._tier_state = None
+        self._tier_resume_state = None
+        self._tier_hot_ceiling = None
+        self._tier_spill_wall = 0.0
+        self._tier_plog_rows = None
         if tiles > 1 and self.frontier_capacity % tiles:
             raise ValueError(
                 f"frontier_capacity {self.frontier_capacity} not divisible "
@@ -755,6 +784,10 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         self._max_depth = 0
         self.metrics = {}
         self.generated = None
+        # a resized re-run re-explores (and re-spills) from scratch
+        self._tier_state = None
+        self._tier_plog_rows = None
+        self._tier_mem = None
 
     def _checkpoint_family(self) -> str:
         # Both sort-merge engines carry the same sorted-prefix visited
@@ -793,6 +826,555 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         self._programs = None
         self.memory_plan = None
         return True
+
+    # -- tiered visited set (stateright_tpu/tier.py, ROADMAP 1b) -----------
+
+    def _overflow_message(self, s):
+        msg = super()._overflow_message(s)
+        if (msg is not None and bool(s[1])
+                and self.tier_hot_rows is not None):
+            # the takeover only runs at chunk syncs: one UNTIERED
+            # transition chunk can carry the resident count from the
+            # ceiling past the capacity before the first spill fires
+            msg += (
+                "  (tiering is configured but the ceiling was "
+                "crossed and overrun within one untiered chunk — "
+                "lower waves_per_sync so a sync lands between the "
+                "ceiling and the capacity, or lower tier_hot_rows)"
+            )
+        return msg
+
+    def _tier_ceiling(self):
+        """The hot-tier ladder ceiling in visited rows (None = tier
+        off). ``"auto"`` resolves through the memplan capacity
+        projection's own pricing (memplan.decide_hot_rows) against
+        ``tier_budget_bytes``."""
+        if self.tier_hot_rows is None:
+            return None
+        if self._tier_hot_ceiling is None:
+            if self.tier_hot_rows == "auto":
+                from ..memplan import decide_hot_rows
+
+                budget = self.tier_budget_bytes or (1 << 31)
+                self._tier_hot_ceiling = decide_hot_rows(
+                    self.capacity, self.v_min, self.v_ladder_step,
+                    self.frontier_capacity, budget,
+                )
+            else:
+                hr = int(self.tier_hot_rows)
+                if hr < 1:
+                    raise ValueError(
+                        f"tier_hot_rows must be >= 1: {hr}"
+                    )
+                self._tier_hot_ceiling = min(hr, self.capacity)
+        return self._tier_hot_ceiling
+
+    def _tier_headroom(self):
+        cold = self._tier_state
+        if cold is None:
+            return None
+        out = cold.summary()
+        out["hot_ceiling_rows"] = self._tier_hot_ceiling
+        out["spill_wall_sec"] = round(self._tier_spill_wall, 6)
+        return out
+
+    def _reset_for_resume(self) -> None:
+        super()._reset_for_resume()
+        self._tier_state = None
+        self._tier_plog_rows = None
+        self._tier_mem = None
+
+    def _tier_takeover(self, carry, n0, chunk_no, reporter):
+        staged = self._tier_resume_state
+        ceiling = self._tier_ceiling()
+        if ceiling is None and staged is None:
+            return None
+        if staged is None:
+            h_np = self._tier_resident_counts(carry)
+            limit = min(
+                ceiling, max(self.capacity - self.frontier_capacity, 1)
+            )
+            if int(h_np.max()) <= limit:
+                return None
+        return self._tier_run(carry, n0, chunk_no, reporter)
+
+    def _lookup_tier_programs(self, n0: int):
+        """Build-or-fetch the TIERED chunk program — a separate
+        program (and cache slot) from the untiered pair, keyed by the
+        same program identity plus the tier marker. Never touches the
+        untiered cache entry, ``_wave_body``, or ``_build_info``."""
+        key = self._program_cache_key(n0)
+        if key is None:
+            fn = self._build_programs(n0, tiered=True)
+            return fn
+        tkey = (key, "tiered")
+        if tkey not in _TIER_CACHE:
+            fn = self._build_programs(n0, tiered=True)
+            _TIER_CACHE[tkey] = (
+                fn, getattr(self, "_tier_pspecs", None)
+            )
+        fn, self._tier_pspecs = _TIER_CACHE[tkey]
+        return fn
+
+    # engine-shape hooks the shared loop uses (the sharded engine
+    # overrides placement and the hot/pend lane layouts)
+
+    def _tier_resident_counts(self, carry) -> np.ndarray:
+        return np.array([int(np.asarray(carry["new"]))], np.int64)
+
+    def _tier_hot_lane(self) -> str:
+        return "n_hot"
+
+    def _tier_zero_hot(self):
+        return np.uint32(0)
+
+    def _tier_hot_value(self, h_np):
+        return np.uint32(int(h_np[0]))
+
+    def _tier_zero_pl(self):
+        return np.uint32(0)
+
+    def _tier_place(self, name, arr):
+        # jax-owned COPY: the tiered chunk donates its carry, and a
+        # zero-copy upload aliasing numpy memory under donate_argnums
+        # is the exact round-15 bug class (checkpoint.py)
+        import jax.numpy as jnp
+
+        return jnp.copy(jnp.asarray(arr))
+
+    def _tier_mask_dev(self, mask_np: np.ndarray):
+        import jax.numpy as jnp
+
+        return jnp.asarray(np.ascontiguousarray(mask_np.reshape(-1)))
+
+    def _tier_shard_rows(self, shard_log):
+        return None
+
+    def _tier_pend_zero(self):
+        return np.uint32(0)
+
+    def _tier_extend_carry(self, carry, h_np):
+        """The handoff: untiered carry + the tiered staging lanes
+        (empty pend, hot count, tier-shaped trace logs)."""
+        S = getattr(self, "n_shards", 1)
+        F = self.frontier_capacity
+        ext = dict(carry)
+        ext["pend_keys"] = self._tier_place(
+            "pend_keys", np.full((2, S * F), _SENT, np.uint32)
+        )
+        if self.track_paths:
+            ext["pend_par"] = self._tier_place(
+                "pend_par", np.zeros((2, S * F), np.uint32)
+            )
+        ext["pend_n"] = self._tier_place(
+            "pend_n", self._tier_pend_zero()
+        )
+        ext["pend_valid"] = self._tier_place(
+            "pend_valid", np.bool_(False)
+        )
+        ext[self._tier_hot_lane()] = self._tier_place(
+            self._tier_hot_lane(), self._tier_hot_value(h_np)
+        )
+        if self._wave_log_enabled():
+            from ..telemetry import WAVE_LOG_LANES as WL
+
+            ext["wlog"] = self._tier_place(
+                "wlog", np.zeros((1, WL), np.uint32)
+            )
+            ext["pstash"] = self._tier_place(
+                "pstash", np.zeros(8, np.uint32)
+            )
+            self._tier_extend_trace(ext)
+        return ext
+
+    def _tier_extend_trace(self, ext) -> None:
+        """Hook: extra tier-shaped trace lanes (the sharded engine's
+        per-shard mesh log)."""
+
+    def _tier_pend_read(self, carry):
+        S = getattr(self, "n_shards", 1)
+        F = self.frontier_capacity
+        pk = np.asarray(carry["pend_keys"]).reshape(2, S, F)
+        pn = np.atleast_1d(
+            np.asarray(carry["pend_n"])
+        ).astype(np.int64).reshape(-1)
+        return pn, pk[0], pk[1]
+
+    def _tier_spill(self, carry, cold, h_np):
+        """Spill the whole hot prefix to the cold store at the sync:
+        the prefix download piggybacks the readback that just blocked
+        (the checkpoint seam), ingest runs on the worker thread
+        overlapped with the next dispatch, and the device hot tier
+        resets to empty. Emits the schema-validated ``tier_spill``
+        event."""
+        import time as _time
+
+        from .. import telemetry
+
+        t0 = _time.monotonic()
+        S = getattr(self, "n_shards", 1)
+        C_pad = self.capacity + self.frontier_capacity
+        vk = np.asarray(carry["vkeys"]).reshape(2, S, C_pad)
+        per_shard = []
+        for s_i in range(S):
+            n = int(h_np[s_i])
+            per_shard.append((
+                vk[0, s_i, :n].copy(), vk[1, s_i, :n].copy()
+            ))
+        prev_rows = cold.rows()
+        prev_runs = cold.run_count()
+        cold.ingest(per_shard)
+        carry = dict(carry)
+        carry["vkeys"] = self._tier_place(
+            "vkeys", np.full((2, S * C_pad), _SENT, np.uint32)
+        )
+        carry[self._tier_hot_lane()] = self._tier_place(
+            self._tier_hot_lane(), self._tier_zero_hot()
+        )
+        wall = _time.monotonic() - t0
+        self._tier_spill_wall += wall
+        rows = int(h_np.sum())
+        from ..tier import COLD_BYTES_PER_ROW
+
+        telemetry.emit(
+            "tier_spill",
+            engine=type(self).__name__,
+            rows=rows,
+            bytes=rows * COLD_BYTES_PER_ROW,
+            rows_per_shard=[int(x) for x in h_np],
+            hot_rows_before=rows,
+            hot_ceiling_rows=self._tier_hot_ceiling,
+            spill_index=int(cold.spills),
+            # pre-compaction run count (ingest is async; compaction
+            # may fold runs before the next sync)
+            runs=int(prev_runs + sum(1 for lo, _ in per_shard
+                                     if lo.size)),
+            cold_rows_total=int(prev_rows + rows),
+            cold_bytes_total=int(
+                (prev_rows + rows) * COLD_BYTES_PER_ROW
+            ),
+            wall_sec=round(wall, 6),
+            ingest_sec=round(cold.ingest_sec, 6),
+        )
+        return carry
+
+    def _tier_plog_reset(self, carry):
+        """Take the device parent log's rows host-side and rewind the
+        cursor — tiered runs outgrow the device log (it is sized for
+        one capacity's worth of uniques, the cumulative count is
+        unbounded), so the host accumulates the drained rows and
+        ``_build_generated`` reads them instead."""
+        S = getattr(self, "n_shards", 1)
+        L = self.capacity + self.frontier_capacity
+        pl = np.atleast_1d(
+            np.asarray(carry["pl_n"])
+        ).astype(np.int64).reshape(-1)
+        plog = np.asarray(carry["plog"]).reshape(4, S, L)
+        rows = []
+        for s_i in range(S):
+            n = int(pl[s_i])
+            if n:
+                rows.append(plog[:, s_i, :n].copy())
+        carry = dict(carry)
+        carry["pl_n"] = self._tier_place("pl_n", self._tier_zero_pl())
+        return carry, rows
+
+    def _tier_plog_drain(self, carry, pl_cursor, confs):
+        """Per-dispatch drain of the rows the commit just appended
+        (the host knows the count — it built the keep mask), with a
+        cursor rewind when the device log nears its end. Slices
+        DEVICE-side before materializing, so only the freshly
+        appended ≤F rows transfer — not the whole [4, S*L] log
+        (which would be a multi-MB D2H per wave on real HBM)."""
+        S = getattr(self, "n_shards", 1)
+        F = self.frontier_capacity
+        L = self.capacity + F
+        if int(confs.sum()):
+            plog = carry["plog"]
+            for s_i in range(S):
+                cnf = int(confs[s_i])
+                if cnf:
+                    st = int(pl_cursor[s_i])
+                    off = s_i * L + st
+                    self._tier_plog_rows.append(
+                        np.asarray(plog[:, off:off + cnf])
+                    )
+                    pl_cursor[s_i] = st + cnf
+        if int(pl_cursor.max()) + F > L:
+            carry = dict(carry)
+            carry["pl_n"] = self._tier_place(
+                "pl_n", self._tier_zero_pl()
+            )
+            pl_cursor[:] = 0
+        return carry
+
+    def _tier_generated_map(self):
+        rows = getattr(self, "_tier_plog_rows", None)
+        if rows is None:
+            return None
+        generated: dict = {}
+        for blk in rows:
+            child = (
+                blk[3].astype(np.uint64) << np.uint64(32)
+            ) | blk[2].astype(np.uint64)
+            parent = (
+                blk[1].astype(np.uint64) << np.uint64(32)
+            ) | blk[0].astype(np.uint64)
+            for ch, pa in zip(child.tolist(), parent.tolist()):
+                generated[int(ch)] = int(pa) if pa else None
+        return generated
+
+    def _tier_run(self, carry, n0, chunk_no, reporter):
+        """The tiered chunk loop (the host side of the deferred-commit
+        protocol): per dispatch — commit the previous wave's survivors
+        under the mask computed here, run one wave, read the new
+        provisional winners at the one sync, run the batched
+        sort-merge membership against the cold runs, spill the hot
+        prefix when it crosses the ceiling. Returns the final
+        ``(carry, stats)`` to the shared completion path in tpu.py."""
+        import time as _time
+
+        from .. import faultinject, telemetry
+        from ..report import ReportData
+        from ..telemetry import WAVE_LOG_LANES as WL
+        from ..tier import ColdStore
+
+        tracer = self._tracer
+        S = getattr(self, "n_shards", 1)
+        F = self.frontier_capacity
+        C = self.capacity
+        props = list(self.model.properties())
+        n_props = len(props)
+        trace_log = self._wave_log_enabled()
+        ceiling = self._tier_ceiling()
+        self._tier_hot_ceiling = ceiling
+        limit = min(ceiling if ceiling else C, max(C - F, 1))
+
+        staged = self._tier_resume_state
+        self._tier_resume_state = None
+        if staged is not None:
+            cold = staged["cold"]
+            cold.max_runs = self.tier_max_runs
+            h_np = np.asarray(staged["hot"], np.int64).reshape(-1)
+        else:
+            cold = ColdStore(n_shards=S, max_runs=self.tier_max_runs)
+            h_np = self._tier_resident_counts(carry)
+        self._tier_state = cold
+
+        tier_fn = self._lookup_tier_programs(n0)
+        carry = self._tier_extend_carry(carry, h_np)
+        if self.track_paths:
+            carry, rows0 = self._tier_plog_reset(carry)
+            # a resumed run's host-drained rows (snapshot tier_plog)
+            # lead; then whatever the restored device log carried
+            pre = (staged or {}).get("plog_rows") or []
+            self._tier_plog_rows = list(pre) + rows0
+        pl_cursor = np.zeros(S, np.int64)
+
+        # first spill: activation means the ceiling is crossed (or
+        # resumed cold runs exist with hot above it)
+        if int(h_np.max()) > limit:
+            carry = self._tier_spill(carry, cold, h_np)
+            h_np = np.zeros(S, np.int64)
+
+        verdicts_seen: set = set()
+        d0 = np.asarray(carry["disc_found"])
+        for i, prop in enumerate(props):
+            if i < d0.size and d0[i]:
+                verdicts_seen.add(prop.name)
+
+        lat = self._lat
+        mem_peak = None
+        mem_src = None
+        mem_polls = 0
+        prev_waves = int(np.asarray(carry["waves"]))
+        chunk_idx = lat["chunks"]
+        mask_np = np.zeros((S, F), bool)
+        pending_confs = np.zeros(S, np.int64)
+        s = None
+        while True:
+            if (self.cancel_event is not None
+                    and self.cancel_event.is_set()):
+                self.cancelled = True
+                return carry, s
+            t0 = _time.monotonic()
+            keep_dev = self._tier_mask_dev(mask_np)
+            out = tier_fn(carry, keep_dev)
+            carry, stats = out[0], out[1]
+            shard_log = out[2] if len(out) > 2 else None
+            faultinject.fire("mid_chunk", chunk_no)
+            t_disp = _time.monotonic()
+            s = np.asarray(stats)
+            t1 = _time.monotonic()
+            lat["chunks"] += 1
+            lat["dispatch_sec"] += t_disp - t0
+            fetch = t1 - t_disp
+            lat["fetch_sec"] += fetch
+            if lat["fetch_min"] is None or fetch < lat["fetch_min"]:
+                lat["fetch_min"] = fetch
+            if lat["t_first_sync"] is None:
+                lat["t_first_sync"] = t1
+
+            if tracer is not None:
+                from ..memplan import device_bytes_in_use
+
+                mem_now, src = device_bytes_in_use()
+                if mem_now is not None:
+                    mem_src = src
+                    mem_polls += 1
+                    mem_peak = (mem_now if mem_peak is None
+                                else max(mem_peak, mem_now))
+                waves_now = int(s[4])
+                n_waves = waves_now - prev_waves
+                rows = None
+                if trace_log:
+                    off = 11 + 3 * n_props + 3
+                    rows = np.asarray(
+                        s[off:off + WL]
+                    ).reshape(1, WL)
+                srows = self._tier_shard_rows(shard_log)
+                tracer.record_chunk(
+                    chunk=chunk_idx,
+                    wave0=prev_waves,
+                    t0=t0,
+                    t1=t1,
+                    dispatch_sec=t_disp - t0,
+                    device_sec=None,
+                    fetch_sec=fetch,
+                    n_waves=n_waves,
+                    wave_rows=(None if rows is None
+                               else rows[:n_waves]),
+                    pairs_valid=self._wave_log_pairs_valid(),
+                    shard_rows=(None if srows is None
+                                else srows[:, :n_waves]),
+                    mem_bytes=mem_now,
+                )
+                prev_waves = waves_now
+                chunk_idx += 1
+                if n_props:
+                    disc = s[11:11 + n_props]
+                    for i, prop in enumerate(props):
+                        if disc[i] and prop.name not in verdicts_seen:
+                            verdicts_seen.add(prop.name)
+                            tracer.event(
+                                "verdict",
+                                property=prop.name,
+                                expectation=(
+                                    prop.expectation.name.lower()
+                                ),
+                                kind="discovery",
+                                wave=int(s[4]),
+                                depth=int(s[3]),
+                                chunk=chunk_idx - 1,
+                            )
+
+            done = bool(s[0])
+            self._total_states = int(s[6]) | (int(s[7]) << 32)
+            self._unique_states = int(s[8])
+            self._max_depth = max(self._max_depth, int(s[3]))
+            self.metrics = dict(
+                frontier_size=int(s[5]),
+                occupancy=(
+                    self._unique_states / self.total_capacity
+                ),
+                dedup_ratio=(
+                    1.0 - self._unique_states / self._total_states
+                    if self._total_states else 0.0
+                ),
+                waves=int(s[4]),
+            )
+            if mem_peak is not None:
+                self.metrics["device_peak_bytes"] = mem_peak
+
+            h_np = h_np + pending_confs
+            if self.track_paths:
+                carry = self._tier_plog_drain(
+                    carry, pl_cursor, pending_confs
+                )
+
+            overflow_msg = self._overflow_message(s)
+            if overflow_msg is not None:
+                if bool(s[2]):
+                    overflow_msg += (
+                        "  (tiered mode: the bound applies to the "
+                        "wave's PROVISIONAL winners — hot-tier-new "
+                        "rows before the cold membership pass — so a "
+                        "frontier that fits the all-resident run may "
+                        "need headroom once the hot tier spills)"
+                    )
+                cold.sync()
+                self._consume_extra_stats(s[11 + 3 * n_props:])
+                self._record_discoveries(s, props)
+                if self._discovered_fps:
+                    overflow_msg += (
+                        "  Discoveries recorded before truncation "
+                        f"(valid counterexamples): "
+                        f"{sorted(self._discovered_fps)} — read them "
+                        "via discovered_property_names() / "
+                        "discovery_fingerprints() after catching "
+                        "this error."
+                    )
+                self._tier_mem = (mem_peak, mem_src, mem_polls)
+                if tracer is not None:
+                    self._emit_memory_watermark(tracer, None, None, 0)
+                raise RuntimeError(overflow_msg)
+
+            if done:
+                break
+
+            pn, p_lo, p_hi = self._tier_pend_read(carry)
+            cold.sync()
+            mask_np = np.zeros((S, F), bool)
+            for s_i in range(S):
+                n_p = int(pn[s_i]) if s_i < pn.size else 0
+                if n_p == 0:
+                    continue
+                member = cold.member(
+                    s_i, p_lo[s_i, :n_p], p_hi[s_i, :n_p]
+                )
+                mask_np[s_i, :n_p] = ~member
+            pending_confs = mask_np.sum(axis=1).astype(np.int64)
+
+            if int(h_np.max()) > limit:
+                carry = self._tier_spill(carry, cold, h_np)
+                h_np = np.zeros(S, np.int64)
+
+            if (self.checkpoint_every
+                    and (chunk_no + 1) % self._ckpt_cadence() == 0):
+                from .. import checkpoint as _ckpt
+
+                t_ck = _time.monotonic()
+                _ckpt.write_snapshot(
+                    self, carry, self.checkpoint_path,
+                    chunk=chunk_no, wave=int(s[4]),
+                    depth=int(s[3]), unique=int(s[8]),
+                    tier=cold, tier_plog=self._tier_plog_rows,
+                )
+                self._note_snapshot_wall(
+                    _time.monotonic() - t_ck, t1 - t0
+                )
+            faultinject.fire("chunk_boundary", chunk_no)
+            chunk_no += 1
+            if reporter is not None:
+                reporter.report_checking(
+                    ReportData(
+                        total_states=self._total_states,
+                        unique_states=self._unique_states,
+                        max_depth=self._max_depth,
+                        duration_sec=self.duration_sec(),
+                        done=False,
+                    )
+                )
+
+        cold.sync()
+        self._tier_mem = (mem_peak, mem_src, mem_polls)
+        self.metrics.update(
+            tier_spills=int(cold.spills),
+            cold_rows=cold.rows(),
+            cold_bytes=cold.bytes(),
+            hot_rows=int(h_np.sum()),
+        )
+        return carry, s
 
     def _use_sparse(self) -> bool:
         if self.sparse is not None:
@@ -858,6 +1440,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             flat_budget_bytes=self.flat_budget_bytes,
             mask_budget_cells=self.mask_budget_cells,
             merge_impl=self.merge_impl,
+            tier_hot_rows=self.tier_hot_rows,
         )
         return lane
 
@@ -945,11 +1528,22 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
 
     # -- device programs ---------------------------------------------------
 
-    def _build_programs(self, n0: int):
+    def _build_programs(self, n0: int, tiered: bool = False):
+        """``tiered=False`` (the default) builds the untiered
+        seed/chunk pair — byte-identical to every round since 10.
+        ``tiered=True`` builds the TIERED chunk program
+        (stateright_tpu/tier.py): one wave per dispatch, whose carry
+        additionally stages the wave's provisional winners
+        (``pend_keys``/``pend_par``/``pend_n``) and whose entry phase
+        COMMITS the previous wave's survivors under the host's
+        cold-membership ``keep`` mask — count, frontier, parent log,
+        and the hot-tier merge see exactly the truly-new rows, in the
+        same key-sorted order the untiered engine commits."""
         import jax
         import jax.numpy as jnp
         from jax import lax
 
+        tier_mode = bool(tiered)
         enc = self.encoded
         props = list(self.model.properties())
         n_props = len(props)
@@ -1193,9 +1787,16 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 is_new, s_pos, s_lo, s_hi, NF, impl=self.merge_impl
             )
 
-            overflow = c["overflow"] | (
-                c["new"] + new_count.astype(jnp.uint32) > jnp.uint32(C)
-            )
+            if tier_mode:
+                # the commit phase (next dispatch) owns the visited-
+                # capacity check against the HOT count; the cumulative
+                # unique count may legitimately exceed device capacity
+                overflow = c["overflow"]
+            else:
+                overflow = c["overflow"] | (
+                    c["new"] + new_count.astype(jnp.uint32)
+                    > jnp.uint32(C)
+                )
             f_overflow = c["f_overflow"] | (new_count > F)
 
             # Fetch width: the payload gather is the merge's costliest
@@ -1234,7 +1835,22 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     # order to read; the log carries the child keys
                     # again (lanes 2-3), in the same key-sorted
                     # fetch order as the parents (_build_generated).
-                    if track_paths:
+                    if not track_paths:
+                        plog2 = c["plog"]
+                    elif tier_mode:
+                        # stage the parent limbs beside the staged
+                        # states — the commit appends the SURVIVORS
+                        # to the parent log, so no false-new row ever
+                        # reaches the drain
+                        plog2 = lax.dynamic_update_slice(
+                            c["pend_par"],
+                            jnp.stack([
+                                jnp.where(valid, par_lo, 0),
+                                jnp.where(valid, par_hi, 0),
+                            ]),
+                            (z, z),
+                        )
+                    else:
                         plog2 = lax.dynamic_update_slice(
                             c["plog"],
                             jnp.stack([
@@ -1245,8 +1861,6 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                             ]),
                             (z, c["pl_n"]),
                         )
-                    else:
-                        plog2 = c["plog"]
                     return frontier2, ebits2, plog2
 
                 return br
@@ -1279,14 +1893,17 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
 
                 return br
 
-            vkeys_new = lax.switch(
-                v_class,
-                [append_core(vc) for vc in range(len(v_ladder))],
-                0,
-            )
+            if tier_mode:
+                vkeys_new = c["vkeys"]  # the commit phase merges
+            else:
+                vkeys_new = lax.switch(
+                    v_class,
+                    [append_core(vc) for vc in range(len(v_ladder))],
+                    0,
+                )
 
             nf_valid_f = jnp.arange(F) < new_count
-            if track_paths:
+            if track_paths and not tier_mode:
                 # Clamp to the NF rows the largest block write can
                 # hold: on an f_overflow wave new_count can exceed
                 # it, and _run raises before reconstruction — but
@@ -1301,6 +1918,65 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 U64(c["gen_lo"], c["gen_hi"]),
                 U64(n_cand.astype(jnp.uint32), jnp.uint32(0)),
             )
+            if tier_mode:
+                # DEFERRED COMMIT (stateright_tpu/tier.py): stage the
+                # provisional winners — sorted keys here, states/ebits
+                # already written into the frontier staging by the
+                # fetch switch, parent limbs in pend_par — and leave
+                # vkeys, the parent log, and every committed counter
+                # untouched. compact_winners sentinel-pads past
+                # new_count, so the staged key block is (hi, lo)-
+                # sorted with a sentinel tail, exactly what the
+                # commit's merge consumes.
+                nc32 = new_count.astype(jnp.uint32)
+                pk_lo = lax.dynamic_update_slice(
+                    jnp.full(F, _SENT, jnp.uint32), w_lo[:NF], (0,)
+                )
+                pk_hi = lax.dynamic_update_slice(
+                    jnp.full(F, _SENT, jnp.uint32), w_hi[:NF], (0,)
+                )
+                trace_extra = {}
+                if trace_log:
+                    trace_extra = dict(
+                        wlog=c["wlog"],
+                        pstash=c["pstash"],
+                        wv_pairs=(n_cand if wv_pairs is None
+                                  else wv_pairs).astype(jnp.uint32),
+                    )
+                return dict(
+                    **trace_extra,
+                    **(dict(pend_par=plog_new) if track_paths
+                       else {}),
+                    vkeys=c["vkeys"],
+                    plog=c["plog"],
+                    pl_n=c["pl_n"],
+                    frontier=next_frontier,
+                    fval=nf_valid_f,
+                    ebits=next_ebits,
+                    n_frontier=nc32,
+                    n_hot=c["n_hot"],
+                    pend_keys=jnp.stack([pk_lo, pk_hi]),
+                    pend_n=nc32,
+                    pend_valid=jnp.bool_(True),
+                    depth=c["depth"],
+                    wchunk=c["wchunk"] + 1,
+                    waves=c["waves"],
+                    gen_lo=g.lo,
+                    gen_hi=g.hi,
+                    new=c["new"],
+                    disc_found=disc_found,
+                    disc_lo=disc_lo,
+                    disc_hi=disc_hi,
+                    overflow=overflow,
+                    f_overflow=f_overflow,
+                    c_overflow=c_overflow,
+                    e_overflow=e_overflow,
+                    max_cand=jnp.maximum(c["max_cand"], n_cand),
+                    max_tile_cand=max_tile_cand,
+                    max_rowen=(c["max_rowen"] if max_rowen is None
+                               else max_rowen),
+                    done=c["done"],
+                )
             new = c["new"] + new_count.astype(jnp.uint32)
             all_disc = (
                 jnp.all(disc_found) if n_props else jnp.bool_(False)
@@ -1955,7 +2631,11 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
 
         def body(c):
             n_f = c["n_frontier"]
-            u = c["new"]
+            # tiered runs dispatch the v-ladder on the HOT count (the
+            # rows actually resident) — the whole point of the tier:
+            # the on-device membership/merge scale with hot, not with
+            # the cumulative unique count
+            u = c["n_hot"] if tier_mode else c["new"]
             f_class = jnp.int32(0)
             for F_i in f_ladder[:-1]:
                 f_class = f_class + (n_f > jnp.uint32(F_i)).astype(jnp.int32)
@@ -1968,6 +2648,26 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 [mk(fc, v_class) for fc in range(len(f_ladder))],
                 c,
             )
+            if trace_log and tier_mode:
+                # the wave-log row can't be written yet — new/unique
+                # settle at the NEXT dispatch's commit; stash the
+                # wave-time lanes for it
+                c2 = dict(
+                    c2,
+                    pstash=jnp.stack(
+                        [
+                            n_f,
+                            c2["wv_pairs"],
+                            c2["gen_lo"] - c["gen_lo"],
+                            c["depth"].astype(jnp.uint32),
+                            f_class.astype(jnp.uint32),
+                            v_class.astype(jnp.uint32),
+                            jnp.uint32(0),
+                            jnp.uint32(0),
+                        ]
+                    ),
+                )
+                return c2
             if trace_log:
                 # One wave-log row (telemetry.WAVE_LOG_FIELDS): the
                 # pre/post carry delta gives candidates (gen counter)
@@ -1996,17 +2696,24 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 )
             return c2
 
-        def cond(c):
-            return ~c["done"] & (c["wchunk"] < waves_per_sync)
+        # Tiered dispatches run exactly ONE wave: the commit phase
+        # needs the host's membership verdict between waves.
+        wps_eff = 1 if tier_mode else waves_per_sync
 
-        # Tooling hook: the un-jitted wave body, re-traceable on a
-        # captured carry (stateright_tpu/wavewall.py times/lowers ONE
-        # wave in isolation — the chunk program hides per-wave
-        # structure inside the while_loop) or on eval_shape abstract
-        # carries (stateright_tpu/analysis/lint.py walks the traced
-        # switch branches for the no-branch-pad-concat rule and the
-        # carry-copy-bytes estimator, never allocating buffers).
-        self._wave_body = body
+        def cond(c):
+            return ~c["done"] & (c["wchunk"] < wps_eff)
+
+        if not tier_mode:
+            # Tooling hook: the un-jitted wave body, re-traceable on a
+            # captured carry (stateright_tpu/wavewall.py times/lowers
+            # ONE wave in isolation — the chunk program hides per-wave
+            # structure inside the while_loop) or on eval_shape
+            # abstract carries (stateright_tpu/analysis/lint.py walks
+            # the traced switch branches for the no-branch-pad-concat
+            # rule and the carry-copy-bytes estimator, never
+            # allocating buffers). The tiered build must not clobber
+            # the untiered hook the lint/profiler fixtures read.
+            self._wave_body = body
 
         # Memory ledger (stateright_tpu/memplan.py): per-ladder-class
         # staging rows, recorded AT BUILD so the memory_plan event is
@@ -2086,15 +2793,14 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         from ..memplan import v_class_entries
 
         _NFmax = min(F, max(c["buffer_rows"] for c in _classes))
-        self._build_info = dict(
-            classes=_classes,
-            v_classes=v_class_entries(v_ladder, _NFmax),
-            engine_modes=_modes,
-        )
+        if not tier_mode:
+            self._build_info = dict(
+                classes=_classes,
+                v_classes=v_class_entries(v_ladder, _NFmax),
+                engine_modes=_modes,
+            )
 
-        def chunk(carry):
-            c = dict(carry, wchunk=jnp.int32(0))
-            c = lax.while_loop(cond, body, c)
+        def pack_stats(c):
             scalars = jnp.stack(
                 [
                     c["done"].astype(jnp.uint32),
@@ -2122,10 +2828,149 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 # The wave log rides the SAME packed readback — no
                 # extra sync (waves_per_sync × WL uint32 ≈ 2 KB).
                 parts.append(c["wlog"].reshape(-1))
-            stats = jnp.concatenate(parts)
-            return c, stats
+            return jnp.concatenate(parts)
 
-        return jax.jit(seed), jax.jit(chunk, donate_argnums=0)
+        def chunk(carry):
+            c = dict(carry, wchunk=jnp.int32(0))
+            c = lax.while_loop(cond, body, c)
+            return c, pack_stats(c)
+
+        if not tier_mode:
+            return jax.jit(seed), jax.jit(chunk, donate_argnums=0)
+
+        # -- the tiered chunk program (stateright_tpu/tier.py) -----------
+
+        def tier_commit(c, keep):
+            """Commit the PREVIOUS wave's survivors under the host's
+            cold-membership ``keep`` mask: order-preserving compaction
+            of the staged rows (one F-scale stable sort — kept rows
+            stay in key order, the order every consumer shares), the
+            hot-tier merge under the v-ladder switch sized by the HOT
+            count, the parent-log append, and the counter/termination
+            updates the untiered merge_stage would have made. A carry
+            with ``pend_valid=False`` (the handoff dispatch) passes
+            through untouched."""
+            pv = c["pend_valid"]
+            rowsF = jnp.arange(F, dtype=jnp.uint32)
+            keepm = keep & (rowsF < c["pend_n"])
+            conf = jnp.sum(keepm).astype(jnp.uint32)
+            drop = jnp.where(keepm, jnp.uint32(0), jnp.uint32(1))
+            _, perm = lax.sort((drop, rowsF), num_keys=1)
+            confv = rowsF < conf
+            front_c = jnp.where(
+                confv[None, :], c["frontier"][:, perm], jnp.uint32(0)
+            )
+            eb_c = jnp.where(confv, c["ebits"][perm], jnp.uint32(0))
+            k_lo = jnp.where(
+                confv, c["pend_keys"][0][perm], jnp.uint32(_SENT)
+            )
+            k_hi = jnp.where(
+                confv, c["pend_keys"][1][perm], jnp.uint32(_SENT)
+            )
+
+            v_class = jnp.int32(0)
+            for V_i in v_ladder[:-1]:
+                v_class = v_class + (
+                    c["n_hot"] > jnp.uint32(V_i)
+                ).astype(jnp.int32)
+
+            def app(vc):
+                V_v = v_ladder[vc]
+
+                def br(_):
+                    m_lo, m_hi = merge_sorted(
+                        c["vkeys"][0, :V_v], c["vkeys"][1, :V_v],
+                        k_lo, k_hi, impl=self.merge_impl,
+                    )
+                    return lax.dynamic_update_slice(
+                        c["vkeys"],
+                        jnp.stack([m_lo, m_hi]),
+                        (jnp.uint32(0), jnp.uint32(0)),
+                    )
+
+                return br
+
+            vkeys_m = lax.switch(
+                v_class, [app(vc) for vc in range(len(v_ladder))], 0
+            )
+
+            def sel(a, b):
+                return jnp.where(pv, a, b)
+
+            confp = jnp.where(pv, conf, jnp.uint32(0))
+            new2 = c["new"] + confp
+            n_hot2 = c["n_hot"] + confp
+            all_disc = (
+                jnp.all(c["disc_found"]) if n_props
+                else jnp.bool_(False)
+            )
+            if target_states is None:
+                target_hit = jnp.bool_(False)
+            else:
+                target_hit = new2 >= jnp.uint32(target_states)
+            overflow = c["overflow"] | (
+                pv & (n_hot2 > jnp.uint32(C))
+            )
+            cont = (
+                pv & (conf > 0) & ~all_disc & ~target_hit
+                & ~overflow & ~c["f_overflow"] & ~c["c_overflow"]
+                & ~c["e_overflow"]
+            )
+            out = dict(
+                c,
+                vkeys=sel(vkeys_m, c["vkeys"]),
+                frontier=sel(front_c, c["frontier"]),
+                ebits=sel(eb_c, c["ebits"]),
+                fval=sel(confv & cont, c["fval"]),
+                n_frontier=sel(conf, c["n_frontier"]),
+                n_hot=n_hot2,
+                new=new2,
+                depth=jnp.where(cont, c["depth"] + 1, c["depth"]),
+                waves=c["waves"] + jnp.where(
+                    pv, jnp.uint32(1), jnp.uint32(0)
+                ),
+                overflow=overflow,
+                done=sel(~cont, c["done"]),
+                pend_valid=jnp.bool_(False),
+                pend_n=jnp.uint32(0),
+            )
+            if track_paths:
+                p_lo = jnp.where(
+                    confv, c["pend_par"][0][perm], jnp.uint32(0)
+                )
+                p_hi = jnp.where(
+                    confv, c["pend_par"][1][perm], jnp.uint32(0)
+                )
+                rows4 = jnp.stack([
+                    p_lo,
+                    p_hi,
+                    jnp.where(confv, k_lo, jnp.uint32(0)),
+                    jnp.where(confv, k_hi, jnp.uint32(0)),
+                ])
+                plog2 = lax.dynamic_update_slice(
+                    c["plog"], rows4, (jnp.uint32(0), c["pl_n"])
+                )
+                out["plog"] = sel(plog2, c["plog"])
+                out["pl_n"] = c["pl_n"] + confp
+            if trace_log:
+                st = c["pstash"]
+                row = jnp.stack([
+                    st[0], st[1], st[2], conf, new2,
+                    st[3], st[4], st[5],
+                ])
+                out["wlog"] = lax.dynamic_update_slice(
+                    c["wlog"], row[None, :],
+                    (jnp.int32(0), jnp.int32(0)),
+                )
+            return out
+
+        def tier_chunk(carry, keep):
+            c = dict(carry, wchunk=jnp.int32(0))
+            c = tier_commit(c, keep)
+            c = lax.while_loop(cond, body, c)
+            return c, pack_stats(c)
+
+        return jax.jit(tier_chunk, donate_argnums=0)
 
     def _vec_fp(self, row) -> int:
         """Host fingerprints use the same all-ones clamp as the device
@@ -2169,6 +3014,13 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         re-orders its rows every wave, so the log is the insertion-
         order record again."""
         if self.generated is None:
+            tier = self._tier_generated_map()
+            if tier is not None:
+                # tiered runs drain the log host-side per dispatch
+                # (stateright_tpu/tier.py): the accumulation IS the
+                # insertion-order record
+                self.generated = tier
+                return self.generated
             _vkeys, plog, pl_n, _new = (
                 np.asarray(a) for a in self._final_tables
             )

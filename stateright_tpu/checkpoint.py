@@ -82,7 +82,41 @@ _SENT = 0xFFFFFFFF
 #: snapshot and the resuming run disagree on tracer state or
 #: waves_per_sync — their content is telemetry, rewritten inside the
 #: chunk before any row is read.
-_SYNTH_LEAVES = frozenset({"wlog", "slog", "swave", "wv_pairs"})
+_SYNTH_LEAVES = frozenset({"wlog", "slog", "swave", "wv_pairs",
+                           "pstash"})
+
+#: tiered-mode carry leaves a snapshot may carry on top of the
+#: untiered spec (the deferred-commit staging of stateright_tpu/
+#: tier.py). Resume FOLDS them host-side — the pending wave is
+#: committed through the same cold-membership pass the device commit
+#: would have run — so the restored carry is always the untiered
+#: shape and re-shard sees only confirmed state.
+_TIER_LEAVES = ("pend_keys", "pend_par", "pend_n", "pend_valid",
+                "n_hot", "h_loc", "pstash")
+
+
+def auto_cadence(snapshot_sec: float, chunk_sec: float,
+                 target: float = 0.05, lo: int = 1,
+                 hi: int = 256) -> int:
+    """``--checkpoint-every=auto``: the cadence (chunks per snapshot)
+    that keeps checkpoint overhead under ``target`` of run wall,
+    from the two walls the run itself measures — the snapshot write
+    wall and the per-chunk wall. Every N chunks, one snapshot costs
+    ``snapshot_sec / (N * chunk_sec)`` relative overhead, so the
+    smallest N meeting the target is
+    ``ceil(snapshot_sec / (target * chunk_sec))``, clamped to
+    ``[lo, hi]`` (hi bounds the progress lost to a crash; lo is
+    every-chunk). Degenerate inputs answer conservatively: an
+    unmeasured snapshot wall checkpoints every chunk, an unmeasured
+    chunk wall checkpoints at the cap."""
+    import math
+
+    if not snapshot_sec or snapshot_sec <= 0:
+        return lo
+    if not chunk_sec or chunk_sec <= 0:
+        return hi
+    n = math.ceil(snapshot_sec / (target * chunk_sec))
+    return max(lo, min(hi, int(n)))
 
 
 class SnapshotError(RuntimeError):
@@ -261,16 +295,55 @@ def load_snapshot(path: str) -> tuple[dict, dict]:
 
 
 def write_snapshot(checker, carry, path: str, *, chunk: int,
-                   wave: int, depth: int, unique: int) -> dict:
+                   wave: int, depth: int, unique: int,
+                   tier=None, tier_plog=None) -> dict:
     """Serialize one chunk carry to an atomic on-disk snapshot. Called
     at the existing per-chunk sync (checkers/tpu.py) — the stats
     readback already blocked, so the carry download adds transfer, not
     a sync point. Returns the manifest; emits a ``checkpoint``
-    telemetry event."""
+    telemetry event.
+
+    ``tier`` (tiered-visited-set runs, stateright_tpu/tier.py) is the
+    engine's :class:`~stateright_tpu.tier.ColdStore`: its sorted
+    immutable runs ride the same npz as ``tier_run{shard}_{i}_lo/hi``
+    buffers and the manifest gains a ``tier`` block (hot ceiling,
+    spill count, per-run row counts) — a snapshot of a tiered run is
+    the whole visited set, both tiers."""
     from . import telemetry
 
     t0 = time.monotonic()
     buffers = {k: np.asarray(v) for k, v in carry.items()}
+    tier_block = None
+    if tier is not None:
+        runs = tier.snapshot_runs()
+        run_rows = []
+        for s, shard in enumerate(runs):
+            rows_s = []
+            for i, (lo, hi) in enumerate(shard):
+                buffers[f"tier_run{s}_{i}_lo"] = lo
+                buffers[f"tier_run{s}_{i}_hi"] = hi
+                rows_s.append(int(lo.size))
+            run_rows.append(rows_s)
+        plog_host = 0
+        if tier_plog:
+            # the host-drained parent-log accumulation (tiered runs
+            # rewind the device log's cursor — these rows exist only
+            # host-side and must survive the process)
+            blk = np.concatenate(
+                [np.asarray(b, np.uint32) for b in tier_plog], axis=1
+            )
+            buffers["tier_plog"] = blk
+            plog_host = int(blk.shape[1])
+        tier_block = dict(
+            hot_rows=int(getattr(checker, "_tier_hot_ceiling", 0)
+                         or 0) or None,
+            max_runs=int(tier.max_runs),
+            spills=int(tier.spills),
+            run_rows=run_rows,
+            plog_host_rows=plog_host,
+            cold_rows_total=int(tier.rows()),
+            cold_bytes_total=int(tier.bytes()),
+        )
     total = int(sum(b.nbytes for b in buffers.values()))
     manifest = dict(
         version=SNAPSHOT_VERSION,
@@ -296,6 +369,7 @@ def write_snapshot(checker, carry, path: str, *, chunk: int,
             auto_budget=bool(getattr(checker, "auto_budget", False)),
         ),
         merge_impl=getattr(checker, "merge_impl", None),
+        tier=tier_block,
         snapshot_bytes=total,
         buffers={
             k: dict(shape=list(b.shape), dtype=str(b.dtype),
@@ -368,6 +442,20 @@ def resume_from(checker, path: str, *,
         == int(checker.frontier_capacity)
         and manifest.get("kind") == _engine_kind(checker)
     )
+    # Tiered snapshots (stateright_tpu/tier.py): fold the deferred-
+    # commit staging host-side — the pending wave commits through the
+    # SAME cold-membership verdict the device commit would have run —
+    # so everything downstream (direct upload, the (owner, fp)
+    # re-shard) sees only confirmed, untiered-shaped state; the cold
+    # runs then re-route by the same owner seam.
+    tier_m = manifest.get("tier")
+    checker._tier_resume_state = None
+    hot_src = None
+    cold_src = None
+    if tier_m:
+        buffers, cold_src, hot_src, plog_host = _fold_tier_snapshot(
+            checker, manifest, buffers, tier_m
+        )
     if not same_layout:
         if family != "sortmerge":
             raise SnapshotIncompatibleError(
@@ -380,7 +468,14 @@ def resume_from(checker, path: str, *,
                 "table re-shards by re-insertion, which this engine "
                 "does not implement; resume on the original layout"
             )
-        buffers = reshard_sortmerge(manifest, buffers, checker)
+        buffers = reshard_sortmerge(
+            manifest, buffers, checker, visited_counts=hot_src
+        )
+    if tier_m:
+        buffers = _route_tier_target(
+            checker, path, manifest, buffers, cold_src, hot_src,
+            same_layout, plog_host,
+        )
 
     checker._resume = (manifest, buffers)
     checker._resume_path = path
@@ -390,8 +485,264 @@ def resume_from(checker, path: str, *,
     return manifest
 
 
+def _fold_tier_snapshot(checker, manifest: dict, buffers: dict,
+                        tier_m: dict):
+    """Restore a tiered snapshot's host state and COMMIT its pending
+    wave host-side: rebuild the :class:`~stateright_tpu.tier.ColdStore`
+    from the serialized runs, run the batched sort-merge membership
+    over the staged provisional winners (exactly the verdict the next
+    device dispatch would have received as its keep mask), and fold
+    the survivors into the carry — hot-prefix merge, frontier
+    compaction, parent-log append, counters — so the buffers leave
+    here as a valid UNTIERED carry at the source layout whose visited
+    prefix holds only the hot tier. Returns ``(buffers, cold_store,
+    hot_counts_per_source_shard, host_plog_block_or_None)``."""
+    from .tier import ColdStore
+
+    W = int(manifest["width"])
+    track_paths = bool(manifest["track_paths"])
+    S_a = int(manifest.get("n_shards", 1))
+    C_a = int(manifest["capacity"])
+    F_a = int(manifest["frontier_capacity"])
+    kind_a = manifest.get("kind", "single")
+    C_pad_a = C_a + F_a
+    L_a = C_a + F_a if track_paths else 0
+
+    run_rows = tier_m.get("run_rows") or []
+    per_shard_runs = []
+    for s in range(S_a):
+        shard = []
+        rows_s = run_rows[s] if s < len(run_rows) else []
+        for i, n in enumerate(rows_s):
+            lo = buffers.pop(f"tier_run{s}_{i}_lo")
+            hi = buffers.pop(f"tier_run{s}_{i}_hi")
+            if int(n) != int(lo.size):
+                raise SnapshotCorruptError(
+                    f"tier run {s}/{i}: manifest declares {n} rows, "
+                    f"buffer has {lo.size}"
+                )
+            shard.append((lo, hi))
+        per_shard_runs.append(shard)
+    cold = ColdStore.from_runs(
+        per_shard_runs,
+        max_runs=int(tier_m.get("max_runs") or 8),
+        spills=int(tier_m.get("spills") or 0),
+    )
+
+    plog_host = buffers.pop("tier_plog", None)
+    # pop the tiered-mode staging leaves (absent only if the snapshot
+    # landed before the first tiered dispatch)
+    staged = {k: buffers.pop(k) for k in _TIER_LEAVES
+              if k in buffers}
+    if kind_a == "sharded":
+        hot = np.atleast_1d(
+            staged.get("h_loc", buffers["u_loc"])
+        ).astype(np.int64).reshape(-1).copy()
+    else:
+        h = staged.get("n_hot", buffers["new"])
+        hot = np.array([int(h)], np.int64)
+
+    pend_valid = bool(staged.get("pend_valid", False))
+    if pend_valid:
+        pend_n = np.atleast_1d(
+            staged["pend_n"]
+        ).astype(np.int64).reshape(-1)
+        pend_keys = staged["pend_keys"]
+        pend_par = staged.get("pend_par")
+        vkeys = buffers["vkeys"]
+        frontier = buffers["frontier"]
+        ebits = buffers["ebits"]
+        fval = buffers["fval"]
+        plog = buffers.get("plog")
+        pl_n = (np.atleast_1d(buffers["pl_n"]).astype(np.int64)
+                .reshape(-1).copy() if track_paths else None)
+        n_loc = np.zeros(S_a, np.int64)
+        confs = np.zeros(S_a, np.int64)
+        for s in range(S_a):
+            n_p = int(pend_n[s]) if s < pend_n.size else 0
+            fb = s * F_a
+            frontier_blk = frontier[:, fb:fb + F_a].copy()
+            eb_blk = ebits[fb:fb + F_a].copy()
+            frontier[:, fb:fb + F_a] = 0
+            ebits[fb:fb + F_a] = 0
+            fval[fb:fb + F_a] = False
+            if n_p == 0:
+                continue
+            sl = slice(fb, fb + n_p)
+            klo = np.asarray(pend_keys[0, sl])
+            khi = np.asarray(pend_keys[1, sl])
+            keep = ~cold.member(s, klo, khi)
+            conf = int(keep.sum())
+            confs[s] = conf
+            if conf == 0:
+                continue
+            h = int(hot[s])
+            base = s * C_pad_a
+            mlo = np.concatenate([vkeys[0, base:base + h], klo[keep]])
+            mhi = np.concatenate([vkeys[1, base:base + h], khi[keep]])
+            order = np.lexsort((mlo, mhi))
+            vkeys[0, base:base + h + conf] = mlo[order]
+            vkeys[1, base:base + h + conf] = mhi[order]
+            hot[s] = h + conf
+            frontier[:, fb:fb + conf] = frontier_blk[:, :n_p][:, keep]
+            ebits[fb:fb + conf] = eb_blk[:n_p][keep]
+            n_loc[s] = conf
+            if track_paths and pend_par is not None:
+                pl = int(pl_n[s])
+                lb = s * L_a
+                plog[0, lb + pl:lb + pl + conf] = \
+                    np.asarray(pend_par[0, sl])[keep]
+                plog[1, lb + pl:lb + pl + conf] = \
+                    np.asarray(pend_par[1, sl])[keep]
+                plog[2, lb + pl:lb + pl + conf] = klo[keep]
+                plog[3, lb + pl:lb + pl + conf] = khi[keep]
+                pl_n[s] = pl + conf
+        conf_total = int(confs.sum())
+        new_after = int(buffers["new"]) + conf_total
+        n_props = int(np.asarray(buffers["disc_found"]).size)
+        all_disc = (bool(np.asarray(buffers["disc_found"]).all())
+                    if n_props else False)
+        target = checker.builder._target_state_count
+        target_hit = target is not None and new_after >= int(target)
+        cont = conf_total > 0 and not all_disc and not target_hit
+        for s in range(S_a):
+            if cont and confs[s]:
+                fval[s * F_a:s * F_a + int(confs[s])] = True
+        buffers["new"] = np.uint32(new_after)
+        buffers["waves"] = np.uint32(int(buffers["waves"]) + 1)
+        if cont:
+            buffers["depth"] = np.int32(int(buffers["depth"]) + 1)
+        buffers["done"] = np.bool_(not cont)
+        if track_paths:
+            buffers["pl_n"] = (
+                pl_n.astype(np.uint32) if kind_a == "sharded"
+                else np.uint32(pl_n[0])
+            )
+        if kind_a == "sharded":
+            buffers["n_loc"] = n_loc.astype(np.uint32)
+        else:
+            buffers["n_frontier"] = np.uint32(n_loc[0])
+        # the manifest's capture point moves past the folded commit
+        manifest["wave"] = int(buffers["waves"])
+        manifest["depth"] = int(buffers["depth"])
+        manifest["unique"] = new_after
+
+    # the source-layout visited prefixes now hold HOT rows only; the
+    # re-shard (if any) must slice by these, not the cumulative count
+    if kind_a == "sharded":
+        buffers["u_loc"] = hot.astype(np.uint32)
+    return buffers, cold, hot, plog_host
+
+
+def _route_tier_target(checker, path: str, manifest: dict,
+                       buffers: dict, cold, hot_src, same_layout,
+                       plog_host=None):
+    """Land a folded tiered snapshot on the TARGET: re-route the cold
+    runs by the new owner seam (``lo % S_new`` — filtering a sorted
+    run preserves its order, so every piece stays a sorted immutable
+    run), then either stage the tier for the resuming engine (tiering
+    configured on the target: ``checker._tier_resume_state``) or
+    UN-TIER — merge the cold rows back into the resident prefix when
+    the target capacity holds the whole set and the target didn't ask
+    for tiering. Refuses loudly when neither fits."""
+    S_b = int(getattr(checker, "n_shards", 1))
+    C_b = int(checker.capacity)
+    F_b = int(checker.frontier_capacity)
+    C_pad_b = C_b + F_b
+    kind_b = _engine_kind(checker)
+
+    cold_t = (cold if same_layout and cold.n_shards == S_b
+              else cold.repartitioned(S_b))
+    if kind_b == "sharded":
+        hot_t = np.atleast_1d(buffers["u_loc"]).astype(
+            np.int64
+        ).reshape(-1).copy()
+    else:
+        hot_t = np.array([int(hot_src.sum())], np.int64)
+    cold_rows = np.array(cold_t.shard_rows(), np.int64)
+
+    tier_on = getattr(checker, "tier_hot_rows", None) is not None
+    if not tier_on:
+        # un-tier: the whole set must fit the target residency
+        total = hot_t + cold_rows
+        if int(total.max(initial=0)) > C_b:
+            raise SnapshotIncompatibleError(
+                f"{path}: tiered snapshot holds "
+                f"{int(total.sum()):,} visited keys "
+                f"({int(cold_rows.sum()):,} cold) but the target's "
+                f"per-shard capacity is {C_b:,} and tiering is off — "
+                "raise the capacity, or resume with tier_hot_rows "
+                "set to keep the cold tier"
+            )
+        vkeys = buffers["vkeys"]
+        from .tier import pack_u64
+
+        for d in range(S_b):
+            base = d * C_pad_b
+            h = int(hot_t[d])
+            lo = vkeys[0, base:base + h]
+            hi = vkeys[1, base:base + h]
+            packed = [pack_u64(lo, hi)]
+            for run in cold_t.runs[d]:
+                packed.append(run)
+            merged = np.sort(np.concatenate(packed))
+            n = merged.size
+            vkeys[0, base:base + n] = (
+                merged & np.uint64(0xFFFFFFFF)
+            ).astype(np.uint32)
+            vkeys[1, base:base + n] = (
+                merged >> np.uint64(32)
+            ).astype(np.uint32)
+            hot_t[d] = n
+        if kind_b == "sharded":
+            buffers["u_loc"] = hot_t.astype(np.uint32)
+        if plog_host is not None and plog_host.shape[1]:
+            # re-home the host-drained parent-log rows into the
+            # device log, per owner shard (row order within a shard
+            # is irrelevant: every child appears exactly once)
+            track_paths = bool(manifest["track_paths"])
+            if track_paths:
+                L_b = C_b + F_b
+                plog = buffers["plog"]
+                pl_n = np.atleast_1d(
+                    buffers["pl_n"]
+                ).astype(np.int64).reshape(-1).copy()
+                owner = (
+                    plog_host[2] % np.uint32(max(S_b, 1))
+                ).astype(np.int64)
+                for d in range(S_b):
+                    rows_d = plog_host[:, owner == d]
+                    n_d = rows_d.shape[1]
+                    pl = int(pl_n[d] if d < pl_n.size else 0)
+                    if pl + n_d > L_b:
+                        raise SnapshotIncompatibleError(
+                            f"{path}: un-tiering needs "
+                            f"{pl + n_d:,} parent-log rows on shard "
+                            f"{d} but the per-shard log holds "
+                            f"{L_b:,} — raise the target capacity"
+                        )
+                    lb = d * L_b
+                    plog[:, lb + pl:lb + pl + n_d] = rows_d
+                    pl_n[d] = pl + n_d
+                buffers["pl_n"] = (
+                    pl_n.astype(np.uint32) if kind_b == "sharded"
+                    else np.uint32(pl_n[0])
+                )
+        return buffers
+
+    # stay tiered: per-shard cumulative counts join hot + owned cold
+    if kind_b == "sharded":
+        buffers["u_loc"] = (hot_t + cold_rows).astype(np.uint32)
+    checker._tier_resume_state = dict(
+        cold=cold_t, hot=hot_t.astype(np.int64),
+        plog_rows=([plog_host] if plog_host is not None
+                   and plog_host.shape[1] else []),
+    )
+    return buffers
+
+
 def reshard_sortmerge(manifest: dict, buffers: dict,
-                      checker) -> dict:
+                      checker, visited_counts=None) -> dict:
     """The elastic re-shard: rebuild the sort-merge carry at the
     target (shard count, per-shard capacity) layout by re-routing
     every row through the (owner, fp) seam the mesh wave's routing
@@ -436,6 +787,11 @@ def reshard_sortmerge(manifest: dict, buffers: dict,
         pl_src = np.array(
             [int(buffers["pl_n"])] if track_paths else [0], np.int64
         )
+    if visited_counts is not None:
+        # tiered snapshots (stateright_tpu/tier.py): the resident
+        # prefix holds the HOT tier only — the cumulative counters
+        # ("new") include spilled rows and must not size the slice
+        u_src = np.asarray(visited_counts, np.int64).reshape(-1)
 
     vkeys = buffers["vkeys"]
     keys_lo = np.concatenate([

@@ -56,7 +56,7 @@ def _network(args: list[str], index: int) -> Network:
 #: without a per-lane knob).
 _RUNTIME: dict = dict(
     checkpoint_every=None, checkpoint_path=None, resume=False,
-    resume_any_sha=False, waves_per_sync=None,
+    resume_any_sha=False, waves_per_sync=None, tier_hot_rows=None,
 )
 
 
@@ -66,13 +66,23 @@ def _apply_runtime(checker) -> None:
     the chunk loop, which host checkers don't have."""
     cfg = _RUNTIME
     if not (cfg["checkpoint_every"] or cfg["resume"]
-            or cfg["waves_per_sync"]):
+            or cfg["waves_per_sync"] or cfg["tier_hot_rows"]):
         return
     if not hasattr(checker, "_run_attempt"):
         raise SystemExit(
-            "--checkpoint-every/--resume/--waves-per-sync need a "
-            "device engine: use a check-tpu lane"
+            "--checkpoint-every/--resume/--waves-per-sync/"
+            "--tier-hot-rows need a device engine: use a check-tpu "
+            "lane"
         )
+    if cfg["tier_hot_rows"]:
+        if not hasattr(checker, "tier_hot_rows"):
+            raise SystemExit(
+                "--tier-hot-rows needs a sort-merge engine (the "
+                "tiered visited set lives in the sorted-prefix "
+                "family, stateright_tpu/tier.py)"
+            )
+        checker.tier_hot_rows = cfg["tier_hot_rows"]
+        checker._tier_hot_ceiling = None
     if cfg["waves_per_sync"]:
         checker.waves_per_sync = cfg["waves_per_sync"]
     path = cfg["checkpoint_path"] or "stateright_tpu.ckpt"
@@ -472,13 +482,22 @@ def _usage(model: str | None = None) -> None:
         "compares two)"
     )
     print(
-        "       --checkpoint-every=N [--checkpoint-path=P] on "
+        "       --checkpoint-every=N|auto [--checkpoint-path=P] on "
         "check-tpu lanes snapshots the chunk carry every N chunks "
-        "(atomic; supervised fault retry); --resume restores from "
-        "the snapshot — elastically, onto a different shard count "
-        "on the sort-merge engines (--resume-any-sha skips the "
-        "git-SHA staleness refusal; --waves-per-sync=N sets the "
-        "chunk cadence)"
+        "(atomic; supervised fault retry; 'auto' picks the cadence "
+        "from the measured snapshot-vs-chunk walls, <=5% overhead); "
+        "--resume restores from the snapshot — elastically, onto a "
+        "different shard count on the sort-merge engines "
+        "(--resume-any-sha skips the git-SHA staleness refusal; "
+        "--waves-per-sync=N sets the chunk cadence)"
+    )
+    print(
+        "       --tier-hot-rows=N|auto on sort-merge check-tpu "
+        "lanes caps the device-resident visited HOT tier at N rows "
+        "and spills the rest to host-DRAM cold runs "
+        "(stateright_tpu/tier.py; 'auto' = the memplan capacity "
+        "projection decides the split) — reachability bounded by "
+        "host memory, not HBM"
     )
 
 
@@ -510,7 +529,26 @@ def _pop_runtime_flags(argv: list[str]) -> list[str]:
     rest = []
     for a in argv:
         if a.startswith("--checkpoint-every="):
-            _RUNTIME["checkpoint_every"] = int(a.split("=", 1)[1])
+            val = a.split("=", 1)[1]
+            # "auto": cadence from the measured snapshot write wall
+            # vs chunk wall (checkpoint.auto_cadence, <=5% overhead)
+            _RUNTIME["checkpoint_every"] = (
+                "auto" if val == "auto" else int(val)
+            )
+        elif a.startswith("--tier-hot-rows="):
+            val = a.split("=", 1)[1]
+            # tiered visited set (stateright_tpu/tier.py): hot-tier
+            # ceiling in rows, or "auto" for the memplan-projection
+            # split. Validated HERE: a 0 would be silently dropped
+            # by the apply-time truthiness gate instead of refused.
+            if val != "auto" and int(val) < 1:
+                raise SystemExit(
+                    f"--tier-hot-rows={val}: the hot ceiling must "
+                    "be >= 1 row (or 'auto')"
+                )
+            _RUNTIME["tier_hot_rows"] = (
+                "auto" if val == "auto" else int(val)
+            )
         elif a.startswith("--checkpoint-path="):
             _RUNTIME["checkpoint_path"] = a.split("=", 1)[1]
         elif a == "--resume":
@@ -533,6 +571,7 @@ def main(argv: list[str] | None = None) -> None:
     _RUNTIME.update(
         checkpoint_every=None, checkpoint_path=None, resume=False,
         resume_any_sha=False, waves_per_sync=None,
+        tier_hot_rows=None,
     )
     trace_level, argv = _pop_trace_flag(argv)
     argv = _pop_runtime_flags(argv)

@@ -130,6 +130,34 @@ def v_class_entries(v_ladder, nf_max: int) -> list:
     ]
 
 
+def decide_hot_rows(capacity: int, v_min: int, v_ladder_step: int,
+                    frontier_capacity: int,
+                    budget_bytes: int) -> int:
+    """The hot/cold split of the tiered visited set (ROADMAP direction
+    1b, stateright_tpu/tier.py), decided by the SAME pricing the
+    capacity projection reports (``next_vkeys_bytes`` +
+    ``next_merge_scratch_bytes``, both ``(V + F) * 8``): the largest
+    visited-ladder class whose resident vkeys block PLUS merge
+    scratch fit ``budget_bytes`` becomes the hot-tier ceiling —
+    everything past it spills to host DRAM.
+
+    Returns ``capacity`` itself when the whole ladder fits (the tier
+    stays dormant: the spill watermark is never crossed), and the
+    ladder bottom ``v_min`` when even that class exceeds the budget
+    (the engine still runs; the hot tier is just minimal). This is
+    the ``tier_hot_rows="auto"`` policy — the projection is exactly
+    the signal, as the round-12 ledger promised."""
+    F = int(frontier_capacity)
+    hot = int(min(v_min, capacity))
+    v = hot
+    while v < capacity:
+        v = min(v * v_ladder_step, capacity)
+        if 2 * (v + F) * 8 > budget_bytes:
+            break
+        hot = v
+    return hot
+
+
 # -- live watermarks ------------------------------------------------------
 
 

@@ -230,9 +230,78 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
         )
         return lane
 
+    # -- tiered visited set (stateright_tpu/tier.py) -----------------------
+    #
+    # The shared takeover loop lives on the single-chip base class;
+    # these hooks adapt it to the mesh layout: per-shard hot counts
+    # (h_loc), per-shard pend lanes, NamedSharding placement for the
+    # carry surgery (spill reset, handoff lanes), and the per-shard
+    # keep-mask upload.
+
+    def _tier_resident_counts(self, carry) -> np.ndarray:
+        return np.asarray(
+            carry["u_loc"]
+        ).astype(np.int64).reshape(-1)
+
+    def _tier_hot_lane(self) -> str:
+        return "h_loc"
+
+    def _tier_zero_hot(self):
+        return np.zeros(self.n_shards, np.uint32)
+
+    def _tier_hot_value(self, h_np):
+        return np.asarray(h_np, np.uint32).reshape(-1)
+
+    def _tier_zero_pl(self):
+        return np.zeros(self.n_shards, np.uint32)
+
+    def _tier_pend_zero(self):
+        return np.zeros(self.n_shards, np.uint32)
+
+    def _tier_place(self, name, arr):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        spec = (getattr(self, "_tier_pspecs", None) or {}).get(name)
+        if spec is None:
+            spec = P()
+        return jnp.copy(jax.device_put(
+            np.asarray(arr), NamedSharding(self.mesh, spec)
+        ))
+
+    def _tier_mask_dev(self, mask_np: np.ndarray):
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        return jax.device_put(
+            np.ascontiguousarray(mask_np.reshape(-1)),
+            NamedSharding(self.mesh, P("shard")),
+        )
+
+    def _tier_shard_rows(self, shard_log):
+        if shard_log is None:
+            return None
+        from ..telemetry import SHARD_LOG_LANES as SL
+
+        return np.asarray(shard_log).reshape(self.n_shards, 1, SL)
+
+    def _tier_extend_trace(self, ext) -> None:
+        from ..telemetry import SHARD_LOG_LANES as SL
+
+        S = self.n_shards
+        ext["slog"] = self._tier_place(
+            "slog", np.zeros((S, SL), np.uint32)
+        )
+        ext["swave"] = self._tier_place(
+            "swave", np.zeros(S * SL, np.uint32)
+        )
+
     # -- device programs ---------------------------------------------------
 
-    def _build_programs(self, n0: int):
+    def _build_programs(self, n0: int, tiered: bool = False):
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -255,6 +324,7 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
         )
         from ..ops.fingerprint import fingerprint_u32v_t
 
+        tier_mode = bool(tiered)
         enc = self.encoded
         props = list(self.model.properties())
         n_props = len(props)
@@ -555,10 +625,15 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                     [nf_pos, jnp.full(F - R_c, _SENT, jnp.uint32)]
                 )
 
-            overflow = overflow0 | bool_any(
-                c["u_loc"][0] + new_count.astype(jnp.uint32)
-                > jnp.uint32(C)
-            )
+            if tier_mode:
+                # the commit phase (next dispatch) owns the per-shard
+                # capacity check against the HOT count
+                overflow = overflow0
+            else:
+                overflow = overflow0 | bool_any(
+                    c["u_loc"][0] + new_count.astype(jnp.uint32)
+                    > jnp.uint32(C)
+                )
             nf_valid = jnp.arange(F) < new_count
             f_overflow = f_overflow0 | bool_any(new_count > F)
             nf_row = jnp.where(nf_valid, nf_pos - 1, jnp.uint32(0))
@@ -591,13 +666,28 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
 
                 return br
 
-            vkeys_new = lax.switch(
-                v_class,
-                [append_core(vc) for vc in range(len(v_ladder))],
-                0,
-            )
+            if tier_mode:
+                vkeys_new = c["vkeys"]  # the commit phase merges
+            else:
+                vkeys_new = lax.switch(
+                    v_class,
+                    [append_core(vc) for vc in range(len(v_ladder))],
+                    0,
+                )
 
-            if track_paths:
+            pend_extra = {}
+            if tier_mode and track_paths:
+                # stage the parent limbs for the commit's append —
+                # no false-new row ever reaches the parent-log drain
+                plog_new = c["plog"]
+                pl_n = c["pl_n"]
+                pend_extra = dict(
+                    pend_par=jnp.stack([
+                        jnp.where(nf_valid, next_fe[:, W], 0),
+                        jnp.where(nf_valid, next_fe[:, W + 1], 0),
+                    ])
+                )
+            elif track_paths:
                 # Parent AND child limbs (round 10): the sorted merge
                 # re-orders vkeys rows every wave, so the round-9
                 # positional child derivation is gone — the log is
@@ -633,6 +723,76 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             max_cand = jnp.maximum(
                 c["max_cand"], lax.pmax(n_cand, "shard")
             )
+
+            if tier_mode:
+                # DEFERRED COMMIT (stateright_tpu/tier.py): stage the
+                # shard's provisional winners and leave vkeys, the
+                # parent log, and every committed counter untouched —
+                # the next dispatch's commit phase folds in the host's
+                # per-shard cold-membership verdict. The staged key
+                # block keeps compact_winners' (hi, lo) order with a
+                # sentinel tail, exactly what the commit merge wants.
+                nc_u32 = new_count.astype(jnp.uint32)
+                pk_lo = lax.dynamic_update_slice(
+                    jnp.full(F, _SENT, jnp.uint32), w_lo[:NFs], (0,)
+                )
+                pk_hi = lax.dynamic_update_slice(
+                    jnp.full(F, _SENT, jnp.uint32), w_hi[:NFs], (0,)
+                )
+                trace_extra = {}
+                if shard_log is not None:
+                    wv_pairs, cross_rows, fill_peak, dest_cap = \
+                        shard_log
+                    # provisional lanes 7/8 — the commit patches them
+                    # with the confirmed count before the slog write
+                    trace_extra = dict(
+                        swave=jnp.stack(
+                            [
+                                c["n_loc"][0],
+                                wv_pairs.astype(jnp.uint32),
+                                n_cand.astype(jnp.uint32),
+                                cross_rows.astype(jnp.uint32),
+                                jnp.sum(r_val, dtype=jnp.uint32),
+                                fill_peak.astype(jnp.uint32),
+                                dest_cap,
+                                nc_u32,
+                                c["u_loc"][0] + nc_u32,
+                            ]
+                        )
+                    )
+                return dict(
+                    **trace_extra,
+                    **pend_extra,
+                    vkeys=vkeys_new,
+                    plog=plog_new,
+                    pl_n=pl_n,
+                    frontier=next_frontier,
+                    fval=nf_valid,
+                    ebits=next_ebits,
+                    n_loc=nc_u32.reshape(1),
+                    u_loc=c["u_loc"],
+                    h_loc=c["h_loc"],
+                    pend_keys=jnp.stack([pk_lo, pk_hi]),
+                    pend_n=nc_u32.reshape(1),
+                    pend_valid=jnp.bool_(True),
+                    depth=c["depth"],
+                    wchunk=c["wchunk"] + 1,
+                    waves=c["waves"],
+                    gen_lo=g.lo,
+                    gen_hi=g.hi,
+                    new=c["new"],
+                    sent_lo=sent.lo,
+                    sent_hi=sent.hi,
+                    max_cand=max_cand,
+                    disc_found=disc_found,
+                    disc_lo=disc_lo,
+                    disc_hi=disc_hi,
+                    overflow=overflow,
+                    f_overflow=f_overflow,
+                    c_overflow=c_overflow,
+                    e_overflow=e_overflow,
+                    done=c["done"],
+                )
 
             all_disc = (
                 jnp.all(disc_found) if n_props else jnp.bool_(False)
@@ -1169,7 +1329,12 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
 
         def body(c):
             n_max = lax.pmax(c["n_loc"][0], "shard")
-            u_max = lax.pmax(c["u_loc"][0], "shard")
+            # tiered runs dispatch the v-ladder on the HOT count (the
+            # rows actually resident per shard), pmax-agreed like
+            # every class decision
+            u_max = lax.pmax(
+                c["h_loc"][0] if tier_mode else c["u_loc"][0], "shard"
+            )
             f_class = jnp.int32(0)
             for F_i in f_ladder[:-1]:
                 f_class = f_class + (
@@ -1183,12 +1348,38 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             if trace_log:
                 n_tot = lax.psum(c["n_loc"][0], "shard")
             ci = {k: v for k, v in c.items()
-                  if k not in ("wlog", "slog")}
+                  if k not in ("wlog", "slog", "pstash")}
             c2 = lax.switch(
                 f_class,
                 [make_wave(fc, v_class) for fc in range(len(f_ladder))],
                 ci,
             )
+            if trace_log and tier_mode:
+                # the wave-log/shard-log rows can't be written yet —
+                # the confirmed counts settle at the NEXT dispatch's
+                # commit; stash the wave-time lanes for it (lane 1 is
+                # 0 at the global level, as untiered: the tracer
+                # back-fills enabled pairs from the shard rows)
+                c2 = dict(
+                    c2,
+                    wlog=c["wlog"],
+                    slog=c["slog"],
+                    pstash=jnp.stack(
+                        [
+                            n_tot,
+                            jnp.uint32(0),
+                            c2["gen_lo"] - c["gen_lo"],
+                            c["depth"].astype(jnp.uint32),
+                            f_class.astype(jnp.uint32),
+                            v_class.astype(jnp.uint32),
+                            jnp.uint32(0),
+                            jnp.uint32(0),
+                        ]
+                    ),
+                )
+                return c2
+            if tier_mode:
+                return c2
             if trace_log:
                 # Every lane here is replicated (psum/pmax results and
                 # the engine's replicated run counters), so the log
@@ -1220,12 +1411,14 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                 )
             return c2
 
-        def cond(c):
-            return ~c["done"] & (c["wchunk"] < waves_per_sync)
+        # Tiered dispatches run exactly ONE wave: the commit phase
+        # needs the host's membership verdict between waves.
+        wps_eff = 1 if tier_mode else waves_per_sync
 
-        def chunk(carry):
-            c = dict(carry, wchunk=jnp.int32(0))
-            c = lax.while_loop(cond, body, c)
+        def cond(c):
+            return ~c["done"] & (c["wchunk"] < wps_eff)
+
+        def pack_stats(c):
             frontier_total = lax.psum(
                 jnp.sum(c["fval"]).astype(jnp.uint32), "shard"
             )
@@ -1262,6 +1455,11 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                 # stats stay replicated) — same dispatch, same sync.
                 return c, stats, c["slog"].reshape(-1)
             return c, stats
+
+        def chunk(carry):
+            c = dict(carry, wchunk=jnp.int32(0))
+            c = lax.while_loop(cond, body, c)
+            return pack_stats(c)
 
         P_shard = P("shard")
         specs = dict(
@@ -1302,6 +1500,177 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
         # (its named workaround). Newer jax type-checks varying-ness
         # instead, which the pvary/pcast promotions satisfy.
         sm_kw = {} if hasattr(lax, "pvary") else {"check_rep": False}
+
+        if tier_mode:
+            # -- the tiered chunk program (stateright_tpu/tier.py) -------
+            specs_t = dict(specs)
+            specs_t["pend_keys"] = P(None, "shard")
+            if track_paths:
+                specs_t["pend_par"] = P(None, "shard")
+            specs_t["pend_n"] = P_shard
+            specs_t["pend_valid"] = P()
+            specs_t["h_loc"] = P_shard
+            if trace_log:
+                specs_t["pstash"] = P()
+
+            def tier_commit(c, keep):
+                """Commit the previous wave's survivors, shard-local,
+                under the host's per-shard ``keep`` mask — the mirror
+                of the single-chip commit with the global verdicts
+                (cont/done/new) psum-agreed like every other
+                termination decision."""
+                pv = c["pend_valid"]
+                rowsF = jnp.arange(F, dtype=jnp.uint32)
+                keepm = keep & (rowsF < c["pend_n"][0])
+                conf = jnp.sum(keepm).astype(jnp.uint32)
+                drop = jnp.where(keepm, jnp.uint32(0), jnp.uint32(1))
+                _, perm = lax.sort((drop, rowsF), num_keys=1)
+                confv = rowsF < conf
+                front_c = jnp.where(
+                    confv[None, :], c["frontier"][:, perm],
+                    jnp.uint32(0),
+                )
+                eb_c = jnp.where(
+                    confv, c["ebits"][perm], jnp.uint32(0)
+                )
+                k_lo = jnp.where(
+                    confv, c["pend_keys"][0][perm], jnp.uint32(_SENT)
+                )
+                k_hi = jnp.where(
+                    confv, c["pend_keys"][1][perm], jnp.uint32(_SENT)
+                )
+
+                h_max = lax.pmax(c["h_loc"][0], "shard")
+                v_class = jnp.int32(0)
+                for V_i in v_ladder[:-1]:
+                    v_class = v_class + (
+                        h_max > jnp.uint32(V_i)
+                    ).astype(jnp.int32)
+
+                def app(vc):
+                    V_v = v_ladder[vc]
+
+                    def br(_):
+                        m_lo, m_hi = merge_sorted(
+                            c["vkeys"][0, :V_v], c["vkeys"][1, :V_v],
+                            k_lo, k_hi, impl=self.merge_impl,
+                        )
+                        return lax.dynamic_update_slice(
+                            c["vkeys"],
+                            jnp.stack([m_lo, m_hi]),
+                            (jnp.uint32(0), jnp.uint32(0)),
+                        )
+
+                    return br
+
+                vkeys_m = lax.switch(
+                    v_class,
+                    [app(vc) for vc in range(len(v_ladder))], 0,
+                )
+
+                def sel(a, b):
+                    return jnp.where(pv, a, b)
+
+                conf_g = lax.psum(conf, "shard")
+                confp = jnp.where(pv, conf, jnp.uint32(0))
+                confp_g = jnp.where(pv, conf_g, jnp.uint32(0))
+                new2 = c["new"] + confp_g
+                h_loc2 = c["h_loc"] + confp.reshape(1)
+                u_loc2 = c["u_loc"] + confp.reshape(1)
+                all_disc = (
+                    jnp.all(c["disc_found"]) if n_props
+                    else jnp.bool_(False)
+                )
+                if target_states is None:
+                    target_hit = jnp.bool_(False)
+                else:
+                    target_hit = new2 >= jnp.uint32(target_states)
+                overflow = c["overflow"] | (
+                    pv & bool_any(h_loc2[0] > jnp.uint32(C))
+                )
+                cont = (
+                    pv & (conf_g > 0) & ~all_disc & ~target_hit
+                    & ~overflow & ~c["f_overflow"]
+                    & ~c["c_overflow"] & ~c["e_overflow"]
+                )
+                out = dict(
+                    c,
+                    vkeys=sel(vkeys_m, c["vkeys"]),
+                    frontier=sel(front_c, c["frontier"]),
+                    ebits=sel(eb_c, c["ebits"]),
+                    fval=sel(confv & cont, c["fval"]),
+                    n_loc=sel(conf.reshape(1), c["n_loc"]),
+                    h_loc=h_loc2,
+                    u_loc=u_loc2,
+                    new=new2,
+                    depth=jnp.where(cont, c["depth"] + 1, c["depth"]),
+                    waves=c["waves"] + jnp.where(
+                        pv, jnp.uint32(1), jnp.uint32(0)
+                    ),
+                    overflow=overflow,
+                    done=sel(~cont, c["done"]),
+                    pend_valid=jnp.bool_(False),
+                    pend_n=jnp.zeros(1, jnp.uint32),
+                )
+                if track_paths:
+                    p_lo = jnp.where(
+                        confv, c["pend_par"][0][perm], jnp.uint32(0)
+                    )
+                    p_hi = jnp.where(
+                        confv, c["pend_par"][1][perm], jnp.uint32(0)
+                    )
+                    rows4 = jnp.stack([
+                        p_lo,
+                        p_hi,
+                        jnp.where(confv, k_lo, jnp.uint32(0)),
+                        jnp.where(confv, k_hi, jnp.uint32(0)),
+                    ])
+                    plog2 = lax.dynamic_update_slice(
+                        c["plog"], rows4, (jnp.uint32(0), c["pl_n"][0])
+                    )
+                    out["plog"] = sel(plog2, c["plog"])
+                    out["pl_n"] = c["pl_n"] + confp.reshape(1)
+                if trace_log:
+                    st = c["pstash"]
+                    row = jnp.stack([
+                        st[0], st[1], st[2], conf_g, new2,
+                        st[3], st[4], st[5],
+                    ])
+                    out["wlog"] = lax.dynamic_update_slice(
+                        c["wlog"], row[None, :],
+                        (jnp.int32(0), jnp.int32(0)),
+                    )
+                    # patch the stashed per-shard row's confirmed
+                    # lanes (7 = post-dedup new, 8 = cumulative
+                    # per-shard visited) before the slog write
+                    sw = jnp.concatenate([
+                        c["swave"][:7],
+                        jnp.stack([conf, u_loc2[0]]),
+                    ])
+                    out["slog"] = lax.dynamic_update_slice(
+                        c["slog"], sw[None, :],
+                        (jnp.int32(0), jnp.int32(0)),
+                    )
+                return out
+
+            def tier_chunk(carry, keep):
+                c = dict(carry, wchunk=jnp.int32(0))
+                c = tier_commit(c, keep)
+                c = lax.while_loop(cond, body, c)
+                return pack_stats(c)
+
+            self._tier_pspecs = dict(specs_t)
+            chunk_out_t = (
+                (specs_t, P(), P_shard) if trace_log
+                else (specs_t, P())
+            )
+            tier_sm = shard_map(
+                tier_chunk, mesh=mesh,
+                in_specs=(specs_t, P_shard), out_specs=chunk_out_t,
+                **sm_kw,
+            )
+            return jax.jit(tier_sm, donate_argnums=0)
+
         # Checkpoint/resume (stateright_tpu/checkpoint.py): a resumed
         # run places its snapshot buffers with these exact shardings —
         # kept beside the programs (rides the program cache via
@@ -1347,6 +1716,12 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
         incrementally-sorted visited array re-orders its rows every
         wave, so the round-9 positional child derivation is gone."""
         if self.generated is None:
+            tier = self._tier_generated_map()
+            if tier is not None:
+                # tiered runs drain the log host-side per dispatch
+                # (stateright_tpu/tier.py)
+                self.generated = tier
+                return self.generated
             _vkeys, plog, pl_n, _u_loc = (
                 np.asarray(a) for a in self._final_tables
             )

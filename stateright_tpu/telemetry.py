@@ -768,6 +768,14 @@ _REQUIRED = {
     "restore": ("run", "wave", "depth", "from_shards", "to_shards"),
     "fault_injected": ("run", "site", "chunk", "action"),
     "fault_recovery": ("run", "attempt", "error"),
+    # The tiered visited set (stateright_tpu/tier.py): one event per
+    # hot->cold spill — rows/bytes moved this spill, the cold tier's
+    # running totals, the hot rows before the reset, and the worker-
+    # side ingest wall (overlapped with the next dispatch). Counts
+    # and totals are EXACT exploration facts (trace_diff compares
+    # them exactly between two tiered runs); walls are timing lanes.
+    "tier_spill": ("run", "rows", "hot_rows_before", "cold_rows_total",
+                   "cold_bytes_total", "runs", "spill_index"),
 }
 
 
@@ -897,7 +905,8 @@ def _run_view(events: list[dict], run: int) -> dict:
                       chunks=[], spans=[], phase_totals={},
                       shard_waves={}, memory_plan=None,
                       memory_watermark=None, latency_profile=None,
-                      builds=[], verdicts=[], restores=[])
+                      builds=[], verdicts=[], restores=[],
+                      tier_spills=[])
     for ev in events:
         if ev.get("run") != run:
             continue
@@ -915,6 +924,8 @@ def _run_view(events: list[dict], run: int) -> dict:
             view["verdicts"].append(ev)
         elif kind == "restore":
             view["restores"].append(ev)
+        elif kind == "tier_spill":
+            view["tier_spills"].append(ev)
         elif kind == "wave":
             view["waves"].append(ev)
         elif kind == "shard_wave":
@@ -1237,12 +1248,13 @@ def memory_summary(events: list[dict], run: int | None = None,
         lane={k: lane[k] for k in
               ("engine", "model", "encoding", "capacity",
                "frontier_capacity", "cand_capacity", "n_shards",
-               "track_paths", "merge_impl")
+               "track_paths", "merge_impl", "tier_hot_rows")
               if k in lane},
         plan=_strip_ev(plan),
         watermark=_strip_ev(wm),
         chunk_mem=chunk_mem,
         engine_modes=modes,
+        tier_spills=[_strip_ev(ev) for ev in view["tier_spills"]],
     )
 
 
@@ -1609,6 +1621,72 @@ def _latency_diff(va: dict, vb: dict, threshold: float,
                 regressions=regressions)
 
 
+def _tier_diff(va: dict, vb: dict, threshold: float,
+               min_sec: float) -> dict:
+    """Tier-spill alignment between two runs (the tiered-visited-set
+    layer, stateright_tpu/tier.py): spill COUNTS and cold-tier
+    rows/bytes are exploration facts — two tiered runs of one
+    workload at the same hot ceiling spill identically, so any
+    mismatch is a divergence — while the spill/ingest WALLS compare
+    relative under the ``b - a > max(min_sec, threshold * a)`` bar
+    the latency lanes use.
+
+    A side with NO tier events simply skips the block (a forced-spill
+    run diffed against the all-resident baseline — the exact A/B this
+    layer's acceptance artifact records — must compare on the WAVE
+    counters, which stay fully enforced, not fail here; pre-tier
+    baseline traces keep diffing the same way)."""
+    sa, sb = va["tier_spills"], vb["tier_spills"]
+    divergences: list[dict] = []
+    lanes: dict = {}
+    regressions: list[str] = []
+    if not sa or not sb:
+        return dict(divergences=divergences, lanes=lanes,
+                    regressions=regressions,
+                    skipped=(not sa) != (not sb))
+
+    def counter(name, a, b):
+        if a != b:
+            divergences.append(dict(field=name, a=a, b=b))
+
+    counter("tier_spill_count", len(sa), len(sb))
+    counter("tier_rows_spilled",
+            sum(int(ev["rows"]) for ev in sa),
+            sum(int(ev["rows"]) for ev in sb))
+    counter("tier_cold_rows_final",
+            int(sa[-1]["cold_rows_total"]),
+            int(sb[-1]["cold_rows_total"]))
+    counter("tier_cold_bytes_final",
+            int(sa[-1]["cold_bytes_total"]),
+            int(sb[-1]["cold_bytes_total"]))
+
+    def lane(name, a, b):
+        if a is None or b is None:
+            return
+        rel = (b - a) / a if a > 0 else (
+            float("inf") if b > 0 else 0.0
+        )
+        lanes[name] = dict(
+            a=round(a, 6), b=round(b, 6), delta=round(b - a, 6),
+            rel=round(rel, 4) if rel != float("inf") else None,
+        )
+        if b - a > max(min_sec, threshold * a):
+            regressions.append(name)
+
+    def wall(evs, field):
+        vals = [ev.get(field) for ev in evs]
+        if any(v is None for v in vals):
+            return None
+        return float(sum(vals))
+
+    lane("tier_spill_wall_sec", wall(sa, "wall_sec"),
+         wall(sb, "wall_sec"))
+    lane("tier_ingest_wall_sec", wall(sa, "ingest_sec"),
+         wall(sb, "ingest_sec"))
+    return dict(divergences=divergences, lanes=lanes,
+                regressions=regressions, skipped=False)
+
+
 def diff_traces(
     a_events: list[dict],
     b_events: list[dict],
@@ -1690,6 +1768,7 @@ def diff_traces(
 
     memory = _memory_diff(va, vb, threshold)
     latency = _latency_diff(va, vb, threshold, min_sec)
+    tier = _tier_diff(va, vb, threshold, min_sec)
     if (rw_a is None) != (rw_b is None):
         # One side resumed mid-run: its walls cover a PARTIAL search
         # (plus a fresh process's compile fetches), so timing/byte
@@ -1699,6 +1778,12 @@ def diff_traces(
         regressions = []
         memory["regressions"] = []
         latency["regressions"] = []
+        tier["regressions"] = []
+        # spill-event counts are also not comparable across a resume:
+        # the pre-kill spills died with the killed process's trace
+        # (the cold-total lanes would match, but the per-event counts
+        # legitimately differ) — wave counters stay fully enforced
+        tier["divergences"] = []
     return dict(
         run_a=va["run"], run_b=vb["run"],
         waves_a=len(va["waves"]), waves_b=len(vb["waves"]),
@@ -1708,13 +1793,16 @@ def diff_traces(
         regressions=regressions,
         memory=memory,
         latency=latency,
+        tier=tier,
         threshold=threshold,
         min_sec=min_sec,
         ok=(not divergences and not regressions
             and not memory["divergences"]
             and not memory["regressions"]
             and not latency["divergences"]
-            and not latency["regressions"]),
+            and not latency["regressions"]
+            and not tier["divergences"]
+            and not tier["regressions"]),
     )
 
 
@@ -1779,6 +1867,29 @@ def format_diff(report: dict) -> str:
             f"{name:28s} {p['a']:10d} {p['b']:10d} "
             f"{p['delta']:+10d} {rel:>8s}{flag}"
         )
+    tier = report.get("tier") or {}
+    if tier.get("skipped"):
+        lines.append(
+            "tier: one side has no tier_spill events (an all-resident"
+            " baseline) — cold-tier lanes skipped"
+        )
+    if tier.get("divergences"):
+        lines.append(
+            f"TIER DIVERGENCE ({len(tier['divergences'])} "
+            "mismatches) — the two runs spilled differently:"
+        )
+        for d in tier["divergences"][:10]:
+            lines.append(
+                f"  {d['field']:22s} A={d['a']} B={d['b']}"
+            )
+    for name, p in (tier.get("lanes") or {}).items():
+        rel = "n/a" if p["rel"] is None else f"{p['rel']:+.1%}"
+        flag = ("  <-- REGRESSION"
+                if name in tier.get("regressions", ()) else "")
+        lines.append(
+            f"{name:28s} {p['a']:10.4f} {p['b']:10.4f} "
+            f"{p['delta']:+10.4f} {rel:>8s}{flag}"
+        )
     lat = report.get("latency") or {}
     if lat.get("divergences"):
         lines.append(
@@ -1801,11 +1912,13 @@ def format_diff(report: dict) -> str:
         )
     mem_regs = mem.get("regressions") or []
     lat_regs = lat.get("regressions") or []
+    tier_regs = tier.get("regressions") or []
     verdict = "OK" if report["ok"] else (
         "FAIL: wave divergence" if report["divergences"]
         else "FAIL: memory-plan divergence" if mem.get("divergences")
         else "FAIL: verdict divergence" if lat.get("divergences")
-        else f"FAIL: {len(report['regressions']) + len(mem_regs) + len(lat_regs)} "
+        else "FAIL: tier divergence" if tier.get("divergences")
+        else f"FAIL: {len(report['regressions']) + len(mem_regs) + len(lat_regs) + len(tier_regs)} "
              f"lane(s) past +{report['threshold']:.0%}"
     )
     lines.append(f"verdict: {verdict}")

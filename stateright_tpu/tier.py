@@ -1,0 +1,281 @@
+"""Tiered visited set: the host-DRAM COLD tier behind the device-hot
+sort-merge dedup (ROADMAP direction 1b, PERF.md §tiered-visited).
+
+Every engine before this round kept the ENTIRE visited set
+device-resident, so the reachable space was bounded by HBM — the
+memplan capacity projection prices exactly when that breaks (44 MB at
+paxos-4's next v-class, §memory), and GPUexplore's scalability study
+(arXiv:1801.05857) frames dedup-structure capacity, not step
+throughput, as what caps explicit-state exploration. The elastic-
+resource framing of arXiv:1203.6806 is the fix this module implements:
+the visited set becomes TWO tiers —
+
+* **HOT** — the existing incrementally-sorted ``vkeys`` prefix on
+  device, now capped by a ladder ceiling (``tier_hot_rows``; the
+  memplan projection decides the split in ``"auto"`` mode via
+  :func:`stateright_tpu.memplan.decide_hot_rows`). The wave's
+  on-device membership/merge passes are unchanged and scale with the
+  HOT count, not the cumulative unique count.
+* **COLD** — sorted immutable runs in host DRAM (this module's
+  :class:`ColdStore`). A spill moves the whole hot prefix — ALREADY
+  ``(hi, lo)``-lexsorted by the round-10 invariant, so a spilled run
+  needs no host sort — at the existing per-chunk sync (the stats
+  readback just blocked; the prefix download piggybacks exactly the
+  way the checkpoint carry download does: transfer, not a new sync
+  point). Run ingest and compaction happen on a WORKER THREAD,
+  overlapped with the next dispatch's device compute; membership
+  joins the worker (:meth:`ColdStore.sync`) before it reads.
+
+**Exactness: the deferred-commit protocol.** With a non-empty cold
+tier, a candidate that survives the on-device hot merge is only
+*provisionally* new — it might duplicate a spilled key. The engines
+therefore switch to a tiered chunk program (one wave per dispatch)
+whose wave STAGES its winners (keys, states, ebits, parent limbs)
+instead of committing them, and whose NEXT dispatch takes a host-
+computed ``keep`` mask — the batched sort-merge membership verdict of
+this module's binary search over the cold runs — and commits only the
+survivors: count, frontier, parent log, and the hot-tier merge all
+see exactly the truly-new rows, in the same key-sorted order the
+resident engine commits, so per-wave counters, unique totals, and
+counterexample paths are bit-identical to an all-resident run
+(``pytest -m tier`` pins it; trace_diff proves it on the committed
+forced-spill artifacts). No false-new row is ever expanded, so there
+is nothing to retract — the membership pass retires false-new rows
+BEFORE they reach the unique counts or the parent-log drain.
+
+Runs are disjoint by construction: a key is spilled at most once
+(a cold member never passes the keep mask, so it never re-enters the
+hot tier), which makes ``hot + sum(run rows)`` the exact cumulative
+unique count and the per-run binary searches an exact membership
+oracle. When the run count passes ``max_runs`` the worker compacts
+all runs into one (one ``np.sort`` over the packed u64 keys) so
+membership stays O(log) per query with a bounded run fan-in.
+
+Import-light by design (numpy + stdlib only): the device side lives
+in the engines (checkers/tpu_sortmerge.py, parallel/
+engine_sortmerge.py), snapshots in checkpoint.py, pricing in
+memplan.py.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+_SENT = 0xFFFFFFFF
+
+#: logical bytes per cold-tier key: two uint32 limbs.
+COLD_BYTES_PER_ROW = 8
+
+
+def pack_u64(lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
+    """One sortable uint64 key per (lo, hi) limb pair, ordered the
+    SAME way as the device invariant's ``(hi, lo)`` lexsort — hi is
+    the major limb — so a ``(hi, lo)``-lexsorted run packs to a
+    sorted u64 array with no re-sort."""
+    return (
+        hi.astype(np.uint64) << np.uint64(32)
+    ) | lo.astype(np.uint64)
+
+
+def member_mask(run: np.ndarray, q: np.ndarray) -> np.ndarray:
+    """``bool[len(q)]``: which packed query keys appear in the sorted
+    packed run (one vectorized binary search — the batched sort-merge
+    membership primitive)."""
+    if run.size == 0 or q.size == 0:
+        return np.zeros(q.shape, bool)
+    idx = np.searchsorted(run, q)
+    idx = np.minimum(idx, run.size - 1)
+    return run[idx] == q
+
+
+class ColdStore:
+    """The host-DRAM cold tier: per-shard lists of sorted immutable
+    runs (packed u64 keys), with async ingest and run compaction on a
+    worker thread.
+
+    Per-shard because spills are per-shard (each mesh shard owns the
+    keys with ``fp_lo % S == shard``) and membership queries are too
+    — a shard's provisional winners can only duplicate keys the SAME
+    shard spilled. Single-chip engines are the ``n_shards=1`` case.
+    """
+
+    def __init__(self, n_shards: int = 1, max_runs: int = 8):
+        self.n_shards = int(n_shards)
+        self.max_runs = int(max_runs)
+        #: per-shard list of sorted np.uint64 arrays (immutable runs)
+        self.runs: list[list[np.ndarray]] = [
+            [] for _ in range(self.n_shards)
+        ]
+        self.spills = 0
+        self._lock = threading.Lock()
+        self._worker: Optional[threading.Thread] = None
+        #: wall seconds spent in worker-side ingest/compaction (the
+        #: overlapped cost — tier_spill events report it)
+        self.ingest_sec = 0.0
+
+    # -- ingest (the spill path) ------------------------------------------
+
+    def ingest(self, per_shard: list[tuple[np.ndarray, np.ndarray]],
+               *, asynchronous: bool = True) -> None:
+        """Append one spill — per-shard ``(lo, hi)`` limb pairs, each
+        ALREADY (hi, lo)-lexsorted (the device prefix invariant) — as
+        new immutable runs. ``asynchronous=True`` runs the pack +
+        compaction on a worker thread so it overlaps the next
+        dispatch's device compute; :meth:`sync` joins it before any
+        membership read. At most one ingest is in flight (the double-
+        buffer discipline: the caller spills at chunk syncs, which
+        are strictly ordered)."""
+        self.sync()
+        packed = [
+            (np.ascontiguousarray(lo), np.ascontiguousarray(hi))
+            for lo, hi in per_shard
+        ]
+        self.spills += 1
+        if not asynchronous:
+            self._do_ingest(packed)
+            return
+        t = threading.Thread(
+            target=self._do_ingest, args=(packed,),
+            name="stpu-tier-ingest", daemon=True,
+        )
+        self._worker = t
+        t.start()
+
+    def _do_ingest(self, per_shard) -> None:
+        import time
+
+        t0 = time.monotonic()
+        for s, (lo, hi) in enumerate(per_shard):
+            if lo.size == 0:
+                continue
+            run = pack_u64(lo, hi)
+            with self._lock:
+                self.runs[s].append(run)
+                if len(self.runs[s]) > self.max_runs:
+                    # compaction: one k-way sort-merge (np.sort over
+                    # the concat — runs are disjoint, so no dedup
+                    # pass is needed) bounds the membership fan-in
+                    merged = np.sort(np.concatenate(self.runs[s]))
+                    self.runs[s] = [merged]
+        self.ingest_sec += time.monotonic() - t0
+
+    def sync(self) -> None:
+        """Join any in-flight ingest (call before membership reads
+        and before snapshotting the run set)."""
+        w = self._worker
+        if w is not None and w.is_alive():
+            w.join()
+        self._worker = None
+
+    # -- membership (the batched sort-merge pass) -------------------------
+
+    def member(self, shard: int, q_lo: np.ndarray,
+               q_hi: np.ndarray) -> np.ndarray:
+        """``bool[len(q)]``: which query keys one shard's cold runs
+        contain — the host half of the tiered dedup. Queries are the
+        wave's provisional winner keys; the engines invert this into
+        the ``keep`` mask the commit dispatch consumes."""
+        q = pack_u64(q_lo, q_hi)
+        out = np.zeros(q.shape, bool)
+        with self._lock:
+            runs = list(self.runs[shard])
+        for run in runs:
+            out |= member_mask(run, q)
+        return out
+
+    # -- accounting --------------------------------------------------------
+
+    def rows(self) -> int:
+        with self._lock:
+            return int(sum(
+                r.size for shard in self.runs for r in shard
+            ))
+
+    def shard_rows(self) -> list[int]:
+        with self._lock:
+            return [
+                int(sum(r.size for r in shard)) for shard in self.runs
+            ]
+
+    def bytes(self) -> int:
+        return self.rows() * COLD_BYTES_PER_ROW
+
+    def run_count(self) -> int:
+        with self._lock:
+            return sum(len(shard) for shard in self.runs)
+
+    def summary(self) -> dict:
+        """The accounting block tier_spill events, the memory
+        watermark, and checkpoint manifests embed."""
+        return dict(
+            n_shards=self.n_shards,
+            spills=int(self.spills),
+            runs=self.run_count(),
+            cold_rows_total=self.rows(),
+            cold_bytes_total=self.bytes(),
+            rows_per_shard=self.shard_rows(),
+            ingest_sec=round(self.ingest_sec, 6),
+        )
+
+    # -- snapshot / re-shard ----------------------------------------------
+
+    def snapshot_runs(self) -> list[list[tuple[np.ndarray, np.ndarray]]]:
+        """Per-shard ``(lo, hi)`` limb pairs of every run (for
+        checkpoint serialization — checkpoint.py stores them as
+        ``tier_run{shard}_{i}_lo/hi`` buffers)."""
+        self.sync()
+        out = []
+        with self._lock:
+            for shard in self.runs:
+                out.append([
+                    (
+                        (r & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+                        (r >> np.uint64(32)).astype(np.uint32),
+                    )
+                    for r in shard
+                ])
+        return out
+
+    @classmethod
+    def from_runs(cls, per_shard_runs, max_runs: int = 8,
+                  spills: int = 0) -> "ColdStore":
+        """Rebuild a store from snapshot runs (per-shard lists of
+        ``(lo, hi)`` pairs, each (hi, lo)-lexsorted)."""
+        store = cls(n_shards=len(per_shard_runs), max_runs=max_runs)
+        store.spills = int(spills)
+        for s, shard in enumerate(per_shard_runs):
+            for lo, hi in shard:
+                if np.asarray(lo).size:
+                    store.runs[s].append(
+                        pack_u64(np.asarray(lo, np.uint32),
+                                 np.asarray(hi, np.uint32))
+                    )
+        return store
+
+    def repartitioned(self, n_shards_new: int,
+                      max_runs: Optional[int] = None) -> "ColdStore":
+        """The cold half of the elastic re-shard (checkpoint.py): each
+        run splits by the NEW owner function ``lo % S_new`` — the same
+        (owner, fp) seam the mesh routing sort and the resident
+        re-shard use. Filtering a sorted run preserves its order, so
+        every piece is still a sorted immutable run; runs stay
+        disjoint because they were disjoint globally."""
+        self.sync()
+        out = ColdStore(
+            n_shards=n_shards_new,
+            max_runs=self.max_runs if max_runs is None else max_runs,
+        )
+        out.spills = self.spills
+        S = np.uint64(max(n_shards_new, 1))
+        with self._lock:
+            for shard in self.runs:
+                for run in shard:
+                    owner = (run & np.uint64(0xFFFFFFFF)) % S
+                    for d in range(n_shards_new):
+                        piece = run[owner == np.uint64(d)]
+                        if piece.size:
+                            out.runs[d].append(piece)
+        return out

@@ -109,6 +109,13 @@ def test_comms_lint_clean_all_fixtures(gate_report):
         for traced in (False, True):
             name = comms_fixture_name(engine, traced)
             assert (name, "wave-body") in covered, name
+    # the TIERED chunk program (round 16, stateright_tpu/tier.py)
+    # rides the same gate: its deferred-commit phase must stay
+    # collective-clean too
+    assert (
+        comms_fixture_name("sortmerge", True, tiered=True),
+        "wave-body",
+    ) in covered
     assert (RECONCILIATION_FIXTURE, "wave-body") in covered
     for spec in ENCODINGS:
         assert (spec.name, "engine:sharded") in covered, spec.name

@@ -1,0 +1,431 @@
+"""Tiered-visited-set gate (``tier`` marker, stateright_tpu/tier.py).
+
+The exactness contract: a hot tier forced tiny (so the engines spill
+repeatedly to host-DRAM cold runs and run the deferred-commit tiered
+chunk loop for most of the search) reproduces the pinned counts
+EXACTLY — paxos 2c/3s = 16,668 with a replayable counterexample path,
+2pc rm=7 = 296,448 — with traced runs showing ZERO per-wave counter
+divergence against the all-resident baseline. Plus: the ColdStore
+primitives (membership, run disjointness, owner repartition), the
+``tier_spill`` event schema and trace_diff alignment (tiered pairs
+compare, resident baselines skip), checkpoint kill/resume across a
+spill boundary, the 2→4 elastic re-shard with cold runs present, the
+un-tier resume, the memplan hot/cold split policy, and the
+``--checkpoint-every=auto`` cadence math.
+"""
+
+import numpy as np
+import pytest
+
+from stateright_tpu import faultinject
+from stateright_tpu.models.paxos import PaxosModelCfg, paxos_model
+from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+pytestmark = pytest.mark.tier
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faultinject.disarm_all()
+
+
+def _twopc3(**kw):
+    kw.setdefault("tier_hot_rows", 32)
+    return TwoPhaseSys(rm_count=3).checker().spawn_tpu_sortmerge(
+        capacity=1 << 10, frontier_capacity=128, cand_capacity=512,
+        waves_per_sync=2, **kw,
+    )
+
+
+def _twopc4(**kw):
+    return TwoPhaseSys(rm_count=4).checker().spawn_tpu_sortmerge(
+        capacity=1 << 11, frontier_capacity=512, cand_capacity=4096,
+        waves_per_sync=4, **kw,
+    )
+
+
+def _mesh2pc4(n_shards, **kw):
+    kw.setdefault("cand_capacity", 4096)
+    kw.setdefault("bucket_capacity", 2048)
+    return TwoPhaseSys(rm_count=4).checker().spawn_tpu_sharded_sortmerge(
+        n_shards=n_shards, capacity=1 << 11, frontier_capacity=256,
+        waves_per_sync=4, **kw,
+    )
+
+
+# -- the ColdStore primitives ---------------------------------------------
+
+
+def test_cold_store_membership_runs_and_repartition():
+    from stateright_tpu.tier import ColdStore, member_mask, pack_u64
+
+    rng = np.random.default_rng(7)
+
+    def sorted_pair(n):
+        lo = rng.integers(0, 1 << 32, n, dtype=np.uint32)
+        hi = rng.integers(0, 1 << 32, n, dtype=np.uint32)
+        order = np.lexsort((lo, hi))
+        return lo[order], hi[order]
+
+    lo, hi = sorted_pair(500)
+    q_lo, q_hi = sorted_pair(200)
+    run = pack_u64(lo, hi)
+    got = member_mask(np.sort(run), pack_u64(q_lo, q_hi))
+    want = np.isin(pack_u64(q_lo, q_hi), run)
+    assert (got == want).all()
+
+    # multi-run ingest (sync mode), compaction past max_runs, and the
+    # hot+cold accounting invariant
+    store = ColdStore(n_shards=1, max_runs=2)
+    total = 0
+    for _ in range(5):
+        lo, hi = sorted_pair(100)
+        store.ingest([(lo, hi)], asynchronous=False)
+        total += 100
+    assert store.rows() == total  # random u64s: no collisions
+    assert store.run_count() <= 2  # compaction bounded the fan-in
+    assert store.bytes() == total * 8
+    assert store.member(0, lo, hi).all()
+
+    # owner repartition: filtering preserves sort, owners route by
+    # lo % S (the (owner, fp) seam)
+    re4 = store.repartitioned(4)
+    assert re4.rows() == store.rows()
+    for d in range(4):
+        for run in re4.runs[d]:
+            assert (np.diff(run.astype(np.uint64)) > 0).all()
+            owners = (run & np.uint64(0xFFFFFFFF)) % np.uint64(4)
+            assert (owners == d).all()
+
+    # snapshot round-trip
+    rebuilt = ColdStore.from_runs(store.snapshot_runs(),
+                                  spills=store.spills)
+    assert rebuilt.rows() == store.rows()
+    assert rebuilt.member(0, lo, hi).all()
+
+
+def test_decide_hot_rows_policy():
+    from stateright_tpu.memplan import decide_hot_rows
+
+    # the whole ladder fits: tier dormant (ceiling = capacity)
+    assert decide_hot_rows(1 << 20, 1 << 10, 2, 1 << 8,
+                           1 << 40) == 1 << 20
+    # nothing past the bottom fits: ceiling = v_min
+    assert decide_hot_rows(1 << 20, 1 << 10, 2, 1 << 8, 1) == 1 << 10
+    # the budget prices (V + F) * 8 * 2 (vkeys + merge scratch):
+    # pick the largest class under it
+    F = 1 << 8
+    budget = 2 * ((1 << 14) + F) * 8
+    hot = decide_hot_rows(1 << 20, 1 << 10, 2, F, budget)
+    assert hot == 1 << 14
+    assert decide_hot_rows(1 << 20, 1 << 10, 2, F,
+                           budget - 1) == 1 << 13
+
+
+def test_auto_checkpoint_cadence_policy():
+    from stateright_tpu.checkpoint import auto_cadence
+
+    # 0.5s snapshot vs 10s chunks: every chunk already <=5%
+    assert auto_cadence(0.5, 10.0) == 1
+    # 0.5s snapshot vs 1s chunks: need 10 chunks per snapshot
+    assert auto_cadence(0.5, 1.0) == 10
+    # exact boundary: ceil keeps overhead AT the target
+    assert auto_cadence(1.0, 4.0, target=0.05) == 5
+    # clamps
+    assert auto_cadence(100.0, 0.001) == 256
+    assert auto_cadence(0.0, 1.0) == 1  # unmeasured snapshot wall
+    assert auto_cadence(1.0, 0.0) == 256  # unmeasured chunk wall
+    # custom target
+    assert auto_cadence(1.0, 1.0, target=0.5) == 2
+
+
+def test_auto_cadence_engine_integration(tmp_path):
+    """``checkpoint_every="auto"`` writes snapshots and re-derives
+    its cadence from the measured walls (no crash, snapshot exists,
+    cadence is a positive int)."""
+    snap = str(tmp_path / "auto.ckpt")
+    c = _twopc3(tier_hot_rows=None, checkpoint_every="auto",
+                checkpoint_path=snap)
+    c.join()
+    assert c.unique_state_count() == 288
+    import os
+
+    assert os.path.exists(snap)
+    assert c._ckpt_auto_every >= 1
+
+
+# -- forced-spill count parity (the pinned counts) ------------------------
+
+
+def test_tier_2pc_rm3_forced_spill_288():
+    c = _twopc3().join()
+    assert c.unique_state_count() == 288
+    assert c.metrics["tier_spills"] >= 2  # spilled repeatedly
+    # the two tiers partition the visited set exactly
+    assert c.metrics["cold_rows"] + c.metrics["hot_rows"] == 288
+    # cold holds the majority at this forced ceiling
+    assert c.metrics["cold_rows"] > 288 // 2
+    for name, path in c.discoveries().items():
+        prop = c.model.property_by_name(name)
+        assert prop.condition(c.model, path.last_state()), name
+
+
+def test_tier_paxos_2c3s_forced_spill_16668():
+    """paxos 2c/3s with the hot tier capped at 1/16th of the space
+    spills repeatedly and still reproduces the pinned 16,668 with a
+    replayable counterexample path."""
+    c = (
+        paxos_model(PaxosModelCfg(client_count=2, server_count=3))
+        .checker()
+        .spawn_tpu_sortmerge(
+            capacity=1 << 15, frontier_capacity=1 << 12,
+            cand_capacity=1 << 14, waves_per_sync=8,
+            tier_hot_rows=1024,
+        )
+    )
+    c.join()
+    assert c.unique_state_count() == 16668
+    assert c.metrics["tier_spills"] >= 2
+    assert c.metrics["cold_rows"] > 16668 // 2
+    assert sorted(c.discoveries()) == ["value chosen"]
+    path = c.discovery("value chosen")
+    prop = c.model.property_by_name("value chosen")
+    assert prop.condition(c.model, path.last_state())
+
+
+def test_tier_2pc_rm7_forced_spill_296448():
+    """The largest CPU-feasible lane: 2pc rm=7 with the hot tier at
+    1/8th of the space reproduces the pinned 296,448. The frontier
+    gets one notch of headroom over the resident config: in tiered
+    mode the bound applies to PROVISIONAL winners (hot-new rows
+    before the cold membership pass), which exceed the truly-new
+    peak once most of the visited set is cold."""
+    c = TwoPhaseSys(rm_count=7).checker().spawn_tpu_sortmerge(
+        capacity=1 << 19, frontier_capacity=1 << 17,
+        cand_capacity=1 << 19, track_paths=False,
+        waves_per_sync=4, tier_hot_rows=1 << 16,
+    )
+    c.join()
+    assert c.unique_state_count() == 296448
+    assert c.metrics["tier_spills"] >= 2
+    assert c.metrics["cold_rows"] > 296448 // 2
+    c.assert_properties()
+
+
+# -- traced exactness: zero counter divergence vs resident ----------------
+
+
+def test_tier_traced_zero_divergence_and_schema():
+    """A traced forced-spill run diffs against the traced all-resident
+    baseline with ZERO wave-counter divergence — the per-wave proof
+    that the deferred-commit protocol retires false-new rows before
+    any count commits. Also pins the tier_spill schema, the watermark
+    cold_tier_bytes lane, and the tier trace_diff block (tiered pair
+    compares; resident baseline skips)."""
+    from stateright_tpu.telemetry import (
+        RunTracer,
+        diff_traces,
+        memory_summary,
+        validate_events,
+    )
+
+    ta = RunTracer()
+    with ta.activate():
+        a = _twopc4().join()
+    tb = RunTracer()
+    with tb.activate():
+        b = _twopc4(tier_hot_rows=64).join()
+    assert a.unique_state_count() == b.unique_state_count() == 1568
+    validate_events(ta.events)
+    validate_events(tb.events)
+
+    spills = [e for e in tb.events if e["ev"] == "tier_spill"]
+    assert len(spills) >= 2
+    last = spills[-1]
+    assert last["cold_rows_total"] * 8 == last["cold_bytes_total"]
+    assert last["spill_index"] == len(spills)
+
+    wm = [e for e in tb.events if e["ev"] == "memory_watermark"][-1]
+    assert wm["cold_tier_bytes"] == last["cold_bytes_total"]
+    tier_hr = wm["headroom"]["tier"]
+    assert tier_hr["cold_rows_total"] == last["cold_rows_total"]
+    # the resident baseline's watermark carries the lane as null
+    wm_a = [e for e in ta.events if e["ev"] == "memory_watermark"][-1]
+    assert wm_a["cold_tier_bytes"] is None
+
+    # resident vs tiered: counters must match, tier block skips
+    rep = diff_traces(ta.events, tb.events)
+    assert rep["divergences"] == []
+    assert rep["tier"]["skipped"] is True
+
+    # tiered vs tiered: tier counters compare exactly
+    tc = RunTracer()
+    with tc.activate():
+        c = _twopc4(tier_hot_rows=64).join()
+    assert c.unique_state_count() == 1568
+    rep2 = diff_traces(tb.events, tc.events)
+    assert rep2["divergences"] == []
+    assert rep2["tier"]["divergences"] == []
+    assert rep2["tier"]["skipped"] is False
+    assert "tier_spill_wall_sec" in rep2["tier"]["lanes"]
+
+    # a doctored cold total is a DIVERGENCE, not a timing delta
+    import copy
+
+    bad = copy.deepcopy(tc.events)
+    for ev in bad:
+        if ev["ev"] == "tier_spill":
+            ev["cold_rows_total"] += 1
+    rep3 = diff_traces(tb.events, bad)
+    assert any(d["field"] == "tier_cold_rows_final"
+               for d in rep3["tier"]["divergences"])
+    assert not rep3["ok"]
+
+    # mem_report renders the tiered run and prints the split
+    summary = memory_summary(tb.events)
+    assert summary["tier_spills"]
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "mem_report_mod",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "mem_report.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    report = mod.format_report(summary)
+    assert "tiered visited set" in report
+    assert "tier spills" in report
+
+
+# -- durability across the tier ------------------------------------------
+
+
+def _kill_at(spawn, snap, chunk, **kw):
+    c = spawn(checkpoint_every=1, checkpoint_path=snap, **kw)
+    c.max_fault_retries = 0
+    faultinject.arm("raise", "chunk_boundary", chunk)
+    with pytest.raises(faultinject.InjectedFault):
+        c.join()
+    faultinject.disarm_all()
+    return c
+
+
+def test_tier_kill_resume_across_spill_boundary(tmp_path):
+    """Kill a tiered run at chunk boundaries spanning the first spill
+    and deep into the tiered phase; resume reproduces the pinned 288
+    with replayable paths (the snapshot carries the cold runs AND the
+    host-drained parent-log rows)."""
+    base = _twopc3().join()
+    n_chunks = base.latency_accounting()["chunks"]
+    assert n_chunks >= 4
+    for k in (0, 1, n_chunks // 2, n_chunks - 2):
+        snap = str(tmp_path / f"t{k}.ckpt")
+        _kill_at(_twopc3, snap, k)
+        from stateright_tpu.checkpoint import load_snapshot
+
+        manifest, _ = load_snapshot(snap)
+        r = _twopc3()
+        r.resume_from(snap)
+        r.join()
+        assert r.unique_state_count() == 288, f"boundary {k}"
+        for name, path in r.discoveries().items():
+            prop = r.model.property_by_name(name)
+            assert prop.condition(r.model, path.last_state()), name
+
+
+def test_tier_untier_resume(tmp_path):
+    """A tiered snapshot resumes into a RESIDENT checker when the
+    target capacity holds both tiers: the cold runs merge back into
+    the visited prefix and the host-drained parent log re-homes —
+    same count, replayable paths. A resident target too small for
+    the folded set refuses loudly."""
+    snap = str(tmp_path / "untier.ckpt")
+    base = _twopc3().join()
+    n_chunks = base.latency_accounting()["chunks"]
+    _kill_at(_twopc3, snap, n_chunks - 2)
+    r = _twopc3(tier_hot_rows=None)  # tier OFF: fold to resident
+    r.resume_from(snap)
+    r.join()
+    assert r.unique_state_count() == 288
+    assert r.metrics.get("tier_spills") is None  # stayed resident
+    for name, path in r.discoveries().items():
+        prop = r.model.property_by_name(name)
+        assert prop.condition(r.model, path.last_state()), name
+
+    from stateright_tpu.checkpoint import SnapshotIncompatibleError
+
+    # a resident target too small for the folded set refuses loudly
+    # BEFORE any device work (either at the hot re-shard slice or at
+    # the un-tier fold, whichever trips first)
+    tiny = TwoPhaseSys(rm_count=3).checker().spawn_tpu_sortmerge(
+        capacity=64, frontier_capacity=32, cand_capacity=128,
+        waves_per_sync=2,
+    )
+    with pytest.raises(SnapshotIncompatibleError):
+        tiny.resume_from(snap)
+
+
+@pytest.fixture(scope="module")
+def host_2pc4():
+    return TwoPhaseSys(rm_count=4).checker().spawn_bfs().join()
+
+
+def test_tier_mesh_and_reshard_with_cold_runs(tmp_path, host_2pc4):
+    """The sharded tier on the virtual mesh: a forced-spill S=2 run
+    matches the host oracle; killed mid-tier it resumes same-shard
+    AND through the 2→4 (owner, fp) re-shard WITH cold runs present
+    (each run splits by the new owner), to the same count with
+    replayable paths."""
+    expected = host_2pc4.unique_state_count()
+    c = _mesh2pc4(2, tier_hot_rows=64).join()
+    assert c.unique_state_count() == expected
+    assert c.metrics["tier_spills"] >= 2
+    assert c.metrics["cold_rows"] > expected // 2
+
+    snap = str(tmp_path / "mesh.ckpt")
+    _kill_at(lambda **kw: _mesh2pc4(2, tier_hot_rows=64, **kw),
+             snap, 8)
+    from stateright_tpu.checkpoint import load_snapshot
+
+    manifest, _ = load_snapshot(snap)
+    assert manifest["tier"]["cold_rows_total"] > 0  # mid-tier kill
+
+    same = _mesh2pc4(2, tier_hot_rows=64)
+    same.resume_from(snap)
+    same.join()
+    assert same.unique_state_count() == expected
+
+    re4 = _mesh2pc4(4, tier_hot_rows=64)
+    m = re4.resume_from(snap)
+    assert m["n_shards"] == 2
+    re4.join()
+    assert re4.unique_state_count() == expected
+    assert sorted(re4.discoveries()) == sorted(host_2pc4.discoveries())
+    for name, path in re4.discoveries().items():
+        prop = re4.model.property_by_name(name)
+        assert prop.condition(re4.model, path.last_state()), name
+
+
+def test_tier_auto_ceiling_dormant():
+    """``tier_hot_rows="auto"`` with a budget holding the whole
+    ladder leaves the tier dormant (no spills, all-resident run);
+    with a budget that only fits a small ladder class it activates.
+    (The ladder must reach below the capacity for a split to exist:
+    v_min < capacity.)"""
+    c = _twopc3(tier_hot_rows="auto")  # default budget >> 2pc rm=3
+    c.join()
+    assert c.unique_state_count() == 288
+    assert c.metrics.get("tier_spills") is None
+
+    # budget = exactly one 64-row class's vkeys + merge scratch:
+    # decide_hot_rows picks 64, the run spills past it
+    budget = 2 * (64 + 128) * 8
+    c2 = _twopc3(tier_hot_rows="auto", tier_budget_bytes=budget,
+                 v_min=64)
+    c2.join()
+    assert c2.unique_state_count() == 288
+    assert c2._tier_hot_ceiling == 64
+    assert c2.metrics["tier_spills"] >= 1

@@ -133,6 +133,26 @@ def format_report(summary: dict, max_chunks: int = 20) -> str:
             f"{m.get('chunks')} chunks of {m.get('chunk_rows'):,}; "
             f"flat budget {fb(m.get('flat_budget_bytes'))})"
         )
+    spills = summary.get("tier_spills") or []
+    if spills:
+        lines.append("")
+        lines.append(
+            f"tier spills ({len(spills)} — hot prefix -> host-DRAM "
+            "cold runs at the chunk sync):"
+        )
+        shown = spills[:max_chunks]
+        lines.append(
+            "  rows per spill: " + " ".join(
+                f"{s['rows']:,}" for s in shown
+            ) + (f" ... ({len(spills) - max_chunks} more)"
+                 if len(spills) > max_chunks else "")
+        )
+        last = spills[-1]
+        lines.append(
+            f"  cold tier after last spill: "
+            f"{last['cold_rows_total']:,} rows = "
+            f"{fb(last['cold_bytes_total'])}"
+        )
     wm = summary.get("watermark")
     chunks = summary.get("chunk_mem") or []
     if wm or chunks:
@@ -170,6 +190,23 @@ def format_report(summary: dict, max_chunks: int = 20) -> str:
                 f"{budget.get('observed_peak') or 0:,}"
                 + (f" ({ratio:.2f}x headroom)"
                    if ratio is not None else "")
+            )
+        tier = (hr.get("tier") or {}) if hr else {}
+        if tier:
+            hot = tier.get("hot_ceiling_rows")
+            cold_rows = tier.get("cold_rows_total", 0)
+            hot_rows = hr.get("visited_rows", 0) - cold_rows
+            lines.append(
+                f"  tiered visited set: hot {hot_rows:,} rows "
+                f"(device, ceiling "
+                + (f"{hot:,}" if hot is not None else "-")
+                + f") / cold {cold_rows:,} rows = "
+                f"{fb(tier.get('cold_bytes_total'))} in "
+                f"{tier.get('runs', 0)} host-DRAM run(s), "
+                f"{tier.get('spills', 0)} spill(s) "
+                f"(spill wall {tier.get('spill_wall_sec', 0):.3f}s, "
+                f"worker ingest {tier.get('ingest_sec', 0):.3f}s "
+                "overlapped)"
             )
         proj = wm.get("projection") or {}
         if proj.get("kind") == "next_v_class":

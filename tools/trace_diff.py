@@ -41,6 +41,14 @@ artifact and this tool is the comparison —
   exhaustion on the other is a DIVERGENCE (the runs answered the
   property differently). Sides without latency events skip the
   block, so pre-round-14 baselines keep diffing.
+* **tier alignment** (round 16) — traces carrying ``tier_spill``
+  events (the tiered visited set, stateright_tpu/tier.py) compare
+  spill counts and cold-tier rows/bytes EXACTLY (two tiered runs of
+  one workload at one hot ceiling spill identically — a mismatch is
+  a divergence) and the spill/ingest walls under the latency bar.
+  A side with no tier events skips the block: a forced-spill run
+  diffs against the all-resident baseline on the wave counters
+  alone — which is exactly the tiered-dedup exactness proof.
 * **regression threshold** — exit nonzero when any phase at least
   ``--min-sec`` long on the A side grew by more than ``--threshold``
   (relative), or on any wave divergence.

@@ -269,7 +269,7 @@ def latest_ckpt_summary(root: str | None = None) -> dict | None:
     repo = repo_root() if root is None else root
     head = _git_sha(repo)
     dirty = _git_dirty(repo)
-    return {
+    out = {
         "artifact": os.path.basename(path),
         "clean": bool(report.get("clean")),
         "git_sha": ckpt_sha,
@@ -281,6 +281,16 @@ def latest_ckpt_summary(root: str | None = None) -> dict | None:
         ),
         "cells": dict(sorted(cell_verdicts.items())),
     }
+    # the degrade column (the degrade-and-continue round): which
+    # cells landed on continue-degraded, with their old -> new shard
+    # counts — bench provenance embeds these beside LINT/COMM
+    deg = report.get("degrade_cells")
+    if isinstance(deg, dict) and deg:
+        out["degrade_cells"] = {
+            str(k): v for k, v in sorted(deg.items())
+            if isinstance(v, dict)
+        }
+    return out
 
 
 def _git_dirty(root: str) -> bool | None:

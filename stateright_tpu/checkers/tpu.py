@@ -524,6 +524,47 @@ class TpuBfsChecker(Checker):
         #: of its exponential backoff (tests shrink it).
         self.max_fault_retries = 3
         self.retry_backoff_sec = 0.5
+        # -- degrade-and-continue policy (checkpoint.FailurePolicy) ------
+        #: allow the supervisor to drop a persistently-faulting shard
+        #: and re-shard the last snapshot onto the survivors (sharded
+        #: engines with checkpointing configured; CLI
+        #: ``--degrade-on-fault``).
+        self.degrade_on_fault = False
+        #: shard-attributed failures before a fault classifies as
+        #: persistent (checkpoint.FailurePolicy persist_threshold).
+        self.fault_persist_threshold = 2
+        # -- hung-dispatch watchdog (checkpoint.watchdog_deadline) -------
+        #: None = off. Set (CLI ``--watchdog[=factor]``) to run every
+        #: chunk dispatch+sync on a watchdog-supervised worker thread
+        #: under a deadline of clamp(factor x rolling max chunk wall)
+        #: — re-derived per chunk like the auto checkpoint cadence. A
+        #: breach emits ``watchdog_timeout`` with the run's latency
+        #: attribution and raises checkpoint.WatchdogTimeout (a
+        #: supervised ``hang``).
+        self.watchdog_factor = None
+        self.watchdog_floor_sec = 2.0
+        self.watchdog_cap_sec = 600.0
+        #: the first-chunk grace (no measured chunk wall yet): the
+        #: cold compile / persistent-cache disk fetch lands inside
+        #: chunk 0's dispatch (a 17.9 s retrieval measured in
+        #: TRACE_r21) and must never be misclassified as a hang.
+        self.watchdog_grace_sec = 300.0
+        #: rolling max chunk wall (NET of ledger-attributed build
+        #: time) the deadline derives from; reset per spawn, kept
+        #: across supervised retries (the walls are real either way).
+        self._wd_roll_max = None
+        # -- health layer (telemetry.detect_stragglers) ------------------
+        #: None = off. On traced sharded runs, a shard whose per-wave
+        #: work exceeds straggler_factor x the shard median emits a
+        #: ``shard_health`` event (CLI ``--straggler-factor``);
+        #: sustained stragglers feed the failure classifier as
+        #: pre-fault evidence.
+        self.straggler_factor = None
+        #: consecutive straggler waves before a shard counts as a
+        #: SUSTAINED straggler (classifier evidence).
+        self.straggler_sustain = 3
+        #: shard id -> consecutive straggler waves (live health state).
+        self._shard_health: dict = {}
         #: staged (manifest, buffers) from :meth:`resume_from`; the
         #: next ``_run_attempt`` builds its carry from these instead
         #: of the seed program.
@@ -904,6 +945,9 @@ class TpuBfsChecker(Checker):
         self.metrics = {}
         self.generated = None
         self._final_tables = None
+        # the fresh attempt starts with clean health state (the
+        # classifier already consumed the failed attempt's evidence)
+        self._shard_health = {}
 
     def _degrade_memory_lean(self) -> bool:
         """Supervisor hook after repeated OOMs: shrink towards a
@@ -912,6 +956,195 @@ class TpuBfsChecker(Checker):
         sort-merge engines shrink ``flat_budget_bytes``, flipping
         their big classes into CHUNKED mode."""
         return False
+
+    def _pre_run_check(self) -> None:
+        """Hook: configuration validation that must land BEFORE any
+        program build or device work. Base engine: nothing to check
+        (the sort-merge engines pre-check the tiered
+        frontier-headroom bound here)."""
+
+    # -- degrade-and-continue (checkpoint.FailurePolicy) -------------------
+
+    def _fault_shards(self):
+        """The live shard-id set the fault-injection hook filters
+        persistent ``shard_fault`` faults against (None = single-chip
+        / unfiltered). The sharded engines set ``_shard_ids`` at mesh
+        construction; a degrade removes the dropped shard, which is
+        exactly what makes the injected dead chip stop firing."""
+        return getattr(self, "_shard_ids", None)
+
+    def _can_degrade_shards(self) -> bool:
+        """Whether the supervisor may drop a shard from this run: a
+        mesh engine with more than one shard left. Both families
+        qualify — the sort-merge re-shard and the sharded-hash
+        re-insertion route both carry a snapshot to the new count."""
+        return (getattr(self, "mesh", None) is not None
+                and int(getattr(self, "n_shards", 1)) > 1)
+
+    def _degrade_shards(self, exclude_shard=None) -> None:
+        """Drop one shard from the mesh (the supervisor's elastic
+        degrade): rebuild the Mesh over the surviving devices and
+        invalidate everything keyed on the old layout — programs,
+        memory plan, carry PartitionSpecs. The next resume routes the
+        snapshot through the (owner, fp) re-shard because the layouts
+        now differ; counts are bit-exact by the PR 11 proof."""
+        from jax.sharding import Mesh
+
+        if not self._can_degrade_shards():
+            raise RuntimeError(
+                "shard degrade needs a mesh engine with > 1 shard"
+            )
+        devices = list(self.mesh.devices.reshape(-1))
+        ids = list(getattr(self, "_shard_ids",
+                           range(self.n_shards)))
+        if exclude_shard in ids:
+            keep = [(d, i) for d, i in zip(devices, ids)
+                    if i != exclude_shard]
+        else:
+            # no attributed shard: shed the last one (capacity loss
+            # is the same; the classifier had no better signal)
+            keep = list(zip(devices, ids))[:-1]
+        self.mesh = Mesh(
+            np.array([d for d, _ in keep]), ("shard",)
+        )
+        self.n_shards = len(keep)
+        self._shard_ids = tuple(i for _, i in keep)
+        self.total_capacity = self.capacity * self.n_shards
+        self._programs = None
+        self.memory_plan = None
+        self._carry_pspecs = None
+        self._shard_health = {}
+
+    # -- health layer (telemetry.detect_stragglers) ------------------------
+
+    def _sustained_stragglers(self) -> tuple:
+        """Shards the health layer currently holds as SUSTAINED
+        stragglers (consecutive straggler waves >= straggler_sustain)
+        — the pre-fault evidence checkpoint.classify_failure uses to
+        attribute an otherwise-anonymous transient fault. Reported in
+        ORIGINAL shard-id space (``_shard_ids``)."""
+        return tuple(
+            s for s, n in sorted(self._shard_health.items())
+            if n >= self.straggler_sustain
+        )
+
+    def _note_shard_health(self, srows, wave0: int) -> None:
+        """Feed one chunk's per-shard wave-log rows through the
+        straggler detector (telemetry.detect_stragglers): per wave, a
+        shard whose work exceeds ``straggler_factor`` x the shard
+        median emits a schema-validated ``shard_health`` event and
+        advances its consecutive-straggler count; a clean wave resets
+        it. No-op unless sharded + traced + straggler_factor set."""
+        factor = self.straggler_factor
+        if not factor or srows is None:
+            return
+        from .. import telemetry
+
+        ids = getattr(self, "_shard_ids", None) or tuple(
+            range(srows.shape[0])
+        )
+        n_waves = srows.shape[1]
+        for w in range(n_waves):
+            flagged = telemetry.detect_stragglers(
+                srows[:, w, :], factor
+            )
+            hit = {rec["shard"] for rec in flagged}
+            for pos in range(srows.shape[0]):
+                sid = ids[pos] if pos < len(ids) else pos
+                if pos in hit:
+                    self._shard_health[sid] = (
+                        self._shard_health.get(sid, 0) + 1
+                    )
+                else:
+                    self._shard_health[sid] = 0
+            for rec in flagged:
+                sid = (ids[rec["shard"]]
+                       if rec["shard"] < len(ids) else rec["shard"])
+                telemetry.emit(
+                    "shard_health",
+                    kind="straggler",
+                    shard=int(sid),
+                    wave=int(wave0 + w),
+                    factor=float(factor),
+                    value=int(rec["value"]),
+                    median=float(rec["median"]),
+                    ratio=round(float(rec["ratio"]), 4),
+                    sustained=int(self._shard_health.get(sid, 0)),
+                )
+
+    # -- hung-dispatch watchdog (checkpoint.watchdog_deadline) -------------
+
+    def _guarded_dispatch(self, thunk, chunk_no: int):
+        """Run one chunk's dispatch+sync, under the watchdog when
+        configured: the thunk executes on a daemon worker thread and
+        the host waits at most the derived deadline. A breach emits
+        ``watchdog_timeout`` with the run's full latency attribution
+        and raises checkpoint.WatchdogTimeout — the supervisor's
+        ``hang`` class. The hung thread is abandoned (XLA offers no
+        cancellation); an injected hang's sleeper finishes harmlessly,
+        a genuinely wedged runtime exhausts the retry budget and the
+        WatchdogTimeout raises through with the diagnosis."""
+        if not getattr(self, "watchdog_factor", None):
+            return thunk()
+        from .. import checkpoint as _ckpt
+        from .. import telemetry
+
+        deadline = _ckpt.watchdog_deadline(
+            self._wd_roll_max, self.watchdog_factor,
+            floor_sec=self.watchdog_floor_sec,
+            cap_sec=self.watchdog_cap_sec,
+            first_grace_sec=self.watchdog_grace_sec,
+        )
+        box: dict = {}
+        done = threading.Event()
+
+        def run():
+            try:
+                box["out"] = thunk()
+            except BaseException as exc:  # re-raised on the host side
+                box["exc"] = exc
+            finally:
+                done.set()
+
+        t0 = time.monotonic()
+        worker = threading.Thread(
+            target=run, daemon=True,
+            name=f"stpu-watchdog-chunk{chunk_no}",
+        )
+        worker.start()
+        if not done.wait(deadline):
+            att = dict(
+                chunk=int(chunk_no),
+                deadline_sec=round(deadline, 3),
+                rolling_max_chunk_sec=(
+                    None if self._wd_roll_max is None
+                    else round(self._wd_roll_max, 6)
+                ),
+                factor=float(self.watchdog_factor),
+                waited_sec=round(time.monotonic() - t0, 3),
+                latency=self.latency_accounting(),
+            )
+            telemetry.emit("watchdog_timeout", **att)
+            raise _ckpt.WatchdogTimeout(chunk_no, deadline, att)
+        if "exc" in box:
+            raise box["exc"]
+        return box["out"]
+
+    def _note_watchdog_wall(self, wall_sec: float,
+                            wd_snap) -> None:
+        """Feed one completed chunk's wall into the watchdog's
+        rolling max, NET of ledger-attributed build time (the monitor
+        delta across the chunk) so a one-off cold compile or disk
+        fetch never inflates — or, worse, becomes — the hang
+        baseline."""
+        if not getattr(self, "watchdog_factor", None):
+            return
+        net = wall_sec
+        if wd_snap is not None:
+            _, build_sec, _, stage_sec = _monitor_delta(wd_snap)
+            net = max(wall_sec - build_sec - stage_sec, 0.0)
+        if self._wd_roll_max is None or net > self._wd_roll_max:
+            self._wd_roll_max = net
 
     def _run_attempt(self, reporter: Optional[Reporter] = None) -> None:
         import jax.numpy as jnp
@@ -982,6 +1215,10 @@ class TpuBfsChecker(Checker):
             device_sec=0.0, fetch_min=None,
             t_start=time.monotonic(), t_first_sync=None,
         )
+        # config pre-checks that must land BEFORE any program build
+        # or device work (e.g. the tiered frontier-headroom bound —
+        # the sort-merge engines override)
+        self._pre_run_check()
         if self._programs is None:
             with telemetry.span("compile", engine=type(self).__name__):
                 self._programs = self._lookup_programs(n0)
@@ -1097,35 +1334,57 @@ class TpuBfsChecker(Checker):
                 break
             t0 = time.monotonic()
             chunk_snap = _monitor_snapshot() if ledger_pending else None
-            # Sharded engines return a third output when traced: the
-            # per-shard mesh wave log (telemetry.SHARD_LOG_FIELDS),
-            # sharded across devices — it rides the same dispatch and
-            # the same sync point as the packed stats.
-            out = chunk_fn(carry)
-            carry, stats = out[0], out[1]
-            shard_log = out[2] if len(out) > 2 else None
-            # fault-injection seam: a device error surfacing between
-            # the async dispatch and the stats readback (no-op with
-            # nothing armed — stateright_tpu/faultinject.py)
-            faultinject.fire("mid_chunk", chunk_no)
-            t_disp = time.monotonic()  # async dispatch returns here
+            wd_snap = (_monitor_snapshot()
+                       if getattr(self, "watchdog_factor", None)
+                       else None)
+
+            def exec_chunk(carry=carry, chunk_no=chunk_no):
+                # fault-injection seam: a device error surfacing
+                # from the mesh collective path (mesh engines only;
+                # no-op with nothing armed)
+                if getattr(self, "mesh", None) is not None:
+                    faultinject.fire("collective_seam", chunk_no,
+                                     shards=self._fault_shards())
+                # Sharded engines return a third output when traced:
+                # the per-shard mesh wave log
+                # (telemetry.SHARD_LOG_FIELDS), sharded across
+                # devices — it rides the same dispatch and the same
+                # sync point as the packed stats.
+                out = chunk_fn(carry)
+                c_out, stats = out[0], out[1]
+                slog = out[2] if len(out) > 2 else None
+                # fault-injection seam: a device error surfacing
+                # between the async dispatch and the stats readback
+                # (no-op with nothing armed — faultinject.py)
+                faultinject.fire("mid_chunk", chunk_no,
+                                 shards=self._fault_shards())
+                td = time.monotonic()  # async dispatch returns here
+                t_dv = td
+                dsec = None
+                if deep:
+                    # The deep level's extra sync: block on the carry
+                    # so the device compute and the stats fetch split
+                    # apart.
+                    import jax
+
+                    jax.block_until_ready(c_out)
+                    t_dv = time.monotonic()
+                    dsec = t_dv - td
+                s_np = np.asarray(stats)  # the chunk's one readback
+                return c_out, s_np, slog, td, t_dv, dsec
+
+            # the whole dispatch+sync runs under the hung-dispatch
+            # watchdog when configured (worker thread + derived
+            # deadline); a plain inline call otherwise
+            carry, s, shard_log, t_disp, t_dev, dev_sec = \
+                self._guarded_dispatch(exec_chunk, chunk_no)
+            t1 = time.monotonic()
             if chunk_snap is not None:
                 # the chunk program's compile-or-fetch is synchronous
                 # inside the first dispatch call — attribute it now
                 self._emit_program_build("chunk", chunk_snap)
                 ledger_pending = False
-            t_dev = t_disp
-            dev_sec = None
-            if deep:
-                # The deep level's extra sync: block on the carry so
-                # the device compute and the stats fetch split apart.
-                import jax
-
-                jax.block_until_ready(carry)
-                t_dev = time.monotonic()
-                dev_sec = t_dev - t_disp
-            s = np.asarray(stats)  # the chunk's one readback
-            t1 = time.monotonic()
+            self._note_watchdog_wall(t1 - t0, wd_snap)
             lat = self._lat
             lat["chunks"] += 1
             lat["dispatch_sec"] += t_disp - t0
@@ -1150,6 +1409,12 @@ class TpuBfsChecker(Checker):
                 n_waves = waves_now - prev_waves
                 rows = self._wave_log_rows(s, n_props)
                 srows = self._shard_log_rows(shard_log)
+                # health layer: straggler detection over this chunk's
+                # per-shard wave-log rows (no-op unless configured)
+                self._note_shard_health(
+                    None if srows is None else srows[:, :n_waves],
+                    prev_waves,
+                )
                 tracer.record_chunk(
                     chunk=chunk_idx,
                     wave0=prev_waves,
@@ -1263,7 +1528,8 @@ class TpuBfsChecker(Checker):
             # fault-injection seam: the chunk boundary — AFTER the
             # snapshot write, so an injected kill here proves the
             # committed-snapshot sequencing a real preemption sees
-            faultinject.fire("chunk_boundary", chunk_no)
+            faultinject.fire("chunk_boundary", chunk_no,
+                             shards=self._fault_shards())
             chunk_no += 1
             if not done:
                 self._maybe_warn_occupancy(self.metrics["occupancy"])

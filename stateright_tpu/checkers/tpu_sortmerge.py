@@ -147,6 +147,7 @@ from ..ops.merge import (
 from ..ops.u64 import U64, u64_add
 from .tpu import (
     TpuBfsChecker,
+    _monitor_snapshot,
     discovery_update,
     expand_frontier,
     frontier_props_t,
@@ -523,6 +524,15 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         self.tier_hot_rows = tier_hot_rows
         self.tier_budget_bytes = tier_budget_bytes
         self.tier_max_runs = tier_max_runs
+        #: tiered-mode frontier-headroom pre-check policy
+        #: (memplan.tier_frontier_headroom, checked BEFORE device
+        #: work): "warn" — surface the PR 12 known bound up front
+        #: (default; the old behavior surfaced it only as a mid-run
+        #: f_overflow message), "bump" — raise frontier_capacity to
+        #: the provable bound before programs build, "refuse" —
+        #: raise instead of risking a mid-run overflow.
+        self.tier_headroom_policy = "warn"
+        self._tier_headroom_checked = False
         #: the live ColdStore while a tiered run is in flight, and
         #: the resume-staged tier state (checkpoint.resume_from)
         self._tier_state = None
@@ -843,6 +853,61 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 "ceiling and the capacity, or lower tier_hot_rows)"
             )
         return msg
+
+    def _pre_run_check(self) -> None:
+        """The tiered frontier-headroom bound, pre-checked BEFORE any
+        program build or device work (memplan.tier_frontier_headroom
+        — the PR 12 known bound, which used to surface only as a
+        mid-run f_overflow message): refuse, auto-bump the frontier
+        to the provable bound, or warn up front, per
+        ``tier_headroom_policy``."""
+        if self.tier_hot_rows is None or self._tier_headroom_checked:
+            return
+        self._tier_headroom_checked = True
+        from ..memplan import tier_frontier_headroom
+
+        cand = self.cand_capacity
+        if cand is None:
+            # no compaction: the true static bound on a wave's
+            # candidates (and therefore provisional winners) is F x K
+            cand = self.frontier_capacity * self.encoded.max_actions
+        chk = tier_frontier_headroom(
+            self.capacity, self.frontier_capacity, cand
+        )
+        if chk["holds"] is not False:
+            # True = provable; None = still-unresolved auto budget
+            # (nothing provable or refutable before it lands)
+            return
+        policy = getattr(self, "tier_headroom_policy", "warn")
+        if policy == "refuse":
+            raise ValueError(
+                "tiered frontier-headroom pre-check refused "
+                "(tier_headroom_policy='refuse'): " + chk["message"]
+            )
+        import warnings
+
+        if policy == "bump" and chk["required_frontier"]:
+            old = self.frontier_capacity
+            bumped = int(chk["required_frontier"])
+            if self.tiles > 1 and bumped % self.tiles:
+                bumped = (
+                    (bumped + self.tiles - 1) // self.tiles
+                ) * self.tiles
+            self.frontier_capacity = bumped
+            self._programs = None
+            self.memory_plan = None
+            warnings.warn(
+                "tiered frontier-headroom pre-check: "
+                f"frontier_capacity {old} -> {bumped} "
+                "(tier_headroom_policy='bump' — provisional winners "
+                "are bounded by cand_capacity="
+                f"{self.cand_capacity}, so the bumped frontier makes "
+                "the bound provable before device work)",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+            return
+        warnings.warn(chk["message"], RuntimeWarning, stacklevel=3)
 
     def _tier_ceiling(self):
         """The hot-tier ladder ceiling in visited rows (None = tier
@@ -1199,13 +1264,30 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 return carry, s
             t0 = _time.monotonic()
             keep_dev = self._tier_mask_dev(mask_np)
-            out = tier_fn(carry, keep_dev)
-            carry, stats = out[0], out[1]
-            shard_log = out[2] if len(out) > 2 else None
-            faultinject.fire("mid_chunk", chunk_no)
-            t_disp = _time.monotonic()
-            s = np.asarray(stats)
+            wd_snap = (_monitor_snapshot()
+                       if getattr(self, "watchdog_factor", None)
+                       else None)
+
+            def exec_chunk(carry=carry, keep_dev=keep_dev,
+                           chunk_no=chunk_no):
+                if getattr(self, "mesh", None) is not None:
+                    faultinject.fire("collective_seam", chunk_no,
+                                     shards=self._fault_shards())
+                out = tier_fn(carry, keep_dev)
+                c_out, stats = out[0], out[1]
+                slog = out[2] if len(out) > 2 else None
+                faultinject.fire("mid_chunk", chunk_no,
+                                 shards=self._fault_shards())
+                td = _time.monotonic()
+                return c_out, np.asarray(stats), slog, td
+
+            # the tiered dispatch+sync runs under the same
+            # hung-dispatch watchdog as the untiered chunk loop
+            carry, s, shard_log, t_disp = self._guarded_dispatch(
+                exec_chunk, chunk_no
+            )
             t1 = _time.monotonic()
+            self._note_watchdog_wall(t1 - t0, wd_snap)
             lat["chunks"] += 1
             lat["dispatch_sec"] += t_disp - t0
             fetch = t1 - t_disp
@@ -1233,6 +1315,12 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                         s[off:off + WL]
                     ).reshape(1, WL)
                 srows = self._tier_shard_rows(shard_log)
+                # health layer: straggler detection (no-op unless
+                # sharded + straggler_factor configured)
+                self._note_shard_health(
+                    None if srows is None else srows[:, :n_waves],
+                    prev_waves,
+                )
                 tracer.record_chunk(
                     chunk=chunk_idx,
                     wave0=prev_waves,
@@ -1353,7 +1441,8 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 self._note_snapshot_wall(
                     _time.monotonic() - t_ck, t1 - t0
                 )
-            faultinject.fire("chunk_boundary", chunk_no)
+            faultinject.fire("chunk_boundary", chunk_no,
+                             shards=self._fault_shards())
             chunk_no += 1
             if reporter is not None:
                 reporter.report_checking(

@@ -55,12 +55,26 @@ answers.
 
 **Supervision** (:func:`supervised_run`): engines route ``_run``
 through here. With checkpointing configured, a failed chunk — device
-error, injected fault, OOM — retries from the last snapshot with
-bounded exponential backoff instead of dying; repeated OOMs degrade
-the sort-merge engines to their CHUNKED memory-lean classes
-(``_degrade_memory_lean``) before the next attempt. Engine overflow
-errors are NOT supervised: the auto-budget retry (tpu_sortmerge.py)
-owns those, one layer out.
+error, injected fault, OOM, watchdog hang — retries from the last
+snapshot with bounded exponential backoff instead of dying; repeated
+OOMs degrade the sort-merge engines to their CHUNKED memory-lean
+classes (``_degrade_memory_lean``) before the next attempt. Engine
+overflow errors are NOT supervised: the auto-budget retry
+(tpu_sortmerge.py) owns those, one layer out.
+
+**Degrade-and-continue** (the round-17 policy layer): every
+supervised failure is CLASSIFIED by a :class:`FailurePolicy`
+(:func:`classify_failure` — transient / oom / hang / shard_fault,
+from the exception and the health layer's straggler evidence), and a
+fault that persists on the same shard escalates — under
+``degrade_on_fault`` — to an automatic elastic degrade: the shard is
+dropped from the mesh and the last snapshot re-shards onto the
+survivors through the same (owner, fp) seam, so a dead chip costs
+capacity, not the run. :func:`watchdog_deadline` derives the
+hung-dispatch watchdog's per-chunk deadline (checkers/tpu.py) from
+the run's own measured chunk walls; a breach is a supervised
+``hang`` that recovers from the snapshot or — when the runtime
+can't be cancelled — refuses loudly with the latency attribution.
 """
 
 from __future__ import annotations
@@ -457,20 +471,34 @@ def resume_from(checker, path: str, *,
             checker, manifest, buffers, tier_m
         )
     if not same_layout:
-        if family != "sortmerge":
+        if family == "sortmerge":
+            buffers = reshard_sortmerge(
+                manifest, buffers, checker, visited_counts=hot_src
+            )
+        elif (family == "hash"
+                and manifest.get("kind") == "sharded"
+                and _engine_kind(checker) == "sharded"):
+            # sharded-hash -> sharded-hash: the per-shard tables
+            # rebuild host-side by re-INSERTION of the snapshot's key
+            # set through the same (owner, fp) route the sort-merge
+            # re-shard uses (the degrade path needs this so the hash
+            # family can drop a shard too).
+            buffers = reshard_hash(manifest, buffers, checker)
+        else:
             raise SnapshotIncompatibleError(
                 f"{path}: shard/capacity re-layout (snapshot "
                 f"S={manifest.get('n_shards')} "
+                f"kind={manifest.get('kind')} "
                 f"C={manifest.get('capacity')}, target "
                 f"S={getattr(checker, 'n_shards', 1)} "
+                f"kind={_engine_kind(checker)} "
                 f"C={checker.capacity}) is supported on the "
-                "sort-merge family only — an open-addressed hash "
-                "table re-shards by re-insertion, which this engine "
-                "does not implement; resume on the original layout"
+                "sort-merge family (all directions) and on "
+                "sharded-hash -> sharded-hash only — the hash "
+                "family's single-chip ⇄ sharded conversions are "
+                "not implemented; resume on the original kind, or "
+                "use the sort-merge family for fully elastic layouts"
             )
-        buffers = reshard_sortmerge(
-            manifest, buffers, checker, visited_counts=hot_src
-        )
     if tier_m:
         buffers = _route_tier_target(
             checker, path, manifest, buffers, cold_src, hot_src,
@@ -931,6 +959,132 @@ def reshard_sortmerge(manifest: dict, buffers: dict,
     return out
 
 
+def reshard_hash(manifest: dict, buffers: dict, checker) -> dict:
+    """The hash-family elastic re-shard (sharded -> sharded only):
+    rebuild the per-shard open-addressed tables host-side by
+    re-INSERTING the snapshot's occupied key set through the same
+    (owner, fp) route the mesh wave uses — owner = ``fp_lo % S_new``,
+    insertion via the numpy path of :func:`ops.hashset.insert`, which
+    retraces the exact triangular probe sequence the device insert
+    compiled, so the rebuilt tables are ones the device could have
+    built itself. Parent-forest entries (slot-indexed side tables)
+    move with their keys to the new slots; frontier rows re-route by
+    their fingerprints. Refuses loudly BEFORE device work when the
+    target tables can't absorb the keys (probe exhaustion at the
+    target capacity) or a shard's frontier share overflows."""
+    from .ops.fingerprint import fingerprint_u32v
+    from .ops.hashset import DeviceHashSet, insert
+
+    W = int(manifest["width"])
+    track_paths = bool(manifest["track_paths"])
+    S_a = int(manifest.get("n_shards", 1))
+    C_a = int(manifest["capacity"])
+    F_a = int(manifest["frontier_capacity"])
+    S_b = int(getattr(checker, "n_shards", 1))
+    C_b = int(checker.capacity)
+    F_b = int(checker.frontier_capacity)
+
+    t_lo = buffers["t_lo"].reshape(S_a * C_a)
+    t_hi = buffers["t_hi"].reshape(S_a * C_a)
+    occupied = (t_lo != 0) | (t_hi != 0)
+    keys_lo = t_lo[occupied]
+    keys_hi = t_hi[occupied]
+    if track_paths:
+        par_lo = buffers["p_lo_t"].reshape(S_a * C_a)[occupied]
+        par_hi = buffers["p_hi_t"].reshape(S_a * C_a)[occupied]
+
+    key_owner = (keys_lo % np.uint32(max(S_b, 1))).astype(np.int64)
+    t_lo_t = np.zeros(S_b * C_b, np.uint32)
+    t_hi_t = np.zeros(S_b * C_b, np.uint32)
+    p_lo_t = np.zeros(S_b * C_b if track_paths else 0, np.uint32)
+    p_hi_t = np.zeros(S_b * C_b if track_paths else 0, np.uint32)
+    for d in range(S_b):
+        sel = key_owner == d
+        kl, kh = keys_lo[sel], keys_hi[sel]
+        if kl.size > C_b:
+            raise SnapshotIncompatibleError(
+                f"hash re-shard: shard {d} of {S_b} would own "
+                f"{kl.size:,} visited keys but per-shard capacity is "
+                f"{C_b:,} — raise the target capacity"
+            )
+        table = DeviceHashSet.empty(C_b, np)
+        table, _, ovf, slots = insert(
+            table, kl, kh, np.ones(kl.size, bool), np,
+            rounds=int(checker.probe_rounds),
+        )
+        if bool(np.any(ovf)):
+            raise SnapshotIncompatibleError(
+                f"hash re-shard: shard {d} of {S_b} exhausted "
+                f"{checker.probe_rounds} probe rounds re-inserting "
+                f"{kl.size:,} keys at capacity {C_b:,} — raise the "
+                "target capacity or probe_rounds"
+            )
+        base = d * C_b
+        t_lo_t[base:base + C_b] = table.lo
+        t_hi_t[base:base + C_b] = table.hi
+        if track_paths:
+            p_lo_t[base + slots.astype(np.int64)] = par_lo[sel]
+            p_hi_t[base + slots.astype(np.int64)] = par_hi[sel]
+
+    # frontier rows re-route by their own fingerprints (dense [F, W]
+    # row-major blocks on the hash family), deterministically ordered
+    # per shard so the re-shard stays bit-reproducible
+    frontier = buffers["frontier"].reshape(S_a * F_a, W)
+    fval = buffers["fval"].reshape(S_a * F_a).astype(bool)
+    ebits = buffers["ebits"].reshape(S_a * F_a)
+    rows = frontier[fval]
+    eb = ebits[fval]
+    fr_lo, fr_hi = fingerprint_u32v(rows, np)
+    fr_owner = (fr_lo % np.uint32(max(S_b, 1))).astype(np.int64)
+    frontier_t = np.zeros((S_b * F_b, W), np.uint32)
+    fval_t = np.zeros(S_b * F_b, bool)
+    ebits_t = np.zeros(S_b * F_b, np.uint32)
+    for d in range(S_b):
+        fsel = fr_owner == d
+        n_d = int(fsel.sum())
+        if n_d > F_b:
+            raise SnapshotIncompatibleError(
+                f"hash re-shard: shard {d} of {S_b} would own "
+                f"{n_d:,} frontier rows but frontier_capacity is "
+                f"{F_b:,} — raise the target frontier_capacity"
+            )
+        forder = np.lexsort((fr_lo[fsel], fr_hi[fsel]))
+        base = d * F_b
+        frontier_t[base:base + n_d] = rows[fsel][forder]
+        ebits_t[base:base + n_d] = eb[fsel][forder]
+        fval_t[base:base + n_d] = True
+
+    def src(name, default):
+        b = buffers.get(name)
+        return np.array(b) if b is not None else default
+
+    return dict(
+        t_lo=t_lo_t,
+        t_hi=t_hi_t,
+        p_lo_t=p_lo_t,
+        p_hi_t=p_hi_t,
+        frontier=frontier_t,
+        fval=fval_t,
+        ebits=ebits_t,
+        depth=np.int32(buffers["depth"]),
+        wchunk=np.int32(0),
+        waves=np.uint32(buffers["waves"]),
+        gen_lo=np.uint32(buffers["gen_lo"]),
+        gen_hi=np.uint32(buffers["gen_hi"]),
+        new=np.uint32(buffers["new"]),
+        sent_lo=src("sent_lo", np.uint32(0)),
+        sent_hi=src("sent_hi", np.uint32(0)),
+        disc_found=np.array(buffers["disc_found"], bool),
+        disc_lo=np.uint32(buffers["disc_lo"]),
+        disc_hi=np.uint32(buffers["disc_hi"]),
+        overflow=np.bool_(buffers["overflow"]),
+        f_overflow=np.bool_(buffers["f_overflow"]),
+        c_overflow=np.bool_(buffers["c_overflow"]),
+        e_overflow=np.bool_(buffers["e_overflow"]),
+        done=np.bool_(buffers["done"]),
+    )
+
+
 def build_resume_carry(checker, manifest: dict, buffers: dict,
                        spec: dict) -> dict:
     """Assemble the initial device carry for a resumed run from staged
@@ -997,6 +1151,159 @@ def build_resume_carry(checker, manifest: dict, buffers: dict,
     return {k: jnp.copy(jnp.asarray(v)) for k, v in carry_np.items()}
 
 
+# -- failure policy (the degrade-and-continue round) ----------------------
+#
+# PR 11's supervisor could only retry the same layout or refuse; this
+# layer closes the loop ROADMAP direction 1 needs for multi-hour mesh
+# runs, where the failure model is "a shard dies, a collective wedges,
+# a dispatch hangs forever" (the worker-loss-as-first-class-event
+# framing of arXiv:1203.6806 and arXiv:0901.0179): every supervised
+# failure is CLASSIFIED (transient / persistent per-shard / OOM /
+# hang) from the exception and the run's own health signals, and a
+# fault that persists on the same shard across the bounded-backoff
+# retries escalates to an automatic elastic degrade — the last
+# snapshot re-shards onto the surviving shard count through the exact
+# (owner, fp) seam PR 11 proved, cold tier and drained parent log
+# included, so the degraded run reproduces bit-exact counts.
+
+#: what a supervised failure classifies as (FailurePolicy.classify).
+FAILURE_CLASSES = ("transient", "oom", "hang", "shard_fault",
+                   "unsupervised")
+
+
+class WatchdogTimeout(RuntimeError):
+    """A chunk dispatch/sync exceeded its derived watchdog deadline
+    (checkers/tpu.py ``watchdog_factor``) — the hung-dispatch shape of
+    the bisected XLA:CPU thunk-runtime livelock family (ROADMAP
+    §carried), which no exception path ever surfaces. Supervised: the
+    policy classifies it ``hang`` and retries from the last snapshot
+    where checkpointing allows (CPython cannot cancel a wedged XLA
+    sync — the hung worker thread is abandoned as a daemon — so
+    in-process recovery re-dispatches and a genuinely wedged runtime
+    exhausts the retry budget and raises this error through:
+    refuse-loudly-with-diagnosis, the contract). ``attribution``
+    carries the run's full latency split at the breach."""
+
+    def __init__(self, chunk: int, deadline_sec: float,
+                 attribution: Optional[dict] = None):
+        super().__init__(
+            f"watchdog: chunk {chunk} exceeded its derived deadline "
+            f"of {deadline_sec:.2f}s with no sync — a hung dispatch "
+            "(the thunk-runtime livelock shape). The dispatch thread "
+            "is abandoned (XLA offers no cancellation); recover from "
+            "the last snapshot or investigate the attribution."
+        )
+        self.chunk = int(chunk)
+        self.deadline_sec = float(deadline_sec)
+        self.attribution = attribution or {}
+
+
+def watchdog_deadline(rolling_max_sec: Optional[float],
+                      factor: float = 8.0, *,
+                      floor_sec: float = 2.0,
+                      cap_sec: float = 600.0,
+                      first_grace_sec: float = 300.0) -> float:
+    """The per-chunk watchdog deadline, re-derived per chunk from the
+    run's OWN measured chunk walls (the auto_cadence pattern):
+    ``clamp(factor x rolling max chunk wall)`` to ``[floor, cap]``.
+    A run with no measured wall yet (chunk 0, where the lazy jit
+    compile or a persistent-cache disk fetch lands inside the first
+    dispatch — a 17.9 s retrieval was measured in TRACE_r21) gets
+    ``first_grace_sec`` instead, so a cold compile is never
+    misclassified as a hang; the engine additionally feeds the roll
+    chunk walls NET of ledger-attributed build time for the same
+    reason."""
+    if not factor or factor <= 0:
+        raise ValueError(f"watchdog factor must be > 0: {factor}")
+    if rolling_max_sec is None:
+        # None means UNMEASURED (chunk 0); a measured-but-tiny wall
+        # (e.g. fully attributed to a compile fetch) is a real
+        # measurement and gets the floor, not the grace — otherwise a
+        # fast first chunk would re-grant the grace forever
+        return float(first_grace_sec)
+    return float(min(
+        cap_sec, max(floor_sec, factor * max(rolling_max_sec, 0.0))
+    ))
+
+
+def classify_failure(exc: BaseException,
+                     straggler_shards=()) -> tuple:
+    """``(class, shard | None)`` for one supervised failure — the
+    classification table FailurePolicy keys escalation on:
+
+    * :class:`WatchdogTimeout` -> ``hang`` (never shard-attributed:
+      a wedged sync has no shard signal);
+    * an OOM-shaped error -> ``oom`` (the memory-lean degrade path);
+    * :class:`~stateright_tpu.faultinject.InjectedShardFault` ->
+      ``shard_fault`` with its shard id — the persistent per-shard
+      class real per-chip ECC/interconnect faults land in;
+    * any other supervised fault -> ``transient``, attributed to a
+      shard only when the health layer's sustained-straggler evidence
+      names exactly ONE suspect (an ambiguous signal attributes
+      nothing — degrading the wrong shard helps nobody);
+    * everything else -> ``unsupervised`` (the supervisor re-raises
+      before classification normally; this row exists for the policy
+      unit tests)."""
+    from .faultinject import InjectedShardFault
+
+    if isinstance(exc, WatchdogTimeout):
+        return "hang", None
+    if isinstance(exc, InjectedShardFault):
+        return "shard_fault", exc.shard
+    if _is_oom(exc):
+        return "oom", None
+    if is_supervised_fault(exc):
+        shard = (int(straggler_shards[0])
+                 if len(straggler_shards) == 1 else None)
+        return "transient", shard
+    return "unsupervised", None
+
+
+class FailurePolicy:
+    """Per-run failure bookkeeping for the supervisor: classify each
+    failure, count shard-attributed strikes, and decide when a fault
+    is PERSISTENT — the same shard failing ``persist_threshold``
+    times — at which point :func:`supervised_run` escalates from
+    retry-same-layout to an automatic elastic degrade onto the
+    surviving shards."""
+
+    def __init__(self, persist_threshold: int = 2):
+        if persist_threshold < 1:
+            raise ValueError(
+                f"persist_threshold must be >= 1: {persist_threshold}"
+            )
+        self.persist_threshold = int(persist_threshold)
+        #: (class, shard) per classified failure, in order.
+        self.history: list[tuple] = []
+        #: shard id -> consecutive attributed failures.
+        self.strikes: dict[int, int] = {}
+
+    def classify(self, exc: BaseException,
+                 straggler_shards=()) -> tuple:
+        """Classify AND record one failure. A shard-attributed
+        failure strikes its shard; a failure attributed to no shard
+        resets nothing (evidence about one shard is not evidence the
+        others recovered)."""
+        kind, shard = classify_failure(exc, straggler_shards)
+        self.history.append((kind, shard))
+        if shard is not None:
+            self.strikes[shard] = self.strikes.get(shard, 0) + 1
+        return kind, shard
+
+    def should_degrade(self) -> Optional[int]:
+        """The shard to drop (most strikes first), or None while no
+        shard has reached the persistence threshold."""
+        over = [(n, s) for s, n in self.strikes.items()
+                if n >= self.persist_threshold]
+        if not over:
+            return None
+        return max(over)[1]
+
+    def degraded(self, shard: int) -> None:
+        """The run dropped this shard — its strikes go with it."""
+        self.strikes.pop(shard, None)
+
+
 # -- supervision ----------------------------------------------------------
 
 
@@ -1009,7 +1316,7 @@ def is_supervised_fault(exc: BaseException) -> bool:
     would loop."""
     from .faultinject import InjectedFault
 
-    if isinstance(exc, (InjectedFault, MemoryError)):
+    if isinstance(exc, (InjectedFault, MemoryError, WatchdogTimeout)):
         return True
     name = type(exc).__name__
     if name in ("XlaRuntimeError", "JaxRuntimeError", "InternalError"):
@@ -1025,17 +1332,71 @@ def _is_oom(exc: BaseException) -> bool:
     return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s
 
 
-def supervised_run(checker, reporter=None) -> None:
-    """The retry loop around one engine run (``checker._run`` routes
-    here): a supervised fault with checkpointing configured retries
-    from the last snapshot (or from the seed when the fault landed
-    before the first snapshot) with bounded exponential backoff;
-    after two OOM-classified failures the engine degrades to its
-    CHUNKED memory-lean classes before the next attempt. Unsupervised
-    errors — and supervised ones past ``max_fault_retries`` — raise
-    through unchanged."""
+def _interruptible_backoff(delay: float, checker) -> None:
+    """The supervisor's backoff sleep, in small slices so a cancel
+    event (the hybrid racer) ends it early — and with the trace run
+    bracket CLOSED on KeyboardInterrupt: a ^C mid-backoff used to die
+    mid-sleep with the run_begin left dangling (the checker's
+    ``_ensure_run`` catches ``Exception`` only, so the BaseException
+    escaped without a run_end), leaving the partial trace unreadable
+    by the run-aligned tools."""
     from . import telemetry
 
+    deadline = time.monotonic() + delay
+    try:
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            ev = getattr(checker, "cancel_event", None)
+            if ev is not None and ev.is_set():
+                return
+            time.sleep(min(remaining, 0.05))
+    except KeyboardInterrupt:
+        tracer = telemetry.current_tracer()
+        if tracer is not None:
+            tracer.end_run(
+                error="KeyboardInterrupt: interrupted during "
+                "supervised backoff"
+            )
+        raise
+
+
+def supervised_run(checker, reporter=None) -> None:
+    """The retry loop around one engine run (``checker._run`` routes
+    here) — since the degrade-and-continue round, a POLICY ENGINE:
+    every supervised fault (device error, injected fault, OOM,
+    watchdog hang) is classified by a :class:`FailurePolicy` from the
+    exception and the health layer's straggler evidence, then
+
+    * **retried** from the last snapshot (or the seed when the fault
+      landed before the first snapshot) with bounded exponential
+      backoff — the PR 11 behavior, now the ``transient`` class;
+    * after two ``oom``-classified failures the engine degrades to
+      its CHUNKED memory-lean classes before the next attempt;
+    * a fault that PERSISTS on the same shard across retries (the
+      ``shard_fault`` class, or ``transient`` faults the straggler
+      evidence attributes) escalates — when ``degrade_on_fault`` is
+      set on a multi-shard engine — to an automatic ELASTIC DEGRADE:
+      the faulted shard is dropped from the mesh and the last
+      snapshot re-shards onto the survivors through the (owner, fp)
+      seam (cold tier runs and the drained parent log carry through
+      resume_from's existing paths), recorded as a ``fault_degrade``
+      event. The degraded run continues to bit-exact counts;
+    * a ``hang`` (WatchdogTimeout) retries from the snapshot like a
+      device error — a genuinely wedged runtime exhausts the retry
+      budget and the WatchdogTimeout raises through with its latency
+      attribution: refuse-loudly-with-diagnosis.
+
+    Unsupervised errors — and supervised ones past
+    ``max_fault_retries`` — raise through unchanged."""
+    from . import telemetry
+
+    policy = FailurePolicy(
+        persist_threshold=getattr(
+            checker, "fault_persist_threshold", 2
+        )
+    )
     attempts = 0
     ooms = 0
     while True:
@@ -1044,6 +1405,9 @@ def supervised_run(checker, reporter=None) -> None:
         except Exception as exc:
             if not is_supervised_fault(exc):
                 raise
+            kind, shard = policy.classify(
+                exc, straggler_shards=checker._sustained_stragglers()
+            )
             snap = (getattr(checker, "_last_snapshot", None)
                     or getattr(checker, "_resume_path", None))
             retries = getattr(checker, "max_fault_retries", 3)
@@ -1051,17 +1415,26 @@ def supervised_run(checker, reporter=None) -> None:
                     or attempts >= retries:
                 raise
             attempts += 1
-            oom = _is_oom(exc)
-            if oom:
+            if kind == "oom":
                 ooms += 1
+            victim = None
+            if (getattr(checker, "degrade_on_fault", False)
+                    and checker._can_degrade_shards()):
+                victim = policy.should_degrade()
             delay = min(
                 getattr(checker, "retry_backoff_sec", 0.5)
                 * (2 ** (attempts - 1)),
                 30.0,
             )
             warnings.warn(
-                f"supervised recovery: {type(exc).__name__} on chunk "
-                f"execution ({exc}); retry {attempts}/{retries} from "
+                f"supervised recovery [{kind}"
+                + (f", shard {shard}" if shard is not None else "")
+                + f"]: {type(exc).__name__} on chunk execution "
+                f"({exc}); "
+                + (f"DEGRADING: dropping shard {victim} "
+                   f"({checker.n_shards} -> {checker.n_shards - 1} "
+                   "shards) and " if victim is not None else "")
+                + f"retry {attempts}/{retries} from "
                 + (f"snapshot {os.path.basename(snap)}" if snap
                    else "the seed")
                 + f" after {delay:.2f}s backoff",
@@ -1074,14 +1447,21 @@ def supervised_run(checker, reporter=None) -> None:
                 error=f"{type(exc).__name__}: {exc}",
                 snapshot=(os.path.basename(snap) if snap else None),
                 backoff_sec=round(delay, 3),
-                oom=oom,
+                oom=(kind == "oom"),
+                failure_class=kind,
+                shard=shard,
             )
             if ooms >= 2:
                 checker._degrade_memory_lean()
-            time.sleep(delay)
+            _interruptible_backoff(delay, checker)
             checker._reset_for_resume()
+            old_shards = int(getattr(checker, "n_shards", 1))
+            if victim is not None:
+                checker._degrade_shards(exclude_shard=victim)
+                policy.degraded(victim)
+            manifest = None
             if snap is not None:
-                resume_from(
+                manifest = resume_from(
                     checker, snap,
                     # the caller's staleness policy carries over: a
                     # run started with allow_sha_mismatch must not
@@ -1089,4 +1469,18 @@ def supervised_run(checker, reporter=None) -> None:
                     allow_sha_mismatch=getattr(
                         checker, "_resume_allow_sha", False
                     ),
+                )
+            if victim is not None:
+                telemetry.emit(
+                    "fault_degrade",
+                    from_shards=old_shards,
+                    to_shards=int(checker.n_shards),
+                    excluded_shard=int(victim),
+                    reason=kind,
+                    wave=(int(manifest["wave"])
+                          if manifest is not None else 0),
+                    rerouted_rows=(int(manifest["unique"])
+                                   if manifest is not None else 0),
+                    snapshot=(os.path.basename(snap) if snap
+                              else None),
                 )

@@ -57,6 +57,7 @@ def _network(args: list[str], index: int) -> Network:
 _RUNTIME: dict = dict(
     checkpoint_every=None, checkpoint_path=None, resume=False,
     resume_any_sha=False, waves_per_sync=None, tier_hot_rows=None,
+    degrade_on_fault=False, watchdog=None, straggler_factor=None,
 )
 
 
@@ -66,14 +67,27 @@ def _apply_runtime(checker) -> None:
     the chunk loop, which host checkers don't have."""
     cfg = _RUNTIME
     if not (cfg["checkpoint_every"] or cfg["resume"]
-            or cfg["waves_per_sync"] or cfg["tier_hot_rows"]):
+            or cfg["waves_per_sync"] or cfg["tier_hot_rows"]
+            or cfg["degrade_on_fault"] or cfg["watchdog"]
+            or cfg["straggler_factor"]):
         return
     if not hasattr(checker, "_run_attempt"):
         raise SystemExit(
             "--checkpoint-every/--resume/--waves-per-sync/"
-            "--tier-hot-rows need a device engine: use a check-tpu "
-            "lane"
+            "--tier-hot-rows/--degrade-on-fault/--watchdog/"
+            "--straggler-factor need a device engine: use a "
+            "check-tpu lane"
         )
+    if cfg["degrade_on_fault"]:
+        # the degrade path needs a snapshot to re-shard; it engages
+        # only on multi-shard engines (single-chip has nothing to
+        # drop), but configuring it there is harmless — the policy
+        # gate is _can_degrade_shards
+        checker.degrade_on_fault = True
+    if cfg["watchdog"]:
+        checker.watchdog_factor = float(cfg["watchdog"])
+    if cfg["straggler_factor"]:
+        checker.straggler_factor = float(cfg["straggler_factor"])
     if cfg["tier_hot_rows"]:
         if not hasattr(checker, "tier_hot_rows"):
             raise SystemExit(
@@ -499,6 +513,19 @@ def _usage(model: str | None = None) -> None:
         "projection decides the split) — reachability bounded by "
         "host memory, not HBM"
     )
+    print(
+        "       --degrade-on-fault on check-tpu lanes lets the "
+        "supervisor DROP a persistently-faulting shard and re-shard "
+        "the last snapshot onto the survivors (degrade-and-continue,"
+        " checkpoint.FailurePolicy); --watchdog[=factor] arms the "
+        "hung-dispatch watchdog (deadline = clamp(factor x rolling "
+        "max chunk wall), default 8 — a breach emits "
+        "watchdog_timeout + recovers from the snapshot or refuses "
+        "loudly); --straggler-factor=F emits shard_health events "
+        "when a shard's wave work exceeds F x the shard median "
+        "(traced mesh runs; sustained stragglers feed the failure "
+        "classifier)"
+    )
 
 
 def _pop_trace_flag(argv: list[str]) -> tuple[str | None, list[str]]:
@@ -558,6 +585,31 @@ def _pop_runtime_flags(argv: list[str]) -> list[str]:
             _RUNTIME["resume_any_sha"] = True
         elif a.startswith("--waves-per-sync="):
             _RUNTIME["waves_per_sync"] = int(a.split("=", 1)[1])
+        elif a == "--degrade-on-fault":
+            # degrade-and-continue (checkpoint.FailurePolicy): a
+            # fault that persists on one shard drops that shard and
+            # re-shards the last snapshot onto the survivors
+            _RUNTIME["degrade_on_fault"] = True
+        elif a == "--watchdog" or a.startswith("--watchdog="):
+            # hung-dispatch watchdog (checkers/tpu.py): deadline =
+            # clamp(factor x rolling max chunk wall), default factor 8
+            val = a.split("=", 1)[1] if "=" in a else "8"
+            f = float(val)
+            if f <= 0:
+                raise SystemExit(
+                    f"--watchdog={val}: the factor must be > 0"
+                )
+            _RUNTIME["watchdog"] = f
+        elif a.startswith("--straggler-factor="):
+            val = a.split("=", 1)[1]
+            f = float(val)
+            if f <= 1:
+                raise SystemExit(
+                    f"--straggler-factor={val}: must be > 1 (a shard "
+                    "flags when its wave work exceeds factor x the "
+                    "shard median)"
+                )
+            _RUNTIME["straggler_factor"] = f
         else:
             rest.append(a)
     return rest
@@ -571,7 +623,8 @@ def main(argv: list[str] | None = None) -> None:
     _RUNTIME.update(
         checkpoint_every=None, checkpoint_path=None, resume=False,
         resume_any_sha=False, waves_per_sync=None,
-        tier_hot_rows=None,
+        tier_hot_rows=None, degrade_on_fault=False, watchdog=None,
+        straggler_factor=None,
     )
     trace_level, argv = _pop_trace_flag(argv)
     argv = _pop_runtime_flags(argv)

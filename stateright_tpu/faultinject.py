@@ -25,17 +25,40 @@ docstring; this module makes every cell of the crash matrix
 * **stale manifest** — :func:`stale_manifest` rewrites the snapshot's
   manifest (wrong git SHA, wrong encoding fingerprint) with VALID
   buffer checksums, which resume must refuse with
-  ``SnapshotStaleError``.
+  ``SnapshotStaleError``;
+* **persistent per-shard device fault** (the degrade-and-continue
+  round) — a ``shard_fault`` armed with a shard id raises
+  :class:`InjectedShardFault` at EVERY chunk at or past its armed
+  chunk *as long as the faulted shard is still in the run's mesh*
+  (the engines pass their live shard-id set to :func:`fire`): the
+  model of a chip that died and stays dead. The supervisor's
+  :class:`~stateright_tpu.checkpoint.FailurePolicy` sees the same
+  shard fail across retries, classifies it persistent, and degrades
+  the run onto the surviving shards — after which the fault stops
+  firing, exactly as a dropped chip stops mattering;
+* **chunk-dispatch hang** — a ``hang`` sleeps ``hang_sec`` (default
+  30 s) at the dispatch site instead of raising: the XLA:CPU
+  thunk-runtime livelock family's shape (ROADMAP §carried), which no
+  exception path ever surfaces. Only the hung-dispatch watchdog
+  (checkers/tpu.py, ``watchdog_factor``) can see it;
+* **collective-seam raise** — a ``raise`` armed at the
+  ``collective_seam`` site fires only on mesh engines, just before
+  the sharded dispatch: a device error surfacing from the all_to_all
+  path, which the supervisor must treat like any chunk fault.
 
 Faults arm either programmatically (:func:`arm`, in-process tests) or
 via the ``STPU_FAULTS`` environment variable (subprocess kill cells):
-a comma-separated list of ``<action>@<site>:<chunk>`` specs, e.g.
-``STPU_FAULTS="kill@chunk_boundary:2"`` or
-``STPU_FAULTS="raise@mid_chunk:1"``. Sites are ``chunk_boundary``
-(fires AFTER the chunk's snapshot write, so a kill there proves the
-committed-snapshot sequencing) and ``mid_chunk`` (fires after the
-async dispatch, before the stats readback). Each armed fault fires
-ONCE by default, so a supervised retry doesn't re-trip it.
+a comma-separated list of ``<action>@<site>:<chunk>[:<arg>]`` specs,
+e.g. ``STPU_FAULTS="kill@chunk_boundary:2"``,
+``STPU_FAULTS="raise@mid_chunk:1"``,
+``STPU_FAULTS="hang@mid_chunk:1:20"`` (arg = seconds), or
+``STPU_FAULTS="shard_fault@mid_chunk:1:0"`` (arg = shard id). Sites
+are ``chunk_boundary`` (fires AFTER the chunk's snapshot write, so a
+kill there proves the committed-snapshot sequencing), ``mid_chunk``
+(fires after the async dispatch, before the stats readback), and
+``collective_seam`` (mesh engines only, before the sharded dispatch).
+Each armed fault fires ONCE by default, so a supervised retry doesn't
+re-trip it — except ``shard_fault``, which is persistent by design.
 
 Every firing emits a ``fault_injected`` telemetry event (best effort:
 a ``kill`` loses the in-memory trace with the process, as a real kill
@@ -48,12 +71,18 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-SITES = ("chunk_boundary", "mid_chunk")
-ACTIONS = ("raise", "kill")
+SITES = ("chunk_boundary", "mid_chunk", "collective_seam")
+ACTIONS = ("raise", "kill", "hang", "shard_fault")
 
 #: exit code of an injected process kill (mirrors SIGKILL's 128+9 so
 #: drivers distinguish the injected death from an assertion failure).
 KILL_EXIT_CODE = 137
+
+#: default sleep of an injected dispatch hang (long enough that any
+#: sanely derived watchdog deadline expires first; a daemonized hang
+#: thread dies with the process, so a recovered run never waits it
+#: out).
+DEFAULT_HANG_SEC = 30.0
 
 
 class InjectedFault(RuntimeError):
@@ -72,20 +101,46 @@ class InjectedFault(RuntimeError):
         self.chunk = chunk
 
 
+class InjectedShardFault(InjectedFault):
+    """A persistent per-shard device fault (``shard_fault`` action):
+    the model of one dead chip in a mesh. Carries the shard id so the
+    supervisor's :class:`~stateright_tpu.checkpoint.FailurePolicy`
+    can attribute repeated failures to the same shard and escalate to
+    an elastic degrade."""
+
+    def __init__(self, site: str, chunk: int, shard: int):
+        RuntimeError.__init__(
+            self,
+            f"injected persistent device fault on shard {shard} at "
+            f"{site} (chunk {chunk}) — stateright_tpu/faultinject.py"
+        )
+        self.site = site
+        self.chunk = chunk
+        self.shard = int(shard)
+
+
 _ARMED: list[dict] = []
 _ENV_PARSED = False
 
 
 def parse_spec(spec: str) -> dict:
-    """One ``<action>@<site>:<chunk>`` spec -> an armed-fault dict."""
+    """One ``<action>@<site>:<chunk>[:<arg>]`` spec -> an armed-fault
+    dict. The optional trailing arg is the hang duration in seconds
+    (``hang``) or the faulted shard id (``shard_fault``)."""
     try:
         action, rest = spec.split("@", 1)
-        site, chunk = rest.split(":", 1)
-        chunk_i = int(chunk)
-    except ValueError as exc:
+        parts = rest.split(":")
+        site = parts[0]
+        chunk_i = int(parts[1])
+        arg = parts[2] if len(parts) > 2 else None
+        if len(parts) > 3:
+            raise ValueError("too many fields")
+    except (ValueError, IndexError) as exc:
         raise ValueError(
-            f"bad fault spec {spec!r} (want <action>@<site>:<chunk>, "
-            f"e.g. kill@chunk_boundary:2)"
+            f"bad fault spec {spec!r} (want "
+            "<action>@<site>:<chunk>[:<arg>], e.g. "
+            "kill@chunk_boundary:2, hang@mid_chunk:1:20, "
+            "shard_fault@mid_chunk:1:0)"
         ) from exc
     if action not in ACTIONS:
         raise ValueError(f"unknown fault action {action!r} (use one of "
@@ -93,17 +148,42 @@ def parse_spec(spec: str) -> dict:
     if site not in SITES:
         raise ValueError(f"unknown fault site {site!r} (use one of "
                          f"{SITES})")
-    return dict(action=action, site=site, chunk=chunk_i, once=True)
+    f = dict(action=action, site=site, chunk=chunk_i, once=True)
+    if action == "hang":
+        f["hang_sec"] = (float(arg) if arg is not None
+                         else DEFAULT_HANG_SEC)
+    elif action == "shard_fault":
+        # persistent by design: a dead chip stays dead until the run
+        # degrades away from it
+        f["shard"] = int(arg) if arg is not None else 0
+        f["once"] = False
+    elif arg is not None:
+        raise ValueError(
+            f"fault spec {spec!r}: trailing arg is only meaningful "
+            "for hang (seconds) and shard_fault (shard id)"
+        )
+    return f
 
 
-def arm(action: str, site: str, chunk: int, once: bool = True) -> None:
-    """Arm one fault programmatically (tests / the crash matrix)."""
+def arm(action: str, site: str, chunk: int, once: bool = True,
+        shard: Optional[int] = None,
+        hang_sec: Optional[float] = None) -> None:
+    """Arm one fault programmatically (tests / the crash matrix).
+    ``shard_fault`` faults are always persistent — a dead chip stays
+    dead until the run degrades away from it (``once`` is ignored)."""
     if action not in ACTIONS:
         raise ValueError(f"unknown fault action {action!r}")
     if site not in SITES:
         raise ValueError(f"unknown fault site {site!r}")
-    _ARMED.append(dict(action=action, site=site, chunk=int(chunk),
-                       once=once))
+    f = dict(action=action, site=site, chunk=int(chunk), once=once)
+    if action == "shard_fault":
+        f["shard"] = int(shard or 0)
+        f["once"] = False
+    if action == "hang":
+        f["hang_sec"] = float(
+            hang_sec if hang_sec is not None else DEFAULT_HANG_SEC
+        )
+    _ARMED.append(f)
 
 
 def disarm_all() -> None:
@@ -141,31 +221,59 @@ def chunk_for_seed(seed: int, n_chunks: int) -> int:
     return (seed * 1103515245 + 12345) % n_chunks
 
 
-def fire(site: str, chunk: int) -> None:
+def fire(site: str, chunk: int, shards=None) -> None:
     """The engine-side hook (checkers/tpu.py chunk loop): fires the
     first armed fault matching (site, chunk). ``raise`` throws
     :class:`InjectedFault`; ``kill`` emits the telemetry event (lost
     with the process, as a real kill's would be) and ``os._exit``\\ s
-    with :data:`KILL_EXIT_CODE`. No armed faults = a list check and
-    out (the hook is per-chunk, not per-wave — cost is noise)."""
+    with :data:`KILL_EXIT_CODE`; ``hang`` sleeps its armed duration
+    (the watchdog's territory — no exception ever surfaces);
+    ``shard_fault`` raises :class:`InjectedShardFault` at EVERY chunk
+    at or past its armed chunk, as long as its shard id appears in
+    ``shards`` (the engine's live shard-id set — None means
+    single-chip/unfiltered, where shard 0 is the only shard). No
+    armed faults = a list check and out (the hook is per-chunk, not
+    per-wave — cost is noise)."""
     _parse_env()
     if not _ARMED:
         return
     for f in _ARMED:
-        if f["site"] == site and f["chunk"] == chunk:
-            if f["once"]:
-                _ARMED.remove(f)
-            from . import telemetry
+        if f["site"] != site:
+            continue
+        if f["action"] == "shard_fault":
+            # persistent: the chunk is a first-fire threshold, and a
+            # degraded mesh that dropped the shard stops matching
+            if chunk < f["chunk"]:
+                continue
+            if shards is not None and f["shard"] not in shards:
+                continue
+            if shards is None and f["shard"] != 0:
+                continue
+        elif f["chunk"] != chunk:
+            continue
+        if f["once"]:
+            _ARMED.remove(f)
+        from . import telemetry
 
-            telemetry.emit(
-                "fault_injected", site=site, chunk=int(chunk),
-                action=f["action"],
-            )
-            if f["action"] == "kill":
-                # A real preemption: no cleanup, no atexit, no flushed
-                # buffers. os._exit is the honest model.
-                os._exit(KILL_EXIT_CODE)
-            raise InjectedFault(site, chunk)
+        telemetry.emit(
+            "fault_injected", site=site, chunk=int(chunk),
+            action=f["action"],
+            **({"shard": f["shard"]}
+               if f["action"] == "shard_fault" else {}),
+        )
+        if f["action"] == "kill":
+            # A real preemption: no cleanup, no atexit, no flushed
+            # buffers. os._exit is the honest model.
+            os._exit(KILL_EXIT_CODE)
+        if f["action"] == "hang":
+            # the livelock shape: the dispatch wedges, nothing raises
+            import time
+
+            time.sleep(f["hang_sec"])
+            return
+        if f["action"] == "shard_fault":
+            raise InjectedShardFault(site, chunk, f["shard"])
+        raise InjectedFault(site, chunk)
 
 
 # -- snapshot-damage helpers (the torn/stale matrix cells) ----------------

@@ -158,6 +158,76 @@ def decide_hot_rows(capacity: int, v_min: int, v_ladder_step: int,
     return hot
 
 
+def tier_frontier_headroom(capacity: int, frontier_capacity: int,
+                           cand_capacity) -> dict:
+    """The tiered-mode frontier-headroom bound (the PR 12 known
+    bound), pre-checked from the SAME numbers the resident-buffer
+    ledger declares — BEFORE any device work, instead of surfacing
+    mid-run as an f_overflow message:
+
+    in tiered mode the frontier bound applies to a wave's
+    PROVISIONAL winners (hot-tier-new rows before the cold membership
+    pass retires spilled duplicates), which can exceed the resident
+    run's post-dedup new counts. The only static ceiling on
+    provisional winners is the candidate budget ``B``
+    (cand_capacity): when ``B <= F`` the bound PROVABLY holds — no
+    tiered wave can overflow a frontier the candidate buffer can't
+    outproduce; when ``B > F`` the bound is load-dependent and a
+    frontier that fits the all-resident run may need headroom once
+    the hot tier spills.
+
+    Returns ``{holds, frontier_capacity, cand_capacity,
+    required_frontier, message}`` — ``holds`` is True (provable),
+    False (violated, ``message`` carries the pinned refuse/warn text
+    and ``required_frontier`` the F that makes it provable, = B), or
+    None when the budget is still unresolved (a literal ``"auto"``
+    not yet replaced by the persisted/heuristic budget — nothing is
+    provable or refutable yet, and no message is emitted: a false
+    "None exceeds F" claim is worse than silence). Callers with
+    ``cand_capacity=None`` (no compaction) should pass the true
+    static bound ``F x K`` instead — the engines' ``_pre_run_check``
+    does. The engines consume this through ``tier_headroom_policy``
+    ("warn" — the documented PR 12 behavior, now surfaced BEFORE
+    device work; "bump" — raise frontier_capacity to
+    ``required_frontier`` before programs build; "refuse" — raise
+    instead of risking a mid-run overflow)."""
+    C = int(capacity)
+    F = int(frontier_capacity)
+    if cand_capacity in (None, "auto"):
+        return dict(
+            holds=None,
+            capacity=C,
+            frontier_capacity=F,
+            cand_capacity=cand_capacity,
+            required_frontier=None,
+            message=None,
+        )
+    B = int(cand_capacity)
+    holds = B <= F
+    message = None
+    if not holds:
+        message = (
+            "tiered-mode frontier-headroom bound: provisional "
+            "winners (hot-tier-new rows before the cold membership "
+            "pass) are bounded only by the candidate budget "
+            f"cand_capacity={B}, which exceeds "
+            f"frontier_capacity={F} — a frontier that fits the "
+            "all-resident run may overflow once the hot tier "
+            f"spills. Raise frontier_capacity to {B} "
+            "(tier_headroom_policy='bump' does this before device "
+            "work), or accept the mid-run f_overflow risk "
+            "(tier_headroom_policy='warn', the default)."
+        )
+    return dict(
+        holds=holds,
+        capacity=C,
+        frontier_capacity=F,
+        cand_capacity=cand_capacity,
+        required_frontier=B,
+        message=message,
+    )
+
+
 # -- live watermarks ------------------------------------------------------
 
 

@@ -111,6 +111,11 @@ class ShardedTpuBfsChecker(TpuBfsChecker):
         )
         self.total_capacity = capacity * self.n_shards
         self.bucket_capacity = bucket_capacity
+        #: live shard ids in ORIGINAL numbering (the degrade-and-
+        #: continue layer: faultinject filters persistent shard
+        #: faults against this, and a supervised degrade removes the
+        #: dropped shard — checkers/tpu.py _degrade_shards).
+        self._shard_ids = tuple(range(self.n_shards))
 
     def _cache_extras(self) -> tuple:
         # Mesh hashes by devices + axis names, so equivalent meshes

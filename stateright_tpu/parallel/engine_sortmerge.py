@@ -171,6 +171,11 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
         )
         self.total_capacity = capacity * self.n_shards
         self.bucket_capacity = bucket_capacity
+        #: live shard ids in ORIGINAL numbering (the degrade-and-
+        #: continue layer: faultinject filters persistent shard
+        #: faults against this, and a supervised degrade removes the
+        #: dropped shard — checkers/tpu.py _degrade_shards).
+        self._shard_ids = tuple(range(self.n_shards))
 
     def _cache_extras(self) -> tuple:
         # Includes the single-chip extras: the ladder/sparse/tile knobs

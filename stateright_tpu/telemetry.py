@@ -776,6 +776,18 @@ _REQUIRED = {
     # them exactly between two tiered runs); walls are timing lanes.
     "tier_spill": ("run", "rows", "hot_rows_before", "cold_rows_total",
                    "cold_bytes_total", "runs", "spill_index"),
+    # The degrade-and-continue layer (checkpoint.FailurePolicy + the
+    # hung-dispatch watchdog + the health layer): ``shard_health`` —
+    # one per straggler verdict (telemetry.detect_stragglers over the
+    # existing per-shard wave log); ``fault_degrade`` — the
+    # supervisor dropped a persistently-faulting shard and re-sharded
+    # the last snapshot onto the survivors (old -> new shard count,
+    # re-routed row total); ``watchdog_timeout`` — a chunk
+    # dispatch+sync exceeded its derived deadline (full latency
+    # attribution rides the event).
+    "shard_health": ("run", "shard", "wave", "kind", "factor"),
+    "fault_degrade": ("run", "from_shards", "to_shards", "reason"),
+    "watchdog_timeout": ("run", "chunk", "deadline_sec"),
 }
 
 
@@ -831,6 +843,20 @@ def validate_events(events: list[dict]) -> None:
                     f"reader ({SCHEMA_VERSION})"
                 )
             open_runs.add(ev["run"])
+        elif kind == "restore":
+            # an in-process supervised retry restored from a snapshot
+            # mid-run: the running sums re-seed — the resumed segment
+            # restarts behind the failed attempt's furthest wave, and
+            # a DEGRADED restore additionally re-routes rows between
+            # shards, so per-shard visited totals are legitimately
+            # discontinuous across this point
+            run = ev["run"]
+            last_unique.pop(run, None)
+            last_wave.pop(run, None)
+            for key in [k for k in last_visited if k[0] == run]:
+                last_visited.pop(key)
+            for key in [k for k in last_shard_wave if k[0] == run]:
+                last_shard_wave.pop(key)
         elif kind == "wave":
             run = ev["run"]
             if run not in open_runs:
@@ -906,7 +932,9 @@ def _run_view(events: list[dict], run: int) -> dict:
                       shard_waves={}, memory_plan=None,
                       memory_watermark=None, latency_profile=None,
                       builds=[], verdicts=[], restores=[],
-                      tier_spills=[])
+                      tier_spills=[], degrades=[], watchdogs=[],
+                      health=[])
+    seen_shard_pairs: set = set()
     for ev in events:
         if ev.get("run") != run:
             continue
@@ -926,12 +954,32 @@ def _run_view(events: list[dict], run: int) -> dict:
             view["restores"].append(ev)
         elif kind == "tier_spill":
             view["tier_spills"].append(ev)
+        elif kind == "fault_degrade":
+            view["degrades"].append(ev)
+        elif kind == "watchdog_timeout":
+            view["watchdogs"].append(ev)
+        elif kind == "shard_health":
+            view["health"].append(ev)
         elif kind == "wave":
             view["waves"].append(ev)
         elif kind == "shard_wave":
             # keyed (wave, shard), last occurrence wins — the same
             # last-attempt alignment the global wave dict gets from
-            # its keyed overwrite
+            # its keyed overwrite. A supervised RETRY re-explores
+            # waves it already logged: when a (wave, shard) pair
+            # repeats, every stored wave >= it belongs to the dead
+            # attempt and is purged, so a DEGRADED retry (fewer
+            # shards) can't leave the old attempt's extra shard rows
+            # mixed into the re-explored waves.
+            key = (ev["wave"], ev["shard"])
+            if key in seen_shard_pairs:
+                for w in [w for w in view["shard_waves"]
+                          if w >= ev["wave"]]:
+                    del view["shard_waves"][w]
+                seen_shard_pairs = {
+                    p for p in seen_shard_pairs if p[0] < ev["wave"]
+                }
+            seen_shard_pairs.add(key)
             view["shard_waves"].setdefault(
                 ev["wave"], {}
             )[ev["shard"]] = ev
@@ -982,6 +1030,59 @@ def _skew(xs: list) -> Optional[float]:
     if tot == 0:
         return None
     return round(max(xs) * len(xs) / tot, 4)
+
+
+#: a wave whose per-shard work median is below this many rows yields
+#: no straggler verdicts: a 1-row seed wave on an 8-shard mesh puts
+#: every loaded shard "factor x median" over an empty one, which is
+#: startup shape, not shard health.
+STRAGGLER_MIN_MEDIAN_ROWS = 16
+
+
+def detect_stragglers(wave_rows, factor: float,
+                      min_median_rows: int = STRAGGLER_MIN_MEDIAN_ROWS,
+                      ) -> list[dict]:
+    """The health layer's per-wave straggler verdict over ONE wave's
+    per-shard log rows (``[n_shards, SHARD_LOG_LANES]`` — the
+    existing mesh wave log, telemetry round 11): a shard whose work
+    (its ``candidates`` lane, the wave's per-shard cost driver)
+    exceeds ``factor`` x the shard MEDIAN is a straggler. On an SPMD
+    mesh every shard leaves a wave together, so a persistent work
+    imbalance is the host-visible shadow of a slow or failing chip —
+    the engines emit one ``shard_health`` event per verdict and feed
+    SUSTAINED stragglers to checkpoint.classify_failure as pre-fault
+    evidence.
+
+    Pure host math over the log rows (unit-tested in ``pytest -m
+    fault``). Returns ``[{shard, value, median, ratio}, ...]``; empty
+    when the mesh is a single shard (no median signal), the wave's
+    median work is under ``min_median_rows`` (seed/drain waves), or
+    nothing exceeds the factor."""
+    import numpy as _np
+
+    if factor is None or factor <= 1.0:
+        raise ValueError(
+            f"straggler factor must be > 1 (got {factor}): at 1.0 "
+            "every shard above the median would flag"
+        )
+    rows = _np.asarray(wave_rows)
+    if rows.ndim != 2 or rows.shape[0] < 2:
+        return []
+    work = rows[:, SHARD_LOG_FIELDS.index("candidates")].astype(
+        _np.int64
+    )
+    median = float(_np.median(work))
+    if median < min_median_rows:
+        return []
+    out = []
+    for s in range(work.shape[0]):
+        v = int(work[s])
+        if v > factor * median:
+            out.append(dict(
+                shard=s, value=v, median=median,
+                ratio=(v / median if median else float("inf")),
+            ))
+    return out
 
 
 def shard_balance(events: list[dict], run: int | None = None,
@@ -1307,6 +1408,11 @@ def latency_summary(events: list[dict], run: int | None = None,
         builds=[_strip_ev(b) for b in builds],
         verdicts=vrows,
         phases=phases,
+        # the degrade-and-continue layer's wall-clock events ride the
+        # latency view: watchdog breaches carry the full attribution,
+        # degrades mark where the run changed shape mid-stream
+        watchdogs=[_strip_ev(w) for w in view["watchdogs"]],
+        degrades=[_strip_ev(d) for d in view["degrades"]],
         error=(view["end"] or {}).get("error"),
     )
 
@@ -1378,6 +1484,21 @@ def _missing_ok(i: int, in_a: bool, in_b: bool,
     return False
 
 
+def _reshard_points(view: dict) -> list[tuple]:
+    """``[(wave, to_shards), ...]`` where this run legitimately
+    changed shard count mid-stream: supervised elastic degrades
+    (``fault_degrade`` events) and elastic re-shard resumes (a
+    ``restore`` whose from/to shard counts differ)."""
+    pts = []
+    for ev in view.get("degrades") or []:
+        pts.append((int(ev.get("wave") or 0), int(ev["to_shards"])))
+    for ev in view.get("restores") or []:
+        if ev.get("from_shards") != ev.get("to_shards"):
+            pts.append((int(ev.get("wave") or 0),
+                        int(ev["to_shards"])))
+    return sorted(pts)
+
+
 def _shard_divergences(va: dict, vb: dict) -> list[dict]:
     """Shard-aware wave alignment (the mesh observability layer): for
     every wave BOTH sides have per-shard rows for, the multisets of
@@ -1385,14 +1506,36 @@ def _shard_divergences(va: dict, vb: dict) -> list[dict]:
     (the multiset is invariant), a different partition of the same
     global counts is not. A wave with shard rows on exactly one side
     also diverges (one run was sharded-traced, the other not — they
-    are not comparable as a mesh A/B)."""
+    are not comparable as a mesh A/B).
+
+    DEGRADE-aware (the degrade-and-continue layer): a run that
+    elastically degraded (``fault_degrade``) or resumed onto a
+    different shard count (``restore``) legitimately changes its
+    per-wave shard count at the re-shard wave. Shard lanes compare
+    within each shard-COUNT segment — waves where the two sides'
+    counts differ because one side re-sharded are skipped on the
+    shard lanes (the GLOBAL counters stay fully enforced, which is
+    exactly the degraded-run bit-exactness proof)."""
     from collections import Counter
+
+    def expected_at(view, pts, wave, default):
+        cur = default
+        for w, s in pts:
+            if wave >= w:
+                cur = s
+        return cur
 
     out: list[dict] = []
     sa, sb = va["shard_waves"], vb["shard_waves"]
     if not sa and not sb:
         return out
     rw_a, rw_b = _resume_wave(va), _resume_wave(vb)
+    pts_a, pts_b = _reshard_points(va), _reshard_points(vb)
+    resharded = bool(pts_a or pts_b)
+    # each side's baseline shard count, from its own run_begin lane
+    # (falls back to the observed row count on traces without one)
+    base_a = ((va["begin"] or {}).get("lane") or {}).get("n_shards")
+    base_b = ((vb["begin"] or {}).get("lane") or {}).get("n_shards")
     for i in sorted(set(sa) | set(sb)):
         if (i in sa) != (i in sb):
             if _missing_ok(i, i in sa, i in sb, rw_a, rw_b):
@@ -1409,6 +1552,18 @@ def _shard_divergences(va: dict, vb: dict) -> list[dict]:
                 for ev in view_waves[i].values()
             )
 
+        if len(sa[i]) != len(sb[i]) and resharded:
+            # different shard-COUNT segments are incomparable on the
+            # shard lanes by design — but ONLY when each side's count
+            # is exactly what its own degrade/re-shard history
+            # predicts for this wave; a row count the history does
+            # NOT explain (a genuinely lost shard row) still diverges
+            ea = expected_at(va, pts_a, i,
+                             base_a if base_a else len(sa[i]))
+            eb = expected_at(vb, pts_b, i,
+                             base_b if base_b else len(sb[i]))
+            if ea != eb and len(sa[i]) == ea and len(sb[i]) == eb:
+                continue  # global counters carry the proof here
         ca, cb = rows(sa), rows(sb)
         if len(sa[i]) != len(sb[i]):
             out.append(
@@ -1769,16 +1924,34 @@ def diff_traces(
     memory = _memory_diff(va, vb, threshold)
     latency = _latency_diff(va, vb, threshold, min_sec)
     tier = _tier_diff(va, vb, threshold, min_sec)
-    if (rw_a is None) != (rw_b is None):
-        # One side resumed mid-run: its walls cover a PARTIAL search
-        # (plus a fresh process's compile fetches), so timing/byte
-        # lanes are not comparable to the uninterrupted side — only
-        # the counters are, and those stay fully enforced above. The
-        # lanes still print; the regression flags are cleared.
+    deg_a = [dict(wave=int(d.get("wave") or 0),
+                  from_shards=int(d["from_shards"]),
+                  to_shards=int(d["to_shards"]),
+                  reason=d.get("reason"))
+             for d in va["degrades"]]
+    deg_b = [dict(wave=int(d.get("wave") or 0),
+                  from_shards=int(d["from_shards"]),
+                  to_shards=int(d["to_shards"]),
+                  reason=d.get("reason"))
+             for d in vb["degrades"]]
+    if (rw_a is None) != (rw_b is None) \
+            or bool(deg_a) != bool(deg_b):
+        # One side resumed (or DEGRADED) mid-run: its walls cover a
+        # PARTIAL search (plus a fresh process's compile fetches), so
+        # timing/byte lanes are not comparable to the uninterrupted
+        # side — only the counters are, and those stay fully enforced
+        # above. The lanes still print; the regression flags are
+        # cleared.
         regressions = []
         memory["regressions"] = []
         latency["regressions"] = []
         tier["regressions"] = []
+        if bool(deg_a) != bool(deg_b):
+            # a degraded run legitimately re-declared its resident
+            # layout at the surviving shard count — the plan-exact
+            # gate compares configs that are SUPPOSED to differ;
+            # the global wave counters stay the exactness proof
+            memory["divergences"] = []
         # spill-event counts are also not comparable across a resume:
         # the pre-kill spills died with the killed process's trace
         # (the cold-total lanes would match, but the per-event counts
@@ -1788,6 +1961,7 @@ def diff_traces(
         run_a=va["run"], run_b=vb["run"],
         waves_a=len(va["waves"]), waves_b=len(vb["waves"]),
         resume_wave_a=rw_a, resume_wave_b=rw_b,
+        degrades_a=deg_a, degrades_b=deg_b,
         divergences=divergences,
         phases=phases,
         regressions=regressions,
@@ -1819,6 +1993,14 @@ def format_diff(report: dict) -> str:
                 f"run {side.upper()} RESUMED at wave {rw}: "
                 "pre-resume waves excluded from alignment; timing "
                 "lanes informational (partial-run walls)"
+            )
+        for d in report.get(f"degrades_{side}") or ():
+            lines.append(
+                f"run {side.upper()} DEGRADED at wave {d['wave']}: "
+                f"S={d['from_shards']} -> S={d['to_shards']} "
+                f"({d.get('reason')}) — shard lanes compare within "
+                "each shard-count segment; global counters fully "
+                "enforced"
             )
     if report["divergences"]:
         lines.append(

@@ -267,7 +267,12 @@ def test_incompatible_targets_refused(twopc3_snapshot):
         tiny.resume_from(twopc3_snapshot)
 
 
-def test_hash_reshard_refused(tmp_path):
+def test_hash_reshard_directions(tmp_path):
+    """The degrade-and-continue round lifted PR 11's refuse-by-name
+    for the sharded-hash -> sharded-hash case: the per-shard tables
+    rebuild host-side by re-insertion through the (owner, fp) route.
+    Single-chip ⇄ sharded hash keeps refusing — with a message that
+    names the supported direction."""
     snap = str(tmp_path / "hash.ckpt")
 
     def spawn(n, **kw):
@@ -283,10 +288,22 @@ def test_hash_reshard_refused(tmp_path):
     r.resume_from(snap)
     r.join()
     assert r.unique_state_count() == 288
-    # ...a hash re-shard refuses loudly (re-insertion not implemented)
+    # ...and the sharded -> sharded re-shard now works too: 2 -> 4
+    # by host-side re-insertion, exact count + discoveries
+    re4 = spawn(4)
+    manifest = re4.resume_from(snap)
+    assert manifest["n_shards"] == 2
+    re4.join()
+    assert re4.unique_state_count() == 288
+    assert sorted(re4.discoveries()) == sorted(r.discoveries())
+    # single-chip ⇄ sharded hash keeps refusing BY NAME, and the
+    # message says which direction IS supported
+    single = TwoPhaseSys(rm_count=3).checker().spawn_tpu(
+        capacity=1 << 10, frontier_capacity=128, waves_per_sync=2,
+    )
     with pytest.raises(SnapshotIncompatibleError,
-                       match="re-layout|hash"):
-        spawn(4).resume_from(snap)
+                       match="sharded-hash -> sharded-hash"):
+        single.resume_from(snap)
 
 
 def test_engine_overflow_is_not_supervised(tmp_path):

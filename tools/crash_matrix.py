@@ -4,9 +4,13 @@
 Runs every cell of the fault-injection matrix
 (stateright_tpu/faultinject.py) against one workload and verdicts each
 as **recovered** (kill or device fault → resumed/retried to the exact
-baseline count) or **refused** (torn snapshot, stale manifest → the
-named Snapshot* error) — the contract is recover-or-refuse-loudly,
-never a silent wrong answer:
+baseline count), **refused** (torn snapshot, stale manifest → the
+named Snapshot* error), or **continue-degraded** (a persistent
+per-shard fault → the supervisor dropped the shard, re-sharded the
+last snapshot onto the survivors, and the degraded run completed to
+the identical count) — the contract is
+continue-degraded-or-recover-or-refuse-loudly, never a silent wrong
+answer and never a hang:
 
 * ``kill`` — a SUBPROCESS runs the real CLI check lane with
   ``--checkpoint-every`` and an armed ``STPU_FAULTS`` process kill at
@@ -19,7 +23,29 @@ never a silent wrong answer:
 * ``torn_truncate`` / ``torn_flip`` — a valid snapshot damaged on disk
   must be detected (``SnapshotCorruptError``) at resume;
 * ``stale_sha`` / ``stale_encoding`` — a rewritten manifest must be
-  refused (``SnapshotStaleError``) at resume.
+  refused (``SnapshotStaleError``) at resume;
+* ``shard_fault_degrade`` (degrade-and-continue round) — a PERSISTENT
+  per-shard device fault on an S=2 virtual mesh under
+  ``degrade_on_fault``: the FailurePolicy classifies the repeat
+  offender, drops the shard, re-shards the snapshot onto the
+  survivor, and the run must complete to the identical count
+  (**continue-degraded**);
+* ``collective_raise`` — an injected raise at the mesh collective
+  seam under supervision must recover like any chunk fault;
+* ``hang_watchdog`` — an injected chunk-dispatch hang (the livelock
+  shape: a sleep, no exception) under an armed watchdog: the breach
+  must be DETECTED within the derived deadline, and the run either
+  recovers from the snapshot (**recovered**) or raises the
+  WatchdogTimeout with its attribution (**refused** — loudly, never
+  a hang).
+
+``--mesh-degrade`` additionally runs the flagship acceptance pair: a
+TRACED 8-shard 2pc rm=5 mesh run with a persistent shard fault at a
+mid-run chunk must automatically degrade and complete to the
+identical 8,832, with the resume/degrade-aware
+``tools/trace_diff.py`` alignment reporting ZERO global-counter
+divergence vs the uninterrupted traced baseline; the TRACE pair and
+the diff verdict are embedded in the artifact.
 
 ``--trace`` additionally runs the baseline and the resumed half
 traced (``TRACE_r*`` artifacts land in the repo root) and embeds the
@@ -87,6 +113,27 @@ def _run_cli(args, faults=None, timeout=1800):
     return proc, unique, traces
 
 
+def _spawn_mesh(count, wps, n_shards, **kw):
+    """A 2pc virtual-mesh sort-merge checker (the degrade cells —
+    the sharded engine refuses cand_capacity='auto', so budgets are
+    explicit)."""
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    import math
+
+    capacity = 1 << max(10, math.ceil(2.6 * count + 1.5))
+    kw.setdefault("cand_capacity", 4096)
+    kw.setdefault("bucket_capacity", 2048)
+    return TwoPhaseSys(rm_count=count).checker() \
+        .spawn_tpu_sharded_sortmerge(
+            n_shards=n_shards,
+            capacity=capacity,
+            frontier_capacity=max(256, capacity // 4),
+            waves_per_sync=wps,
+            **kw,
+        )
+
+
 def _spawn(workload, count, wps, **kw):
     if workload == "2pc":
         from stateright_tpu.models.two_phase_commit import TwoPhaseSys
@@ -114,6 +161,116 @@ def _spawn(workload, count, wps, **kw):
             **STRUCTURAL_SIZES[count],
             **kw,
         )
+    )
+
+
+def _mesh_degrade_proof(cell):
+    """The flagship acceptance pair (``--mesh-degrade``): a TRACED
+    8-shard 2pc rm=5 virtual-mesh run with a PERSISTENT per-shard
+    fault injected at a mid-run chunk must automatically degrade to
+    fewer shards and complete to the identical 8,832, with the
+    resume/degrade-aware trace_diff reporting ZERO global-counter
+    divergence vs the uninterrupted traced baseline. Writes the
+    TRACE pair as committed artifacts and returns the block the CKPT
+    artifact embeds."""
+    import warnings as _warnings
+
+    from stateright_tpu import faultinject
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+    from stateright_tpu.telemetry import (
+        RunTracer,
+        diff_traces,
+        validate_events,
+        write_artifacts,
+    )
+
+    def spawn(**kw):
+        # the dryrun_multichip flagship config (TRACE_r16), at a
+        # chunk cadence that puts several boundaries before and
+        # after the injected fault
+        kw.setdefault("cand_capacity", 2048)
+        kw.setdefault("bucket_capacity", 1024)
+        return (
+            TwoPhaseSys(rm_count=5)
+            .checker()
+            .spawn_tpu_sharded_sortmerge(
+                n_shards=8,
+                capacity=1 << 12,
+                frontier_capacity=512,
+                waves_per_sync=2,
+                track_paths=True,
+                **kw,
+            )
+        )
+
+    fault_chunk, fault_shard = 3, 5
+    print("mesh degrade acceptance: traced 2pc rm=5 S=8, "
+          f"persistent shard fault (shard {fault_shard}) from chunk "
+          f"{fault_chunk}")
+    tr_base = RunTracer()
+    with tr_base.activate():
+        b = spawn().join()
+    base_n = b.unique_state_count()
+    validate_events(tr_base.events)
+    jsonl_a, _ = write_artifacts(tr_base)
+    print(f"  baseline: {base_n:,} states "
+          f"({os.path.basename(jsonl_a)})")
+
+    tmp = tempfile.mkdtemp(prefix="stpu_mesh_degrade_")
+    snap = os.path.join(tmp, "mesh.ckpt")
+    c = spawn(checkpoint_every=1, checkpoint_path=snap)
+    c.degrade_on_fault = True
+    c.retry_backoff_sec = 0.01
+    tr_deg = RunTracer()
+    faultinject.arm("shard_fault", "mid_chunk", fault_chunk,
+                    shard=fault_shard)
+    err = None
+    try:
+        with tr_deg.activate():
+            with _warnings.catch_warnings():
+                _warnings.simplefilter("ignore")
+                c.join()
+    except Exception as exc:
+        err = f"{type(exc).__name__}: {exc}"
+    finally:
+        faultinject.disarm_all()
+        shutil.rmtree(tmp, ignore_errors=True)
+    validate_events(tr_deg.events)
+    jsonl_b, _ = write_artifacts(tr_deg)
+    if err is not None:
+        cell("mesh_degrade", "raised", error=err,
+             degraded_trace=os.path.basename(jsonl_b))
+        return dict(error=err)
+    n = c.unique_state_count()
+    rep = diff_traces(tr_base.events, tr_deg.events)
+    degraded = bool(rep["degrades_b"]) and c.n_shards < 8
+    good = (n == base_n and degraded
+            and not rep["divergences"] and rep["ok"])
+    cell(
+        "mesh_degrade",
+        "continue-degraded" if good else "count_mismatch",
+        count=n, baseline=base_n, to_shards=c.n_shards,
+        counter_divergences=len(rep["divergences"]),
+    )
+    print(f"  trace_diff: {os.path.basename(jsonl_a)} vs "
+          f"{os.path.basename(jsonl_b)} — "
+          f"{len(rep['divergences'])} counter divergences, "
+          f"degraded at wave "
+          f"{rep['degrades_b'][0]['wave'] if rep['degrades_b'] else '-'}, "
+          f"{'OK' if rep['ok'] else 'FAIL'}")
+    return dict(
+        baseline_trace=os.path.basename(jsonl_a),
+        degraded_trace=os.path.basename(jsonl_b),
+        baseline_unique=base_n,
+        degraded_unique=n,
+        fault_chunk=fault_chunk,
+        fault_shard=fault_shard,
+        from_shards=8,
+        to_shards=int(c.n_shards),
+        degrade_wave=(rep["degrades_b"][0]["wave"]
+                      if rep["degrades_b"] else None),
+        counter_divergences=len(rep["divergences"]),
+        diff_ok=bool(rep["ok"]),
     )
 
 
@@ -145,7 +302,18 @@ def main():
     ap.add_argument("--root", default=None,
                     help="artifact directory for --json (default: "
                     "the repo root)")
+    ap.add_argument("--mesh-degrade", action="store_true",
+                    help="additionally run the traced 8-shard 2pc "
+                    "rm=5 degrade acceptance pair (TRACE artifacts + "
+                    "zero-divergence diff embedded in the JSON)")
     args = ap.parse_args()
+
+    # the mesh cells need virtual devices BEFORE jax initializes
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
     import jax
 
@@ -170,11 +338,16 @@ def main():
 
     def cell(name, verdict, **detail):
         nonlocal ok
-        good = verdict in ("recovered", "refused")
+        # the degrade column's verdict vocabulary: every cell must
+        # land on continue-degraded / recovered / refused-loudly —
+        # anything else (incl. a hang, which the driver's timeout
+        # would surface) fails the matrix
+        good = verdict in ("recovered", "refused",
+                           "continue-degraded")
         if not good:
             ok = False
         cells[name] = dict(verdict=verdict, **detail)
-        print(f"  {name:16s} {verdict:10s} "
+        print(f"  {name:20s} {verdict:18s} "
               + " ".join(f"{k}={v}" for k, v in detail.items()))
 
     print(f"crash matrix: {args.workload} count={args.count} "
@@ -319,9 +492,96 @@ def main():
             cell(name, "wrong_error",
                  error=f"{type(exc).__name__}: {exc}")
 
+    # -- cell: persistent per-shard fault -> automatic degrade ------------
+    # (degrade-and-continue round: the FailurePolicy sees the same
+    # shard fail across retries, drops it, re-shards the snapshot
+    # onto the survivor — the run must complete to the exact count)
+    c = _spawn_mesh(args.count, wps, n_shards=2,
+                    checkpoint_every=1,
+                    checkpoint_path=os.path.join(tmp, "deg.ckpt"))
+    c.degrade_on_fault = True
+    c.retry_backoff_sec = 0.01
+    faultinject.arm("shard_fault", "mid_chunk", kill_chunk, shard=1)
+    try:
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore")
+            c.join()
+        n = c.unique_state_count()
+        if n == baseline and c.n_shards == 1:
+            cell("shard_fault_degrade", "continue-degraded",
+                 count=n, from_shards=2, to_shards=c.n_shards)
+        else:
+            cell("shard_fault_degrade", "count_mismatch",
+                 count=n, n_shards=c.n_shards, baseline=baseline)
+    except Exception as exc:
+        cell("shard_fault_degrade", "raised",
+             error=f"{type(exc).__name__}: {exc}")
+    finally:
+        faultinject.disarm_all()
+
+    # -- cell: collective-seam raise, supervised recovery -----------------
+    c = _spawn_mesh(args.count, wps, n_shards=2,
+                    checkpoint_every=1,
+                    checkpoint_path=os.path.join(tmp, "coll.ckpt"))
+    c.retry_backoff_sec = 0.01
+    faultinject.arm("raise", "collective_seam", kill_chunk,
+                    once=True)
+    try:
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore")
+            c.join()
+        n = c.unique_state_count()
+        cell("collective_raise",
+             "recovered" if n == baseline else "count_mismatch",
+             count=n)
+    except Exception as exc:
+        cell("collective_raise", "raised",
+             error=f"{type(exc).__name__}: {exc}")
+    finally:
+        faultinject.disarm_all()
+
+    # -- cell: chunk-dispatch hang -> watchdog ----------------------------
+    # (the livelock shape: a sleep at the dispatch site, no exception
+    # — only the watchdog can see it; the verdict must be recovered
+    # or refused-loudly-with-attribution, never a hang)
+    from stateright_tpu.checkpoint import WatchdogTimeout
+
+    c = _spawn(args.workload, args.count, wps,
+               checkpoint_every=1,
+               checkpoint_path=os.path.join(tmp, "hang.ckpt"))
+    c.retry_backoff_sec = 0.01
+    c.watchdog_factor = 5.0
+    c.watchdog_floor_sec = 1.0
+    c.watchdog_grace_sec = 20.0
+    faultinject.arm("hang", "mid_chunk", kill_chunk, hang_sec=25.0)
+    try:
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore")
+            c.join()
+        n = c.unique_state_count()
+        cell("hang_watchdog",
+             "recovered" if n == baseline else "count_mismatch",
+             count=n)
+    except WatchdogTimeout as exc:
+        # refuse-loudly-with-diagnosis: acceptable where in-process
+        # recovery isn't (the attribution names the hung chunk)
+        cell("hang_watchdog", "refused",
+             error="WatchdogTimeout", chunk=exc.chunk,
+             deadline_sec=round(exc.deadline_sec, 3))
+    except Exception as exc:
+        cell("hang_watchdog", "raised",
+             error=f"{type(exc).__name__}: {exc}")
+    finally:
+        faultinject.disarm_all()
+
+    # -- the flagship degrade acceptance pair (--mesh-degrade) ------------
+    mesh_degrade = None
+    if args.mesh_degrade:
+        mesh_degrade = _mesh_degrade_proof(cell)
+
     print(f"verdict: {'CLEAN' if ok else 'FAIL'} "
-          f"({sum(1 for c in cells.values() if c['verdict'] in ('recovered', 'refused'))}"
-          f"/{len(cells)} cells recover-or-refuse)")
+          f"({sum(1 for c in cells.values() if c['verdict'] in ('recovered', 'refused', 'continue-degraded'))}"
+          f"/{len(cells)} cells continue-degraded/recover/refuse)")
     if snapshot_bytes is not None:
         print(f"snapshot bytes: {snapshot_bytes:,}"
               + (f" (memplan resident: {plan_bytes:,})"
@@ -349,6 +609,15 @@ def main():
             snapshot_bytes=snapshot_bytes,
             memplan_resident_bytes=plan_bytes,
             cells=cells,
+            # the degrade column, summarized: which cells landed on
+            # continue-degraded and where they degraded to
+            degrade_cells={
+                name: {k: c[k] for k in
+                       ("from_shards", "to_shards") if k in c}
+                for name, c in cells.items()
+                if c["verdict"] == "continue-degraded"
+            },
+            mesh_degrade=mesh_degrade,
             clean=ok,
             provenance=provenance(),
         )

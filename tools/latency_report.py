@@ -137,6 +137,23 @@ def format_report(summary: dict) -> str:
                if comp.get("builds") else "")
         )
 
+    for w in summary.get("watchdogs") or ():
+        lines.append(
+            f"WATCHDOG TIMEOUT at chunk {w.get('chunk')}: deadline "
+            f"{_sec(w.get('deadline_sec'))} "
+            f"(rolling max chunk wall "
+            f"{_sec(w.get('rolling_max_chunk_sec'))}, waited "
+            f"{_sec(w.get('waited_sec'))}) — a hung dispatch; the "
+            "latency attribution rides the event"
+        )
+    for d in summary.get("degrades") or ():
+        lines.append(
+            f"DEGRADED at wave {d.get('wave')}: "
+            f"S={d.get('from_shards')} -> S={d.get('to_shards')} "
+            f"({d.get('reason')}, {d.get('rerouted_rows')} rows "
+            "re-routed from the snapshot)"
+        )
+
     verdicts = summary.get("verdicts") or []
     if verdicts:
         lines.append("")

@@ -619,6 +619,18 @@ def main():
             f"ckpt ref: {ckpt_ref['artifact']} "
             f"clean={ckpt_ref['clean']}"
         )
+    # SERVE cross-reference (the resident-service round, same
+    # best-effort contract): the newest serve-report artifact — the
+    # warm-vs-cold latency-per-query verdict measured at the
+    # referenced SHA (tools/serve_report.py, stateright_tpu/serve.py).
+    from stateright_tpu.artifacts import latest_serve_summary
+
+    serve_ref = latest_serve_summary()
+    if serve_ref is not None:
+        _stderr(
+            f"serve ref: {serve_ref['artifact']} "
+            f"sessions={serve_ref['sessions']}"
+        )
 
     # Compile-cache ledger (round 14, checkers/tpu.py): per-lane
     # DELTAS of the process-cumulative compile-or-fetch counters, so
@@ -879,6 +891,8 @@ def main():
                            if comms_ref is not None else {}),
                         **({"ckpt": ckpt_ref}
                            if ckpt_ref is not None else {}),
+                        **({"serve": serve_ref}
+                           if serve_ref is not None else {}),
                     }
                 ),
                 "detail": detail,

@@ -28,6 +28,11 @@ commit, cross-referenced BY bench/lint artifacts
 telemetry.write_latency_artifact) follows MEM's pattern exactly:
 derived from a TRACE, names it in its ``trace`` field, numbers in
 its own sequence (``next_round(root, stems=("LAT",))``).
+``SERVE_r*.json`` (resident-service session reports,
+tools/serve_report.py over a service trace — stateright_tpu/serve.py)
+follows the same derived-from-a-TRACE pattern: own sequence
+(``SERVE_r01`` first), cross-referenced BY bench provenance via
+:func:`latest_serve_summary`.
 """
 
 from __future__ import annotations
@@ -291,6 +296,58 @@ def latest_ckpt_summary(root: str | None = None) -> dict | None:
             if isinstance(v, dict)
         }
     return out
+
+
+def latest_serve_summary(root: str | None = None) -> dict | None:
+    """Cross-reference block for the newest ``SERVE_r*.json``
+    (resident-service session report, tools/serve_report.py): artifact
+    name, the producing SHA, session count, and the warm-vs-cold
+    latency-per-query verdict (cold first-query vs warm repeat-query
+    time-to-verdict with the compile-tier attribution) — ROADMAP
+    direction 4's headline numbers, embedded in bench provenance
+    beside the LINT/COMM/CKPT blocks. Best effort with the same
+    guarantees: a missing, hand-edited, or truncated artifact degrades
+    to None, never aborts the caller."""
+    path = latest_artifact("SERVE", root)
+    if path is None:
+        return None
+    try:
+        with open(path) as fh:
+            report = json.load(fh)
+        sessions = report.get("sessions")
+        if not isinstance(sessions, list) or not sessions:
+            return None
+        prov = report.get("provenance")
+        serve_sha = (prov.get("git_sha")
+                     if isinstance(prov, dict) else None)
+        wvc = report.get("warm_vs_cold")
+        warm_block = None
+        if isinstance(wvc, list) and wvc:
+            wvc = wvc[0]
+        if isinstance(wvc, dict):
+            warm_block = {
+                k: wvc.get(k)
+                for k in ("cold_ttv_sec", "warm_ttv_sec",
+                          "ttv_delta_sec", "compile_delta_sec",
+                          "dispatch_net_delta_sec", "warm_start")
+            }
+    except (OSError, ValueError, TypeError, AttributeError, KeyError):
+        return None
+    repo = repo_root() if root is None else root
+    head = _git_sha(repo)
+    dirty = _git_dirty(repo)
+    return {
+        "artifact": os.path.basename(path),
+        "git_sha": serve_sha,
+        "sha_matches_head": (
+            serve_sha == head
+            if serve_sha is not None and head is not None
+            and dirty is False
+            else None
+        ),
+        "sessions": len(sessions),
+        "warm_vs_cold": warm_block,
+    }
 
 
 def _git_dirty(root: str) -> bool | None:

@@ -479,6 +479,15 @@ class TpuBfsChecker(Checker):
         #: (the hybrid racer's losing side; see checkers/hybrid.py).
         self.cancel_event = None
         self.cancelled = False
+        #: optional context manager acquired around EVERY chunk
+        #: dispatch+sync (both the untiered chunk loop and the tiered
+        #: takeover loop funnel through ``_guarded_dispatch``): the
+        #: resident service (stateright_tpu/serve.py) installs its
+        #: FIFO device queue here so concurrent sessions interleave at
+        #: chunk granularity without racing the device — one chunk in
+        #: flight at a time, queued in arrival order. None (default) =
+        #: no gate, zero overhead.
+        self.dispatch_gate = None
         #: per-run wave metrics for observability (SURVEY §5): updated
         #: at each host sync point.
         self.metrics: dict[str, float] = {}
@@ -1083,7 +1092,21 @@ class TpuBfsChecker(Checker):
         ``hang`` class. The hung thread is abandoned (XLA offers no
         cancellation); an injected hang's sleeper finishes harmlessly,
         a genuinely wedged runtime exhausts the retry budget and the
-        WatchdogTimeout raises through with the diagnosis."""
+        WatchdogTimeout raises through with the diagnosis.
+
+        When a ``dispatch_gate`` is installed (the resident service's
+        FIFO device queue, stateright_tpu/serve.py), the whole
+        dispatch+sync — watchdog-supervised or plain — runs inside it:
+        this method is the ONE seam both chunk loops (untiered and
+        tiered takeover) pass through, so gating here is what makes
+        concurrent sessions interleave at chunk granularity."""
+        gate = getattr(self, "dispatch_gate", None)
+        if gate is not None:
+            with gate:
+                return self._dispatch_supervised(thunk, chunk_no)
+        return self._dispatch_supervised(thunk, chunk_no)
+
+    def _dispatch_supervised(self, thunk, chunk_no: int):
         if not getattr(self, "watchdog_factor", None):
             return thunk()
         from .. import checkpoint as _ckpt

@@ -402,6 +402,42 @@ def write_snapshot(checker, carry, path: str, *, chunk: int,
     return manifest
 
 
+def retain_final_snapshot(checker, path: str) -> Optional[dict]:
+    """The warm-start half of the resident service's incremental
+    re-check (ROADMAP direction 4, stateright_tpu/serve.py): package a
+    COMPLETED device run's final chunk carry as an ordinary snapshot.
+    The carry of a finished search holds the whole visited set, the
+    parent forest, the discovery lanes, and ``done=True`` — so a later
+    checker whose :func:`encoding_fingerprint` matches can
+    :func:`resume_from` it and settle in one chunk with zero new waves
+    dispatched, counts bit-identical to the cold run (the same
+    validation/re-shard seam applies: an EDITED model changes the
+    fingerprint and refuses, which is the service's cue to run cold).
+
+    Requires the run to have kept its final carry
+    (``checker.keep_final_carry = True`` before join — the existing
+    tools/profile_stages.py capture hook). Returns the manifest, or
+    None when there is nothing retainable: no final carry, a run that
+    raised, or a TIERED run (its visited set lives partly in host cold
+    runs; retaining only the device carry would warm-start from a
+    subset and silently re-explore — refuse instead of approximating).
+    """
+    carry = getattr(checker, "_final_carry", None)
+    if carry is None or checker._run_error is not None:
+        return None
+    metrics = getattr(checker, "metrics", None) or {}
+    if metrics.get("tier_spills"):
+        return None
+    lat = getattr(checker, "_lat", None) or {}
+    return write_snapshot(
+        checker, carry, path,
+        chunk=int(lat.get("chunks") or 0),
+        wave=int(metrics.get("waves") or 0),
+        depth=int(checker._max_depth),
+        unique=int(checker._unique_states),
+    )
+
+
 # -- resume ---------------------------------------------------------------
 
 

@@ -31,6 +31,7 @@ Diff two trace artifacts with ``tools/trace_diff.py``.
 from __future__ import annotations
 
 import sys
+import threading
 
 from .actor.network import Network
 from .report import WriteReporter
@@ -49,12 +50,43 @@ def _network(args: list[str], index: int) -> Network:
     return Network.from_name(name)
 
 
+class _ThreadLocalRuntime:
+    """Dict-like, PER-THREAD runtime-flag store: ``main()`` is
+    re-entered in-process (tests, embedders) and — since the resident
+    service (stateright_tpu/serve.py) runs sessions on concurrent
+    HTTP threads — a process-global dict would let one invocation's
+    reset silently wipe another thread's popped flags between its pop
+    and its ``_apply_runtime``. Each thread sees its own copy,
+    initialized to the defaults; supports exactly the dict surface
+    the flag plumbing uses (``[]`` get/set, ``update(**kw)``)."""
+
+    def __init__(self, **defaults):
+        self._defaults = dict(defaults)
+        self._tls = threading.local()
+
+    def _cfg(self) -> dict:
+        cfg = getattr(self._tls, "cfg", None)
+        if cfg is None:
+            cfg = dict(self._defaults)
+            self._tls.cfg = cfg
+        return cfg
+
+    def __getitem__(self, key):
+        return self._cfg()[key]
+
+    def __setitem__(self, key, value):
+        self._cfg()[key] = value
+
+    def update(self, **kw) -> None:
+        self._cfg().update(kw)
+
+
 #: runtime flags popped by main() and applied at the one reporting
 #: seam every check lane shares (_report): checkpoint/resume
 #: (stateright_tpu/checkpoint.py) and the waves-per-sync override
 #: (sets the chunk cadence — and therefore the checkpoint cadence —
-#: without a per-lane knob).
-_RUNTIME: dict = dict(
+#: without a per-lane knob). Thread-scoped — see _ThreadLocalRuntime.
+_RUNTIME = _ThreadLocalRuntime(
     checkpoint_every=None, checkpoint_path=None, resume=False,
     resume_any_sha=False, waves_per_sync=None, tier_hot_rows=None,
     degrade_on_fault=False, watchdog=None, straggler_factor=None,
@@ -114,14 +146,32 @@ def _apply_runtime(checker) -> None:
         )
 
 
+#: thread-scoped session hook (the resident service,
+#: stateright_tpu/serve.py): the service installs a callback here
+#: around each session's handler call, and ``_report`` runs it on the
+#: freshly-spawned checker BEFORE the first join — admission, warm
+#: start, the FIFO device gate, retention arming. Thread-local so
+#: concurrent service sessions (and a plain in-process ``main()``
+#: embedder on another thread) never see each other's hook. This is
+#: also why a second same-config check in one process provably hits
+#: the ``in_process`` compile-ledger tier: every lane funnels its
+#: checker through THIS one seam, whose engines share the process
+#: program cache (tests/test_serve.py pins the tier).
+_SESSION_HOOK = threading.local()
+
+
 def _report(checker, out=None) -> None:
     """The one reporting path every check lane shares: the reference-
     format ``Reporter`` (report.rs:60-98) — no lane formats privately
     (tests/test_report.py pins the format through this seam). Also
-    the seam the popped runtime flags (checkpoint/resume) land on:
+    the seam the popped runtime flags (checkpoint/resume) land on —
+    and the seam the resident service intercepts (``_SESSION_HOOK``):
     every check lane passes its checker through here before the first
     join."""
     _apply_runtime(checker)
+    hook = getattr(_SESSION_HOOK, "hook", None)
+    if hook is not None:
+        hook(checker)
     checker.report(WriteReporter(out if out is not None else sys.stdout))
 
 
@@ -489,6 +539,12 @@ def _usage(model: str | None = None) -> None:
             if model == "panic":
                 extra = ""  # fixed harness: no count, no network
             print(f"  python -m stateright_tpu {model} {sub} {extra}")
+    if model is None:
+        print(
+            "  python -m stateright_tpu serve [HOST:PORT] "
+            "[--explore=MODEL[,COUNT]] [--program-budget-bytes=N] "
+            "[--device-budget-bytes=N] [--no-warm-start]"
+        )
     print(f"NETWORK: {' | '.join(Network.names())}")
     print(
         "FLAGS: --trace[=deep] on any check lane writes TRACE_r*.jsonl"
@@ -526,6 +582,35 @@ def _usage(model: str | None = None) -> None:
         "(traced mesh runs; sustained stragglers feed the failure "
         "classifier)"
     )
+    print(
+        "       `serve` runs the resident multi-tenant checking "
+        "service (stateright_tpu/serve.py): one warm process, a FIFO "
+        "device queue, a byte-budget LRU of compiled programs, "
+        "fingerprint-stable warm-start re-checks, and an optional "
+        "Explorer mount; --connect=HOST:PORT on any check lane ships "
+        "it to a running service (counts bit-identical, compile "
+        "amortized)"
+    )
+
+
+def _pop_connect_flag(argv: list[str]) -> tuple[str | None, list[str]]:
+    """Strip ``--connect=HOST:PORT`` from anywhere in argv: client
+    mode — the remaining lane argv ships to a resident checking
+    service (stateright_tpu/serve.py) instead of running cold in this
+    process. Counts are bit-identical (the service runs the same
+    handler, warm); latency skips the per-process compile."""
+    addr = None
+    rest = []
+    for a in argv:
+        if a.startswith("--connect="):
+            addr = a.split("=", 1)[1]
+        elif a == "--connect":
+            raise SystemExit(
+                "--connect needs an address: --connect=HOST:PORT"
+            )
+        else:
+            rest.append(a)
+    return addr, rest
 
 
 def _pop_trace_flag(argv: list[str]) -> tuple[str | None, list[str]]:
@@ -626,6 +711,17 @@ def main(argv: list[str] | None = None) -> None:
         tier_hot_rows=None, degrade_on_fault=False, watchdog=None,
         straggler_factor=None,
     )
+    # resident-service lanes (ROADMAP direction 4, serve.py): the
+    # daemon, and the client mode that ships a lane to one
+    connect, argv = _pop_connect_flag(argv)
+    if argv and argv[0] == "serve":
+        from . import serve
+
+        raise SystemExit(serve.daemon_main(argv[1:]))
+    if connect is not None:
+        from . import serve
+
+        raise SystemExit(serve.client_main(connect, argv))
     trace_level, argv = _pop_trace_flag(argv)
     argv = _pop_runtime_flags(argv)
     if not argv or argv[0] not in _MODELS:

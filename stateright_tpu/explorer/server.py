@@ -110,7 +110,15 @@ def _live_status_view(checker, snapshot: Optional[Snapshot]) -> dict:
     handler lock existed, the accessor path could re-enter the
     running search from another thread). Reads of live attributes
     are GIL-atomic; the values are a consistent-enough snapshot for
-    a progress display."""
+    a progress display.
+
+    This view covers ONE checker (the mounted Explorer model). It
+    used to be the server's whole status; under the resident service
+    (stateright_tpu/serve.py) the same lock-free-snapshot rule
+    extends to the multi-session registry — ``make_server`` appends
+    ``registry.status_block()`` (every session's live state, the
+    program-LRU bytes) as the ``service`` field, so the single-
+    checker assumption lives only here, not in the HTTP surface."""
     props = []
     for prop in checker.model.properties():
         disc = checker._discoveries.get(prop.name)
@@ -218,8 +226,35 @@ def serve(builder: CheckerBuilder, addr: str):
     return checker
 
 
-def make_server(checker, snapshot, host: str, port: int) -> ThreadingHTTPServer:
-    """Build (without starting) the HTTP server — separable for tests."""
+def make_server(checker, snapshot, host: str, port: int,
+                registry=None) -> ThreadingHTTPServer:
+    """Build (without starting) the HTTP server — separable for tests.
+
+    Single-checker by default (``registry=None``: the historical
+    Explorer server, byte-identical behavior — the smoke tests pin
+    it). The resident service (stateright_tpu/serve.py) passes itself
+    as ``registry`` to mount BOTH tenancies on one server; the
+    protocol is three methods:
+
+    * ``handle_request(handler, method, path) -> bool`` — service
+      routes (``POST /.check``, ``GET /.serve/sessions``, ...), tried
+      BEFORE the Explorer's; True means handled.
+    * ``request_scope() -> context manager`` — installed around each
+      Explorer request, so the service's explorer-session tracer
+      meters the per-request spans instead of the process tracer.
+    * ``status_block() -> dict`` — appended to ``/.status`` as
+      ``sessions``: the lock-free snapshot rule that view documents
+      generalizes from one checker's live counters to the service's
+      whole session registry (GIL-atomic attribute reads on both
+      sides, so progress polls keep answering mid-run).
+
+    ``checker`` may be None only with a registry (a service with no
+    Explorer mounted): explorer routes then 404 while service routes
+    still answer."""
+    if checker is None and registry is None:
+        raise ValueError(
+            "make_server needs a checker, a registry, or both"
+        )
 
     # One lock serializes every handler section that touches checker
     # state: the on-demand checker's dicts are not thread-safe under
@@ -253,15 +288,41 @@ def make_server(checker, snapshot, host: str, port: int) -> ThreadingHTTPServer:
         # the cache-hit state (whether the request was served entirely
         # from already-explored states or pulled new ones into the
         # on-demand search). The span API's no-op path keeps untraced
-        # serving cost-free; with a process tracer active each request
-        # lands as one span event in the TRACE artifact.
+        # serving cost-free; with a tracer active each request lands
+        # as one span event in the TRACE artifact — the service's
+        # request_scope routes them into its explorer session.
 
-        def do_GET(self):
+        def _dispatch(self, method):
+            if registry is not None:
+                if registry.handle_request(self, method, self.path):
+                    return
+                scope = registry.request_scope()
+            else:
+                scope = None
+            if checker is None:
+                self._err("not found")
+                return
+            if scope is None:
+                self._explorer_request(method)
+            else:
+                with scope:
+                    self._explorer_request(method)
+
+        def _explorer_request(self, method):
             with telemetry.span(
-                "explorer_request", method="GET",
+                "explorer_request", method=method,
                 path=self.path.split("?", 1)[0],
             ) as meta:
-                self._get(meta)
+                if method == "GET":
+                    self._get(meta)
+                else:
+                    self._post(meta)
+
+        def do_GET(self):
+            self._dispatch("GET")
+
+        def do_POST(self):
+            self._dispatch("POST")
 
         def _get(self, meta):
             if self.path in _UI_FILES:
@@ -275,11 +336,16 @@ def make_server(checker, snapshot, host: str, port: int) -> ThreadingHTTPServer:
                 self.wfile.write(data)
             elif self.path == "/.status":
                 # a status poll never explores: always a cache hit —
-                # and deliberately LOCK-FREE (live attributes only),
-                # so progress polls keep answering while a
-                # run_to_completion holds the checker lock
+                # and deliberately LOCK-FREE (live attributes only,
+                # and the registry's own lock-free snapshot), so
+                # progress polls keep answering while a
+                # run_to_completion holds the checker lock or a
+                # service session holds the device
                 meta["kind"], meta["cache_hit"] = "status", True
-                self._json(_live_status_view(checker, snapshot))
+                view = _live_status_view(checker, snapshot)
+                if registry is not None:
+                    view["service"] = registry.status_block()
+                self._json(view)
             elif self.path.startswith("/.states"):
                 meta["kind"] = "states"
                 # ``_unique_states`` is a live attribute (no run
@@ -305,24 +371,20 @@ def make_server(checker, snapshot, host: str, port: int) -> ThreadingHTTPServer:
                 meta["error"] = "not found"
                 self._err("not found")
 
-        def do_POST(self):
-            with telemetry.span(
-                "explorer_request", method="POST",
-                path=self.path.split("?", 1)[0],
-            ) as meta:
-                if self.path == "/.runtocompletion":
-                    meta["kind"] = "run_to_completion"
-                    with checker_lock:
-                        before = checker._unique_states
-                        checker.run_to_completion()
-                        meta["cache_hit"] = (
-                            checker._unique_states == before
-                        )
-                    self.send_response(200)
-                    self.send_header("Content-Length", "0")
-                    self.end_headers()
-                else:
-                    meta["error"] = "not found"
-                    self._err("not found")
+        def _post(self, meta):
+            if self.path == "/.runtocompletion":
+                meta["kind"] = "run_to_completion"
+                with checker_lock:
+                    before = checker._unique_states
+                    checker.run_to_completion()
+                    meta["cache_hit"] = (
+                        checker._unique_states == before
+                    )
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+            else:
+                meta["error"] = "not found"
+                self._err("not found")
 
     return ThreadingHTTPServer((host, port), Handler)

@@ -116,6 +116,38 @@ def plan_total(entries) -> int:
     return int(sum(e["bytes"] for e in entries))
 
 
+def session_resident_bytes(checker) -> dict:
+    """Pre-run admission pricing for the resident service
+    (stateright_tpu/serve.py): the dominant resident-buffer rows of a
+    device checker, derivable from CONFIG ALONE — no program build, no
+    device work — so the service can refuse an oversized session
+    BEFORE it touches the device. Prices the same quantities the full
+    ledger declares (visited keys + parent forest via the engine's own
+    ``_visited_bytes_per_row``, the frontier block, the candidate
+    buffer), as a documented FLOOR: per-ladder-class staging and
+    compiled temp bytes land on top once programs build, which is why
+    admission compares against a budget the operator sets with
+    headroom. Returns ``{visited_bytes, frontier_bytes, cand_bytes,
+    total_bytes}``."""
+    bpr = int(checker._visited_bytes_per_row())
+    n_shards = int(getattr(checker, "n_shards", 1))
+    W = int(checker.encoded.width)
+    K = int(checker.encoded.max_actions)
+    F = int(checker.frontier_capacity)
+    visited = int(checker.total_capacity) * bpr
+    frontier = n_shards * F * W * 4
+    cand = checker.cand_capacity
+    if cand in (None, "auto"):
+        cand = F * K  # the no-compaction static bound
+    cand_bytes = n_shards * int(cand) * W * 4
+    return dict(
+        visited_bytes=int(visited),
+        frontier_bytes=int(frontier),
+        cand_bytes=int(cand_bytes),
+        total_bytes=int(visited + frontier + cand_bytes),
+    )
+
+
 def v_class_entries(v_ladder, nf_max: int) -> list:
     """Per-VISITED-ladder-class merge-scratch rows, shared by both
     sort-merge engines' ``_build_info`` (one pricing, no drift): the

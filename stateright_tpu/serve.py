@@ -1,0 +1,1013 @@
+"""The resident multi-tenant checking service (ROADMAP direction 4).
+
+Every check used to be a cold process: the ~106 ms sync floor, the
+multi-second XLA compiles (TRACE_r21 measured a 17.9 s persistent-cache
+disk fetch inside chunk 0), and the whole exploration were paid per
+query. This module keeps ONE warm process resident on the device and
+serves many concurrent sessions from it — the
+checking-as-a-cloud-service framing of arXiv:1203.6806 and the
+portable-caching / warm-start framing of arXiv:2603.09555:
+
+* **Sessions** (:class:`CheckService.check`): each query — a CLI check
+  lane (``paxos check-tpu 2``, same argv, bit-identical counts to a
+  cold process) or an Explorer browse — runs as one :class:`Session`
+  with its OWN :class:`~stateright_tpu.telemetry.RunTracer` installed
+  thread-locally (``activate_thread``), so concurrent sessions trace
+  into disjoint event streams with zero cross-session bleed. The
+  service intercepts the checker at the CLI's one ``_report`` seam
+  (cli.py) — the same seam the checkpoint/resume flags land on.
+* **FIFO device queue** (:class:`FifoLock`): every engine chunk
+  dispatch+sync acquires the service's gate (the ``dispatch_gate``
+  seam in checkers/tpu.py, the one funnel both the untiered and
+  tiered chunk loops pass through), so concurrent sessions interleave
+  at chunk granularity in strict arrival order instead of racing the
+  device. Per-session queue wait is accumulated and reported.
+* **Admission** (:func:`~stateright_tpu.memplan.session_resident_bytes`):
+  a device session's dominant resident bytes are priced from config
+  alone and checked against the service's device budget BEFORE any
+  program build or device work — an oversized query is refused loudly
+  (:class:`AdmissionRefused`), not discovered mid-run as an OOM.
+* **Compiled-program LRU**: the engines' ``_programs``/XLA chunk cache
+  (checkers/tpu.py ``_CHUNK_CACHE``) grows one entry per distinct
+  program key; the service bounds it by BYTES — each entry priced by
+  the memplan ledger total of the session that used it — evicting
+  least-recently-used entries past ``program_budget_bytes`` (a
+  re-submitted evicted query recompiles, or re-fetches from the
+  persistent XLA disk cache; counts are unaffected).
+* **Incremental re-check / warm start**: a completed device session's
+  final chunk carry is retained as a snapshot
+  (:func:`~stateright_tpu.checkpoint.retain_final_snapshot`, keyed by
+  :func:`~stateright_tpu.checkpoint.encoding_fingerprint`). A
+  re-submitted model whose fingerprint matches resumes from the
+  retained visited set through the existing checkpoint/restore seam —
+  uploads, re-shards if the layout changed — and settles in one chunk
+  with zero new waves, instead of re-exploring from wave 0. An edited
+  model changes the fingerprint, the resume refuses, and the session
+  runs cold: correctness never rides the cache.
+* **HTTP surface**: the service mounts on the Explorer's server
+  (explorer/server.py ``make_server(registry=...)``) — Explorer
+  browse/``run_to_completion`` queries keep their per-request spans
+  and checker lock, ``POST /.check`` runs a CLI session remotely
+  (the ``stateright_tpu --connect`` client mode), ``GET
+  /.serve/sessions`` lists sessions, ``POST /.serve/trace`` exports
+  the merged trace.
+* **Reporting**: :meth:`CheckService.write_trace` merges every
+  session's events into one TRACE artifact (one run index per
+  session, ``session_begin``/``session_end``/``program_evict``
+  service events); :func:`serve_summary` derives the per-session
+  time-to-verdict / queue-wait / compile-tier / cache-hit tables
+  tools/serve_report.py renders into auto-numbered ``SERVE_r*.json``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import itertools
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from collections import OrderedDict, deque
+from contextlib import nullcontext
+from typing import Optional
+
+from . import checkpoint, memplan, telemetry
+
+
+class AdmissionRefused(RuntimeError):
+    """The session's projected resident bytes exceed the service's
+    device budget — refused BEFORE any program build or device work."""
+
+
+#: session argv must be plain lane argv: the runtime flags would
+#: fight the service's own machinery — --trace wants the process
+#: tracer the per-session tracers replace, --checkpoint/--resume
+#: would race the warm-start retention on the same engine seams —
+#: so telemetry and durability are the SERVICE's job, refused loudly.
+_FLAG_REFUSAL = (
+    "service sessions take plain lane argv (e.g. ['paxos', "
+    "'check-tpu', '2']); runtime flags are process-global and are "
+    "the service's job — telemetry via the per-session tracer / "
+    "write_trace(), durability via warm-start retention"
+)
+
+
+# -- FIFO device queue ----------------------------------------------------
+
+
+class FifoLock:
+    """A FIFO-fair mutex: acquirers are served strictly in arrival
+    order. ``threading.Lock`` makes no fairness promise — under
+    contention one session could starve while another hogs the device
+    — and the service's latency-per-query story needs queue wait to be
+    arrival-ordered and therefore boundable. Release HANDS OFF to the
+    oldest waiter (the lock never goes briefly free for a newcomer to
+    steal)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._waiters: deque = deque()
+        self._locked = False
+
+    def acquire(self) -> None:
+        with self._mu:
+            if not self._locked and not self._waiters:
+                self._locked = True
+                return
+            ev = threading.Event()
+            self._waiters.append(ev)
+        ev.wait()
+
+    def release(self) -> None:
+        with self._mu:
+            if self._waiters:
+                self._waiters.popleft().set()  # hand-off: stays locked
+            else:
+                self._locked = False
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class _GateHandle:
+    """The per-session view of the service's device gate, installed as
+    the engine's ``dispatch_gate``: acquiring accumulates this
+    session's queue wait (the latency-per-query lane serve_report
+    prints), releasing hands the device to the next queued session."""
+
+    __slots__ = ("_gate", "_session")
+
+    def __init__(self, gate: FifoLock, session: "Session"):
+        self._gate = gate
+        self._session = session
+
+    def __enter__(self):
+        t0 = time.monotonic()
+        self._gate.acquire()
+        self._session.gate_wait_sec += time.monotonic() - t0
+        return self
+
+    def __exit__(self, *exc):
+        self._gate.release()
+        return False
+
+
+# -- per-thread stdout capture --------------------------------------------
+
+
+class _ThreadLocalStdout:
+    """A ``sys.stdout`` proxy with a per-thread target: the service
+    captures each session's handler output (the CLI lanes print their
+    reference-format report to stdout) WITHOUT redirecting other
+    threads — ``contextlib.redirect_stdout`` swaps the process-global
+    stream and would bleed concurrent sessions into each other.
+    Threads with no target installed write through to the real
+    stream untouched."""
+
+    def __init__(self, real):
+        self._real = real
+        self._tls = threading.local()
+
+    def push(self, target) -> None:
+        self._tls.target = target
+
+    def pop(self) -> None:
+        self._tls.target = None
+
+    def _target(self):
+        return getattr(self._tls, "target", None) or self._real
+
+    def write(self, s):
+        return self._target().write(s)
+
+    def flush(self):
+        t = self._target()
+        flush = getattr(t, "flush", None)
+        if flush is not None:
+            flush()
+
+    def __getattr__(self, name):
+        return getattr(self._real, name)
+
+
+def _stdout_proxy() -> _ThreadLocalStdout:
+    """Install (idempotently) the thread-local stdout proxy over the
+    CURRENT ``sys.stdout`` — re-wrapping whatever stream a test
+    harness may have installed since the last call."""
+    cur = sys.stdout
+    if isinstance(cur, _ThreadLocalStdout):
+        return cur
+    proxy = _ThreadLocalStdout(cur)
+    sys.stdout = proxy
+    return proxy
+
+
+# -- sessions -------------------------------------------------------------
+
+
+class Session:
+    """One query's lifecycle record: identity, lane argv, state
+    machine (queued → running → done/failed/refused; Explorer mounts
+    stay ``serving``), the per-session tracer, timing lanes (admission
+    wait, accumulated device-queue wait, wall), outcome counts, and
+    the byte/cache attribution (admitted bytes, program key,
+    evictions this session triggered)."""
+
+    def __init__(self, sid: int, kind: str, argv):
+        self.id = sid
+        self.kind = kind
+        self.argv = tuple(argv)
+        self.state = "queued"
+        self.error: Optional[str] = None
+        self.output: Optional[str] = None
+        self.tracer = None
+        self.checker = None
+        self.device = False
+        self.running = False
+        self.warm_start = False
+        self.encoding_fp: Optional[str] = None
+        self.program_key: Optional[str] = None
+        self.admitted_bytes: Optional[int] = None
+        self.plan_bytes: Optional[int] = None
+        self.unique: Optional[int] = None
+        self.total: Optional[int] = None
+        self.evictions: list = []
+        self.gate_wait_sec = 0.0
+        self.t_submit = time.monotonic()
+        self.t_admit: Optional[float] = None
+        self.t_start: Optional[float] = None
+        self.t_end: Optional[float] = None
+
+    def describe(self) -> dict:
+        return dict(
+            session=self.id,
+            kind=self.kind,
+            lane=" ".join(self.argv),
+            state=self.state,
+            error=self.error,
+            warm_start=self.warm_start,
+            admitted_bytes=self.admitted_bytes,
+            queue_wait_sec=round(self.gate_wait_sec, 6),
+            unique=self.unique,
+            total=self.total,
+            duration_sec=(
+                round(self.t_end - self.t_start, 6)
+                if self.t_end is not None and self.t_start is not None
+                else None
+            ),
+        )
+
+
+class CheckService:
+    """The resident service: one warm process, many sessions (module
+    docstring). Thread-safe: ``check`` may be called concurrently from
+    any number of threads (the HTTP server's per-request threads, a
+    test's worker pool) — the FIFO gate arbitrates the device, the
+    admission lock arbitrates the byte budget, and per-session tracers
+    keep telemetry disjoint.
+
+    ``program_budget_bytes`` bounds the compiled-program LRU (None =
+    unbounded, the cold-process behavior); ``device_budget_bytes``
+    bounds admitted sessions' projected resident bytes (None = admit
+    everything); ``warm_start=False`` disables retention/resume (every
+    session explores from wave 0). ``max_retained_sessions`` bounds
+    the SETTLED-session registry — a resident daemon must not grow
+    per query, so once the bound is crossed the oldest settled check
+    sessions (their tracer events, captured output, and checker) are
+    dropped from the registry; they disappear from ``status_block``
+    and later ``write_trace`` exports (export before they rotate out
+    if you need them), while live and Explorer sessions are always
+    kept."""
+
+    def __init__(self, *, program_budget_bytes: Optional[int] = None,
+                 device_budget_bytes: Optional[int] = None,
+                 spool_dir: Optional[str] = None,
+                 warm_start: bool = True,
+                 max_retained_sessions: int = 256):
+        self.program_budget_bytes = program_budget_bytes
+        self.device_budget_bytes = device_budget_bytes
+        self.warm_start = warm_start
+        self.max_retained_sessions = max_retained_sessions
+        self.spool_dir = spool_dir or tempfile.mkdtemp(
+            prefix="stpu_serve_"
+        )
+        self._t0 = time.monotonic()
+        self._lock = threading.Lock()
+        self._gate = FifoLock()
+        self._sessions: list[Session] = []
+        self._ids = itertools.count()
+        #: encoding fingerprint -> retained warm-start snapshot path
+        self._warm: dict[str, str] = {}
+        #: program-key-hash -> {key, bytes}: the byte-priced LRU view
+        #: over the engines' _CHUNK_CACHE (most-recently-used last)
+        self._lru: "OrderedDict[str, dict]" = OrderedDict()
+        self._explorer = None  # (checker, snapshot, session)
+
+    # -- check sessions ---------------------------------------------------
+
+    def check(self, argv) -> Session:
+        """Run one CLI check lane as a session in the CALLING thread
+        (callers provide their own concurrency — the HTTP server's
+        request threads, a test's workers). Returns the settled
+        Session; the lane's stdout (the reference-format report) is in
+        ``session.output``, bit-identical in counts to a cold-process
+        run of the same argv. Raises ValueError on runtime flags in
+        the argv (see ``_FLAG_REFUSAL``); admission refusals and run
+        errors land on the session, not as raises."""
+        argv = [str(a) for a in argv]
+        if any(a.startswith("--") for a in argv):
+            raise ValueError(_FLAG_REFUSAL)
+        from . import cli
+
+        # only MODEL lanes are sessions: in particular `serve` must
+        # never recurse into a nested daemon (a remote POST /.check
+        # {"argv": ["serve", ...]} would block this thread in a
+        # second serve_forever, forever)
+        if not argv or argv[0] not in cli._MODELS:
+            raise ValueError(
+                f"unknown session lane {argv[:1] or '(empty)'}: "
+                "service sessions run model check lanes only "
+                f"({' | '.join(sorted(cli._MODELS))})"
+            )
+
+        session = Session(next(self._ids), "check", argv)
+        session.tracer = telemetry.RunTracer()
+        with self._lock:
+            self._sessions.append(session)
+        proxy = _stdout_proxy()
+        buf = io.StringIO()
+        proxy.push(buf)
+        session.t_start = time.monotonic()
+        try:
+            with session.tracer.activate_thread():
+                cli._SESSION_HOOK.hook = self._session_hook(session)
+                try:
+                    cli.main(argv)
+                    session.state = "done"
+                except AdmissionRefused as exc:
+                    session.state = "refused"
+                    session.error = str(exc)
+                    print(f"REFUSED: {exc}")
+                except SystemExit as exc:
+                    code = exc.code
+                    if code in (None, 0):
+                        session.state = "done"
+                    else:
+                        session.state = "failed"
+                        session.error = str(code)
+                except Exception as exc:
+                    session.state = "failed"
+                    session.error = f"{type(exc).__name__}: {exc}"
+                finally:
+                    cli._SESSION_HOOK.hook = None
+                # retention + attribution while the session tracer is
+                # still the thread's tracer, so the checkpoint event
+                # of the retained snapshot lands in THIS trace
+                self._finish(session)
+        finally:
+            proxy.pop()
+            session.output = buf.getvalue()
+            session.t_end = time.monotonic()
+            session.running = False
+            self._trim_sessions()
+        return session
+
+    def _trim_sessions(self) -> None:
+        """Bound the settled-session registry (the resident process
+        must not grow per query): drop the oldest settled check
+        sessions past ``max_retained_sessions``. Live (running /
+        queued) and Explorer sessions always stay."""
+        cap = self.max_retained_sessions
+        if cap is None:
+            return
+        with self._lock:
+            settled = [s for s in self._sessions
+                       if s.kind == "check" and not s.running
+                       and s.t_end is not None]
+            excess = len(settled) - cap
+            if excess <= 0:
+                return
+            drop = set(id(s) for s in settled[:excess])
+            self._sessions = [s for s in self._sessions
+                              if id(s) not in drop]
+
+    def _session_hook(self, session: Session):
+        """The callback cli._report runs on the freshly-spawned
+        checker, before its first join: admission, warm-start staging,
+        the FIFO gate, and final-carry retention arming — everything
+        the service needs, at the one seam every check lane shares."""
+
+        def hook(checker) -> None:
+            session.checker = checker
+            if not hasattr(checker, "_run_attempt"):
+                # host engines: no device work to admit or gate; the
+                # session still traces and reports
+                session.t_admit = time.monotonic()
+                session.running = True
+                return
+            session.device = True
+            self._admit(session, checker)
+            if self.warm_start:
+                fp = checkpoint.encoding_fingerprint(checker)
+                session.encoding_fp = fp
+                path = self._warm.get(fp)
+                if path is not None:
+                    try:
+                        checker.resume_from(path)
+                        session.warm_start = True
+                    except checkpoint.SnapshotError:
+                        # stale/incompatible retention: run cold —
+                        # correctness never rides the cache
+                        session.warm_start = False
+            checker.keep_final_carry = True
+            checker.dispatch_gate = _GateHandle(self._gate, session)
+
+        return hook
+
+    def _admit(self, session: Session, checker) -> None:
+        """The admission check (ISSUE contract: against the capacity
+        pricing, BEFORE device work): projected resident bytes from
+        config alone vs the device budget minus in-flight sessions'
+        admissions. Refuses loudly; never queues an oversized query
+        into a mid-run OOM."""
+        est = memplan.session_resident_bytes(checker)
+        with self._lock:
+            in_flight = sum(
+                s.admitted_bytes or 0
+                for s in self._sessions
+                if s.running and s.device and s is not session
+            )
+            budget = self.device_budget_bytes
+            if (budget is not None
+                    and est["total_bytes"] + in_flight > budget):
+                session.error = (
+                    f"admission refused: session projects "
+                    f"{est['total_bytes']:,} resident bytes "
+                    f"(visited {est['visited_bytes']:,} + frontier "
+                    f"{est['frontier_bytes']:,} + candidates "
+                    f"{est['cand_bytes']:,}), {in_flight:,} already "
+                    f"in flight, device budget "
+                    f"{budget:,} — shrink the lane's capacity or "
+                    "raise the service budget"
+                )
+                raise AdmissionRefused(session.error)
+            session.admitted_bytes = est["total_bytes"]
+            session.t_admit = time.monotonic()
+            session.running = True
+
+    def _finish(self, session: Session) -> None:
+        checker = session.checker
+        if checker is None:
+            return
+        session.unique = getattr(checker, "_unique_states", None)
+        session.total = getattr(checker, "_total_states", None)
+        if not session.device or session.state != "done":
+            return
+        session.program_key = getattr(
+            checker, "_program_key_hash", None
+        )
+        plan = getattr(checker, "memory_plan", None)
+        if plan is not None:
+            session.plan_bytes = int(plan["total_bytes"])
+        if self.warm_start and session.encoding_fp:
+            key = hashlib.sha1(
+                session.encoding_fp.encode()
+            ).hexdigest()[:16]
+            path = os.path.join(self.spool_dir, f"warm_{key}.ckpt")
+            try:
+                manifest = checkpoint.retain_final_snapshot(
+                    checker, path
+                )
+                if manifest is not None:
+                    self._warm[session.encoding_fp] = path
+            except Exception:
+                pass  # retention is an optimization, never a failure
+        # the retained snapshot (or nothing) is the warm state now —
+        # drop the device-resident final carry so completed sessions
+        # don't pin HBM
+        checker._final_carry = None
+        self._lru_note(session, checker)
+
+    # -- compiled-program LRU ---------------------------------------------
+
+    def _lru_note(self, session: Session, checker) -> None:
+        """Record this session's program use in the byte-priced LRU
+        and evict past the budget. Attribution is EXACT: the checker's
+        ``_program_key_hash`` identifies its ``_CHUNK_CACHE`` entry
+        (the same key the XLA persistent cache derives from), and the
+        entry is priced by the session's memplan ledger total. The
+        entry the session just used is never evicted — the budget
+        bounds the TAIL, not the working program."""
+        from .checkers import tpu as _tpu
+
+        key_hash = session.program_key
+        if key_hash is None:
+            return
+        with self._lock:
+            entry = self._lru.get(key_hash)
+            if entry is None:
+                for key in list(_tpu._CHUNK_CACHE):
+                    if _tpu._key_hash(key) == key_hash:
+                        self._lru[key_hash] = dict(
+                            key=key,
+                            bytes=int(session.plan_bytes or 0),
+                        )
+                        break
+            else:
+                self._lru.move_to_end(key_hash)
+                if session.plan_bytes:
+                    entry["bytes"] = int(session.plan_bytes)
+            budget = self.program_budget_bytes
+            if budget is None:
+                return
+            total = sum(e["bytes"] for e in self._lru.values())
+            while total > budget and len(self._lru) > 1:
+                old_hash = next(iter(self._lru))
+                if old_hash == key_hash:
+                    break
+                entry = self._lru.pop(old_hash)
+                _tpu._CHUNK_CACHE.pop(entry["key"], None)
+                total -= entry["bytes"]
+                session.evictions.append(
+                    (old_hash, entry["bytes"])
+                )
+
+    def lru_bytes(self) -> int:
+        with self._lock:
+            return sum(e["bytes"] for e in self._lru.values())
+
+    # -- Explorer mount ---------------------------------------------------
+
+    def mount_explorer(self, builder, name: Optional[str] = None):
+        """Attach one Explorer model to the service: spawns the
+        on-demand checker, opens a long-lived ``explorer`` session
+        whose tracer meters every HTTP request (the round-14
+        ``explorer_request`` spans, installed around each request via
+        :meth:`request_scope`). Returns ``(checker, snapshot)`` for
+        :func:`explorer.server.make_server`."""
+        from .explorer.server import Snapshot
+
+        checker = builder.spawn_on_demand()
+        snapshot = Snapshot()
+        model = name or type(checker.model).__name__
+        session = Session(next(self._ids), "explorer", ("explore", model))
+        session.tracer = telemetry.RunTracer()
+        session.tracer.begin_run(
+            lane=dict(engine="explorer", model=model)
+        )
+        session.state = "serving"
+        session.t_admit = session.t_start = time.monotonic()
+        with self._lock:
+            self._sessions.append(session)
+            self._explorer = (checker, snapshot, session)
+        return checker, snapshot
+
+    def http_server(self, host: str, port: int):
+        """The service's HTTP server: the Explorer server (when one is
+        mounted) with the service's routes and session registry on top
+        — one server, both tenancies (explorer/server.py
+        ``make_server(registry=...)``)."""
+        from .explorer.server import Snapshot, make_server
+
+        if self._explorer is not None:
+            checker, snapshot, _ = self._explorer
+        else:
+            checker, snapshot = None, Snapshot()
+        return make_server(checker, snapshot, host, port,
+                           registry=self)
+
+    # -- the make_server registry protocol --------------------------------
+
+    def handle_request(self, handler, method: str, path: str) -> bool:
+        """Service routes, tried before the Explorer's: ``POST
+        /.check`` runs a session from JSON ``{"argv": [...]}`` (the
+        ``--connect`` client's endpoint), ``GET /.serve/sessions``
+        lists sessions, ``POST /.serve/trace`` exports the merged
+        TRACE artifact pair. Returns True when handled."""
+        if method == "POST" and path == "/.check":
+            try:
+                length = int(handler.headers.get("Content-Length") or 0)
+                body = json.loads(
+                    handler.rfile.read(length) or b"{}"
+                )
+                argv = [str(a) for a in (body.get("argv") or [])]
+                session = self.check(argv)
+            except (ValueError, TypeError) as exc:
+                handler._json(dict(ok=False, error=str(exc)), code=400)
+                return True
+            handler._json(dict(
+                ok=session.state == "done",
+                session=session.describe(),
+                output=session.output,
+            ))
+            return True
+        if method == "GET" and path == "/.serve/sessions":
+            handler._json(self.status_block())
+            return True
+        if method == "POST" and path == "/.serve/trace":
+            jsonl, chrome = self.write_trace()
+            handler._json(dict(jsonl=jsonl, chrome=chrome))
+            return True
+        return False
+
+    def request_scope(self):
+        """Context manager installed around each Explorer request: the
+        explorer session's tracer becomes the request thread's tracer,
+        so the per-request spans land in that session's stream."""
+        ex = self._explorer
+        if ex is None:
+            return nullcontext()
+        return ex[2].tracer.activate_thread()
+
+    def status_block(self) -> dict:
+        """Lock-free-readable service snapshot for ``/.status`` /
+        ``/.serve/sessions`` (GIL-atomic attribute reads, the Explorer
+        status view's progress-poll contract)."""
+        with self._lock:
+            sessions = [s.describe() for s in self._sessions]
+            lru_bytes = sum(e["bytes"] for e in self._lru.values())
+            lru_len = len(self._lru)
+        return dict(
+            sessions=sessions,
+            programs=dict(
+                cached=lru_len,
+                bytes=lru_bytes,
+                budget_bytes=self.program_budget_bytes,
+            ),
+            device_budget_bytes=self.device_budget_bytes,
+            warm_models=len(self._warm),
+        )
+
+    # -- merged trace export ----------------------------------------------
+
+    def events(self) -> list:
+        """Merge every session's tracer events into ONE stream:
+        sessions get disjoint run indices (submission order), times
+        rebase to the service clock, and each session is bracketed by
+        ``session_begin``/``session_end`` service events (plus
+        ``program_evict`` rows for evictions it triggered). The result
+        validates under telemetry.validate_events and diffs/derives
+        like any TRACE."""
+        with self._lock:
+            sessions = list(self._sessions)
+        out: list[dict] = []
+        base = 0
+        now = time.monotonic()
+        for s in sessions:
+            tracer = s.tracer
+            evs: list[dict] = []
+            if tracer is not None:
+                # NB: a live Explorer session's run stays OPEN — an
+                # export is a read, not a shutdown, and must be
+                # idempotent (the session keeps serving and later
+                # exports see the later requests); a run without a
+                # run_end is valid to every consumer (validate_events,
+                # _run_view, serve_summary)
+                with tracer._lock:
+                    evs = [dict(e) for e in tracer.events]
+            runs = sorted({
+                e["run"] for e in evs
+                if isinstance(e.get("run"), int) and e["run"] >= 0
+            }) or [0]
+            run_map = {r: base + i for i, r in enumerate(runs)}
+            rb = base
+            offset = ((tracer._t_base - self._t0)
+                      if tracer is not None else 0.0)
+            t_admit = s.t_admit if s.t_admit is not None else s.t_submit
+            out.append(dict(
+                ev="session_begin", run=rb, session=s.id,
+                kind=s.kind, t=round(t_admit - self._t0, 6),
+                lane=" ".join(s.argv),
+                admitted_bytes=s.admitted_bytes,
+                admission_wait_sec=round(t_admit - s.t_submit, 6),
+                warm_start=s.warm_start,
+            ))
+            for e in evs:
+                r = e.get("run")
+                if isinstance(r, int):
+                    e["run"] = run_map.get(r, rb)
+                for k in ("t", "t0", "t1"):
+                    v = e.get(k)
+                    if isinstance(v, (int, float)):
+                        e[k] = round(v + offset, 6)
+                out.append(e)
+            t_end = s.t_end if s.t_end is not None else now
+            out.append(dict(
+                ev="session_end", run=rb, session=s.id,
+                state=s.state, t=round(t_end - self._t0, 6),
+                error=s.error, unique=s.unique, total=s.total,
+                queue_wait_sec=round(s.gate_wait_sec, 6),
+                warm_start=s.warm_start,
+                program_key=s.program_key,
+                duration_sec=(
+                    round(t_end - s.t_start, 6)
+                    if s.t_start is not None else None
+                ),
+            ))
+            for key_hash, nbytes in s.evictions:
+                out.append(dict(
+                    ev="program_evict", run=rb, key=key_hash,
+                    bytes=int(nbytes), t=round(t_end - self._t0, 6),
+                ))
+            base += len(runs)
+        return out
+
+    def write_trace(self, root: Optional[str] = None,
+                    round: Optional[int] = None) -> tuple[str, str]:
+        """Export the merged stream as an auto-numbered TRACE artifact
+        pair (JSONL + Chrome trace) — the input tools/serve_report.py
+        derives ``SERVE_r*`` from."""
+        tracer = telemetry.RunTracer()
+        tracer.events = self.events()
+        return telemetry.write_artifacts(tracer, root=root,
+                                         round=round)
+
+
+# -- the derived per-session summary (tools/serve_report.py) --------------
+
+
+def serve_summary(events: list) -> Optional[dict]:
+    """Derive the per-session latency-per-query view from a service
+    trace's ``session_begin``/``session_end`` events and each
+    session's run events: time-to-verdict, queue wait, compile-tier
+    ledger, cache hits, and the warm-vs-cold pairing (repeat queries
+    of one program key vs their cold first query, with the
+    time-to-verdict delta attributed between the compile tier and
+    dispatch). None when the trace carries no session events (not a
+    service trace) — serve_report exits 2 on that."""
+    from .telemetry import _run_view
+
+    begins = [e for e in events if e.get("ev") == "session_begin"]
+    if not begins:
+        return None
+    ends = {e["session"]: e for e in events
+            if e.get("ev") == "session_end"}
+    sessions = []
+    for sb in sorted(begins, key=lambda e: e["session"]):
+        run = sb["run"]
+        view = _run_view(events, run)
+        se = ends.get(sb["session"], {})
+        tiers: dict[str, int] = {}
+        for b in view["builds"]:
+            tiers[b["tier"]] = tiers.get(b["tier"], 0) + 1
+        build_wall = sum(
+            b.get("wall_sec") or 0.0 for b in view["builds"]
+        )
+        cold = sum(b.get("cold_sec") or 0.0 for b in view["builds"])
+        t0_run = (view["begin"] or {}).get("t", sb["t"])
+        verdicts = [
+            dict(
+                {k: v for k, v in ev.items()
+                 if k not in ("ev", "run", "t")},
+                t_since_run=round(ev["t"] - t0_run, 6),
+            )
+            for ev in view["verdicts"]
+        ]
+        ttv = max(
+            (v["t_since_run"] for v in verdicts), default=None
+        )
+        prof = view["latency_profile"] or {}
+        spans = [s for s in view["spans"]
+                 if s.get("phase") == "explorer_request"]
+        sessions.append(dict(
+            session=sb["session"],
+            run=run,
+            kind=sb["kind"],
+            lane=sb.get("lane"),
+            state=se.get("state"),
+            error=se.get("error"),
+            warm_start=bool(se.get("warm_start",
+                                   sb.get("warm_start"))),
+            admitted_bytes=sb.get("admitted_bytes"),
+            admission_wait_sec=sb.get("admission_wait_sec"),
+            queue_wait_sec=se.get("queue_wait_sec"),
+            unique=se.get("unique"),
+            total=se.get("total"),
+            duration_sec=se.get("duration_sec"),
+            chunks=prof.get("chunks"),
+            waves=prof.get("waves"),
+            resumed_from_wave=prof.get("resumed_from_wave"),
+            time_to_first_wave_sec=prof.get(
+                "time_to_first_wave_sec"
+            ),
+            dispatch_net_sec=prof.get("dispatch_net_sec"),
+            fetch_sec=prof.get("fetch_sec"),
+            time_to_verdict_sec=ttv,
+            verdicts=verdicts,
+            builds=dict(
+                tiers=tiers,
+                wall_sec=round(build_wall, 6),
+                cold_sec=round(cold, 6),
+            ),
+            program_key=se.get("program_key"),
+            explorer=(dict(
+                requests=len(spans),
+                cache_hits=sum(
+                    1 for s in spans if s.get("cache_hit")
+                ),
+            ) if spans else None),
+        ))
+    evictions = [
+        {k: v for k, v in e.items() if k != "ev"}
+        for e in events if e.get("ev") == "program_evict"
+    ]
+    return dict(
+        sessions=sessions,
+        evictions=evictions,
+        warm_vs_cold=_warm_vs_cold(sessions),
+    )
+
+
+def _warm_vs_cold(sessions: list) -> list:
+    """Pair repeat check queries with their cold first query (same
+    program key): per pair, the time-to-verdict delta and where the
+    ledger says it went — the compile tier (build walls) vs dispatch
+    proper (``dispatch_net_sec``, compile already subtracted). The
+    acceptance read: a healthy warm query's ttv sits below the cold
+    one with the difference on the compile side."""
+    by_key: dict[str, list] = {}
+    for s in sessions:
+        if s["kind"] == "check" and s.get("program_key"):
+            by_key.setdefault(s["program_key"], []).append(s)
+    out = []
+    for key, group in sorted(by_key.items()):
+        if len(group) < 2:
+            continue
+        cold = group[0]
+        for warm in group[1:]:
+            c_ttv, w_ttv = (cold.get("time_to_verdict_sec"),
+                            warm.get("time_to_verdict_sec"))
+            out.append(dict(
+                program_key=key,
+                cold_session=cold["session"],
+                warm_session=warm["session"],
+                warm_start=warm.get("warm_start"),
+                cold_ttv_sec=c_ttv,
+                warm_ttv_sec=w_ttv,
+                ttv_delta_sec=(
+                    round(c_ttv - w_ttv, 6)
+                    if c_ttv is not None and w_ttv is not None
+                    else None
+                ),
+                compile_delta_sec=round(
+                    (cold["builds"]["wall_sec"]
+                     - warm["builds"]["wall_sec"]), 6
+                ),
+                dispatch_net_delta_sec=(
+                    round((cold.get("dispatch_net_sec") or 0.0)
+                          - (warm.get("dispatch_net_sec") or 0.0), 6)
+                ),
+                waves_cold=cold.get("waves"),
+                waves_warm=warm.get("waves"),
+            ))
+    return out
+
+
+def write_serve_artifact(summary: dict,
+                         root: Optional[str] = None) -> str:
+    """Write one auto-numbered ``SERVE_r*.json`` (own round sequence,
+    like MEM/LAT/COMM — derived from a TRACE it names in its ``trace``
+    field; numbering via stateright_tpu/artifacts.py)."""
+    from .artifacts import artifact_path, next_round, provenance, \
+        repo_root
+
+    root = repo_root() if root is None else root
+    path = artifact_path(
+        "SERVE", "json", root=root,
+        round=next_round(root, stems=("SERVE",)),
+    )
+    doc = dict(summary)
+    doc.setdefault("provenance", provenance())
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+# -- daemon + client (the CLI's `serve` / `--connect` lanes) --------------
+
+
+def explorer_builder(name: str, count: Optional[int] = None):
+    """A CheckerBuilder for the daemon's ``--explore=MODEL[,COUNT]``
+    mount (the same model constructors the CLI lanes use)."""
+    if name == "2pc":
+        from .models.two_phase_commit import TwoPhaseSys
+
+        return TwoPhaseSys(rm_count=count or 2).checker()
+    if name == "paxos":
+        from .models.paxos import PaxosModelCfg, paxos_model
+
+        return paxos_model(
+            PaxosModelCfg(client_count=count or 2, server_count=3)
+        ).checker()
+    if name == "increment":
+        from .models.increment import Increment
+
+        return Increment(thread_count=count or 2).checker()
+    if name == "single-copy-register":
+        from .models.single_copy_register import (
+            SingleCopyRegisterCfg,
+            single_copy_register_model,
+        )
+
+        return single_copy_register_model(
+            SingleCopyRegisterCfg(client_count=count or 2)
+        ).checker()
+    if name == "linearizable-register":
+        from .models.linearizable_register import (
+            AbdModelCfg,
+            abd_model,
+        )
+
+        return abd_model(AbdModelCfg(client_count=count or 2)).checker()
+    raise SystemExit(
+        f"serve --explore: unknown model {name!r} (2pc | paxos | "
+        "increment | single-copy-register | linearizable-register)"
+    )
+
+
+def daemon_main(argv: list) -> int:
+    """``python -m stateright_tpu serve [HOST:PORT] [--explore=MODEL
+    [,COUNT]] [--program-budget-bytes=N] [--device-budget-bytes=N]
+    [--no-warm-start]`` — run the resident service until interrupted.
+    Clients reach it with ``--connect=HOST:PORT`` on any check lane,
+    a browser at ``/`` when an Explorer model is mounted."""
+    addr = "localhost:3000"
+    explore = None
+    kw: dict = {}
+    for a in argv:
+        if a.startswith("--explore="):
+            spec = a.split("=", 1)[1]
+            name, _, count = spec.partition(",")
+            explore = (name, int(count) if count else None)
+        elif a.startswith("--program-budget-bytes="):
+            kw["program_budget_bytes"] = int(a.split("=", 1)[1])
+        elif a.startswith("--device-budget-bytes="):
+            kw["device_budget_bytes"] = int(a.split("=", 1)[1])
+        elif a == "--no-warm-start":
+            kw["warm_start"] = False
+        elif a.startswith("--"):
+            raise SystemExit(f"serve: unknown flag {a}")
+        else:
+            addr = a
+    service = CheckService(**kw)
+    if explore is not None:
+        service.mount_explorer(
+            explorer_builder(*explore), explore[0]
+        )
+    host, _, port = addr.partition(":")
+    server = service.http_server(host or "localhost",
+                                 int(port or 3000))
+    print(
+        f"Resident checking service on http://{addr} "
+        f"(POST /.check, GET /.serve/sessions, POST /.serve/trace"
+        + (", Explorer UI at /" if explore is not None else "")
+        + "). Connect check lanes with --connect=" + addr
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def client_main(addr: str, argv: list) -> int:
+    """``--connect=HOST:PORT`` client mode: ship the lane argv to the
+    resident service, print its captured report verbatim (counts
+    bit-identical to a cold-process run of the same argv — it IS the
+    same handler, warm). Returns the exit status."""
+    import urllib.error
+    import urllib.request
+
+    if any(a.startswith("--") for a in argv):
+        print(f"--connect: {_FLAG_REFUSAL}", file=sys.stderr)
+        return 2
+    body = json.dumps({"argv": argv}).encode()
+    req = urllib.request.Request(
+        f"http://{addr}/.check", data=body,
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req) as r:
+            resp = json.loads(r.read())
+    except (urllib.error.URLError, OSError) as exc:
+        print(
+            f"--connect: no resident service at {addr} ({exc}); "
+            "start one with `python -m stateright_tpu serve "
+            f"{addr}`",
+            file=sys.stderr,
+        )
+        return 2
+    sys.stdout.write(resp.get("output") or "")
+    err = resp.get("error") or (resp.get("session") or {}).get("error")
+    if not resp.get("ok") and err:
+        print(f"session failed: {err}", file=sys.stderr)
+    return 0 if resp.get("ok") else 1

@@ -159,11 +159,24 @@ VERDICT_KINDS = ("discovery", "exhaustion")
 
 _ACTIVE: Optional["RunTracer"] = None
 _ACTIVE_LOCK = threading.Lock()
+#: thread-scoped tracer override (the resident service,
+#: stateright_tpu/serve.py): each session runs its checker on its own
+#: thread with its OWN tracer installed here, so concurrent sessions
+#: record into disjoint event streams with zero cross-session bleed —
+#: while single-query processes (CLI --trace, bench) keep using the
+#: process-global activation, and threads with no override (the hybrid
+#: racer's device worker) still see the global tracer.
+_TLS = threading.local()
 
 
 def current_tracer() -> Optional["RunTracer"]:
-    """The process-active tracer, or None (the common, zero-overhead
-    case — every instrumentation site guards on this)."""
+    """The active tracer for THIS thread — the thread-scoped override
+    when one is installed (``RunTracer.activate_thread``), else the
+    process-global one — or None (the common, zero-overhead case —
+    every instrumentation site guards on this)."""
+    tracer = getattr(_TLS, "tracer", None)
+    if tracer is not None:
+        return tracer
     return _ACTIVE
 
 
@@ -196,10 +209,11 @@ _NULL_SPAN = _NullSpan()
 
 
 def span(phase: str, **meta):
-    """Module-level span hook: a real span on the active tracer, a
+    """Module-level span hook: a real span on the active tracer
+    (thread-scoped override first — see :func:`current_tracer`), a
     shared no-op context manager otherwise — call sites never need a
     tracer reference or an if."""
-    tracer = _ACTIVE
+    tracer = current_tracer()
     if tracer is None:
         return _NULL_SPAN
     return tracer.span(phase, **meta)
@@ -207,7 +221,7 @@ def span(phase: str, **meta):
 
 def emit(ev: str, **fields) -> None:
     """Module-level instant-event hook (no-op without a tracer)."""
-    tracer = _ACTIVE
+    tracer = current_tracer()
     if tracer is not None:
         tracer.event(ev, **fields)
 
@@ -271,6 +285,25 @@ class RunTracer:
         finally:
             with _ACTIVE_LOCK:
                 _ACTIVE = None
+
+    @contextmanager
+    def activate_thread(self):
+        """Install as THIS THREAD's tracer for the block (the resident
+        service's per-session scope, stateright_tpu/serve.py): every
+        instrumentation site reached from this thread — engine chunk
+        loops, checkpoint/restore events, Explorer request spans —
+        records here instead of the process-global tracer, so
+        concurrent sessions trace into disjoint streams. Nests: the
+        previous thread-scoped tracer (if any) is restored on exit.
+        Threads the session spawns itself (the hybrid racer's worker)
+        do NOT inherit the override — they fall back to the global
+        tracer, exactly the pre-existing contract."""
+        prev = getattr(_TLS, "tracer", None)
+        _TLS.tracer = self
+        try:
+            yield self
+        finally:
+            _TLS.tracer = prev
 
     # -- event plumbing --------------------------------------------------
 
@@ -788,6 +821,19 @@ _REQUIRED = {
     "shard_health": ("run", "shard", "wave", "kind", "factor"),
     "fault_degrade": ("run", "from_shards", "to_shards", "reason"),
     "watchdog_timeout": ("run", "chunk", "deadline_sec"),
+    # The resident checking service (stateright_tpu/serve.py):
+    # ``session_begin`` — a query was admitted (kind check/explorer,
+    # the admission pricing, the wait from submit to admit);
+    # ``session_end`` — the query settled (state, counts, the total
+    # device-queue wait, warm-start flag, program-cache key);
+    # ``program_evict`` — the compiled-program LRU dropped an entry
+    # to stay under its byte budget (keyed like the ``_programs``/XLA
+    # cache, priced by the memplan ledger). These land in the
+    # service's MERGED trace export (one run index per session), which
+    # tools/serve_report.py derives SERVE_r* artifacts from.
+    "session_begin": ("run", "session", "kind", "t"),
+    "session_end": ("run", "session", "state", "t"),
+    "program_evict": ("run", "key", "bytes", "t"),
 }
 
 

@@ -1,0 +1,448 @@
+"""Resident-service gate (``serve`` marker, stateright_tpu/serve.py).
+
+The multi-tenancy contract: ONE warm process serves concurrent check
+sessions and Explorer queries with counts bit-identical to
+cold-process runs — paxos 2c/3s = 16,668 and 2pc rm=4 = 1,568 pinned
+under real thread concurrency, with zero cross-session telemetry
+bleed (every session's trace validates independently and names only
+its own lane). Plus: the byte-budget program LRU (eviction forces a
+rebuild, counts unaffected), the fingerprint-stable warm-start
+re-check (equal counts, zero new waves dispatched), the admission
+check refusing oversized sessions BEFORE device work, the
+``_report``-seam in_process ledger-tier regression for repeated
+in-process checks, the FIFO gate, the generalized Explorer server
+registry, and the serve_summary/SERVE_r* derivation.
+"""
+
+import io
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from stateright_tpu import cli
+from stateright_tpu.serve import (
+    AdmissionRefused,
+    CheckService,
+    FifoLock,
+    serve_summary,
+)
+from stateright_tpu.telemetry import validate_events
+
+pytestmark = pytest.mark.serve
+
+
+def _wave_events(session):
+    return [e for e in session.tracer.events if e["ev"] == "wave"]
+
+
+def _builds(session, program=None):
+    out = [e for e in session.tracer.events
+           if e["ev"] == "program_build"]
+    if program is not None:
+        out = [e for e in out if e["program"] == program]
+    return out
+
+
+# -- concurrent sessions: pinned counts, zero bleed -----------------------
+
+
+def test_concurrent_sessions_pinned_counts_zero_bleed(tmp_path):
+    """The acceptance row: one warm service, concurrent sessions over
+    paxos 2c/3s and 2pc rm=4, counts bit-identical to the pinned
+    cold-process baselines, and each session's trace validates
+    independently with only its own lane's events."""
+    service = CheckService(spool_dir=str(tmp_path))
+    lanes = [
+        ["paxos", "check-tpu", "2"],
+        ["2pc", "check-tpu", "4"],
+    ]
+    results: dict = {}
+
+    def run(i, argv):
+        results[i] = service.check(argv)
+
+    threads = [
+        threading.Thread(target=run, args=(i, argv))
+        for i, argv in enumerate(lanes)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    paxos, twopc = results[0], results[1]
+    assert paxos.state == "done", paxos.error
+    assert twopc.state == "done", twopc.error
+    assert paxos.unique == 16668
+    assert twopc.unique == 1568
+    assert "unique=16668" in paxos.output
+    assert "unique=1568" in twopc.output
+
+    # zero cross-session bleed: each trace validates on its own and
+    # carries exactly one run whose lane names its own encoding
+    for s, enc in ((paxos, "PaxosEncoded"),
+                   (twopc, "TwoPhaseSysEncoded")):
+        validate_events(s.tracer.events)
+        begins = [e for e in s.tracer.events
+                  if e["ev"] == "run_begin"]
+        assert len(begins) == 1
+        assert begins[0]["lane"]["encoding"] == enc
+        # every event in this stream belongs to this session's run
+        assert {e.get("run") for e in s.tracer.events} == {0}
+    # the final wave's running unique total is the pinned count —
+    # the per-wave stream really is this session's exploration
+    assert _wave_events(paxos)[-1]["unique_total"] == 16668
+    assert _wave_events(twopc)[-1]["unique_total"] == 1568
+
+    # the merged service trace validates too, with disjoint runs and
+    # session brackets
+    merged = service.events()
+    validate_events(merged)
+    kinds = [e["ev"] for e in merged]
+    assert kinds.count("session_begin") == 2
+    assert kinds.count("session_end") == 2
+    runs = {e["run"] for e in merged if e["ev"] == "run_begin"}
+    assert len(runs) == 2
+
+
+# -- explorer on the same warm process ------------------------------------
+
+
+def test_explorer_query_on_the_warm_service(tmp_path):
+    """≥ 2 check sessions plus an Explorer query on ONE process: the
+    Explorer mounts on the service's HTTP server (make_server
+    registry), browses answer while a check session runs, the status
+    view carries the session registry, and the explorer session's
+    request spans land in its own trace."""
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    service = CheckService(spool_dir=str(tmp_path))
+    service.mount_explorer(TwoPhaseSys(rm_count=2).checker(), "2pc")
+    server = service.http_server("127.0.0.1", 0)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        done = []
+
+        def run_check():
+            done.append(service.check(["2pc", "check-tpu", "3"]))
+
+        worker = threading.Thread(target=run_check)
+        worker.start()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/.status"
+        ) as r:
+            status = json.loads(r.read())
+        assert status["model"] == "TwoPhaseSys"
+        assert "service" in status
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/.states/"
+        ) as r:
+            views = json.loads(r.read())
+        assert views and "fingerprint" in views[0]
+        # the remote-check endpoint (the --connect client's route)
+        body = json.dumps(
+            {"argv": ["2pc", "check-tpu", "3"]}
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/.check", data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req) as r:
+            resp = json.loads(r.read())
+        assert resp["ok"] is True
+        assert "unique=288" in resp["output"]
+        worker.join()
+        assert done[0].state == "done"
+        assert done[0].unique == 288
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/.serve/sessions"
+        ) as r:
+            block = json.loads(r.read())
+        states = {s["lane"]: s["state"] for s in block["sessions"]}
+        assert states["explore 2pc"] == "serving"
+    finally:
+        server.shutdown()
+
+    merged = service.events()
+    validate_events(merged)
+    spans = [e for e in merged
+             if e["ev"] == "span"
+             and e.get("phase") == "explorer_request"]
+    assert len(spans) >= 2
+    ex_session = next(s for s in service._sessions
+                      if s.kind == "explorer")
+    ex_spans = [e for e in ex_session.tracer.events
+                if e["ev"] == "span"
+                and e["phase"] == "explorer_request"]
+    assert len(ex_spans) == len(spans)
+    # and the check sessions' traces carry NO explorer spans (bleed)
+    for s in service._sessions:
+        if s.kind == "check":
+            assert not [e for e in s.tracer.events
+                        if e.get("phase") == "explorer_request"]
+
+
+# -- warm start: incremental re-check -------------------------------------
+
+
+def test_warm_start_recheck_equal_counts_fewer_waves(tmp_path):
+    """A re-submitted model whose encoding fingerprint matches the
+    retained session resumes from the retained visited set: counts
+    equal the cold check, zero NEW waves dispatched (the cold run's
+    wave stream vs the warm run's empty one), and the warm session's
+    programs came from the in_process tier."""
+    service = CheckService(spool_dir=str(tmp_path))
+    cold = service.check(["2pc", "check-tpu", "3"])
+    assert cold.state == "done" and cold.unique == 288
+    assert not cold.warm_start
+    assert len(_wave_events(cold)) > 0
+
+    warm = service.check(["2pc", "check-tpu", "3"])
+    assert warm.state == "done", warm.error
+    assert warm.warm_start is True
+    # bit-identical counts (total AND unique: the carry holds both)
+    assert warm.unique == cold.unique == 288
+    assert warm.total == cold.total
+    assert warm.output.split("unique=")[1].split()[0] == \
+        cold.output.split("unique=")[1].split()[0]
+    # fewer waves dispatched: the retained carry is already done —
+    # the warm run settles at its first sync with no new waves
+    assert len(_wave_events(warm)) == 0
+    assert [e for e in warm.tracer.events if e["ev"] == "restore"]
+    prof = [e for e in warm.tracer.events
+            if e["ev"] == "latency_profile"][-1]
+    assert prof["chunks"] == 1
+    assert prof["resumed_from_wave"] is not None
+    # the program cache served warm too
+    assert any(b["tier"] == "in_process"
+               for b in _builds(warm, "programs"))
+
+    # an EDITED model (different fingerprint -> different retained
+    # key) runs cold: correctness never rides the cache
+    other = service.check(["2pc", "check-tpu", "4"])
+    assert other.state == "done" and other.unique == 1568
+    assert not other.warm_start
+    assert len(_wave_events(other)) > 0
+
+
+def test_warm_start_disabled_explores_again(tmp_path):
+    service = CheckService(spool_dir=str(tmp_path), warm_start=False)
+    a = service.check(["2pc", "check-tpu", "3"])
+    b = service.check(["2pc", "check-tpu", "3"])
+    assert a.unique == b.unique == 288
+    assert not b.warm_start
+    assert len(_wave_events(b)) > 0
+
+
+# -- program LRU: byte-budget eviction ------------------------------------
+
+
+def test_lru_eviction_recompiles_and_matches_counts(tmp_path):
+    """A forced-tiny program budget evicts the LRU program; the
+    re-submitted query rebuilds (no in_process programs fetch) and
+    still reproduces the pinned count."""
+    from stateright_tpu.checkers import tpu as _tpu
+
+    service = CheckService(
+        spool_dir=str(tmp_path), program_budget_bytes=1,
+        warm_start=False,
+    )
+    a = service.check(["2pc", "check-tpu", "3"])
+    assert a.unique == 288
+    assert a.program_key is not None
+    assert service.lru_bytes() > 1  # one entry always survives
+
+    b = service.check(["2pc", "check-tpu", "4"])
+    assert b.unique == 1568
+    # b's arrival pushed a's program out of the byte budget
+    assert b.evictions and b.evictions[0][0] == a.program_key
+    assert not any(
+        _tpu._key_hash(k) == a.program_key
+        for k in _tpu._CHUNK_CACHE
+    )
+
+    c = service.check(["2pc", "check-tpu", "3"])
+    assert c.unique == 288  # counts survive eviction
+    # the evicted program could NOT be served in-process again
+    assert not any(b_ev["tier"] == "in_process"
+                   for b_ev in _builds(c, "programs"))
+
+    merged = service.events()
+    validate_events(merged)
+    ev = [e for e in merged if e["ev"] == "program_evict"]
+    assert ev and ev[0]["key"] == a.program_key
+
+
+# -- admission ------------------------------------------------------------
+
+
+def test_admission_refuses_oversized_before_device_work(tmp_path):
+    service = CheckService(
+        spool_dir=str(tmp_path), device_budget_bytes=1024,
+    )
+    s = service.check(["2pc", "check-tpu", "3"])
+    assert s.state == "refused"
+    assert "admission refused" in s.error
+    assert "REFUSED" in s.output
+    # refused BEFORE any program build or device work
+    assert s.checker is not None
+    assert s.checker._programs is None
+    # and a session under the budget still runs (no leaked in-flight
+    # accounting from the refused one)
+    service.device_budget_bytes = 1 << 30
+    ok = service.check(["2pc", "check-tpu", "3"])
+    assert ok.state == "done" and ok.unique == 288
+
+
+def test_runtime_flags_refused():
+    service = CheckService()
+    with pytest.raises(ValueError, match="plain lane argv"):
+        service.check(["2pc", "check-tpu", "3", "--trace"])
+
+
+# -- the _report seam: in_process second check (satellite) ----------------
+
+
+def test_second_in_process_check_hits_in_process_tier():
+    """Two identical in-process CLI invocations share the one
+    ``_report`` seam and therefore the process program cache: the
+    second's compile ledger pins the ``in_process`` tier for the
+    whole programs pair (the regression this PR's seam factoring
+    must keep true — a resident service without it would recompile
+    per query)."""
+    from stateright_tpu.telemetry import RunTracer
+
+    buf = io.StringIO()
+
+    def run():
+        tr = RunTracer()
+        with tr.activate_thread():
+            cli.main(["increment", "check-tpu", "2"])
+        return tr
+
+    import contextlib
+
+    with contextlib.redirect_stdout(buf):
+        run()  # builds (cold or disk — whatever this process paid)
+        tr2 = run()
+    progs = [e for e in tr2.events if e["ev"] == "program_build"
+             and e["program"] == "programs"]
+    assert progs and progs[0]["tier"] == "in_process"
+    # in_process means NO XLA work: the ledger's wall is the fetch
+    assert progs[0]["cold_sec"] == 0.0
+
+
+# -- FIFO gate ------------------------------------------------------------
+
+
+def test_fifo_lock_is_arrival_ordered():
+    import time
+
+    lock = FifoLock()
+    order = []
+    lock.acquire()
+
+    def waiter(i):
+        def run():
+            with lock:
+                order.append(i)
+
+        t = threading.Thread(target=run)
+        t.start()
+        # wait until this waiter is actually ENQUEUED before the next
+        # arrives — arrival order is what the lock must preserve
+        deadline = time.monotonic() + 5.0
+        while len(lock._waiters) < i + 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.001)
+        return t
+
+    threads = [waiter(i) for i in range(4)]
+    lock.release()
+    for t in threads:
+        t.join()
+    assert order == [0, 1, 2, 3]
+
+
+# -- summary + artifact derivation ----------------------------------------
+
+
+def test_serve_summary_and_artifact(tmp_path):
+    service = CheckService(spool_dir=str(tmp_path))
+    service.check(["2pc", "check-tpu", "3"])
+    service.check(["2pc", "check-tpu", "3"])
+    jsonl, chrome = service.write_trace(root=str(tmp_path))
+    assert "TRACE_r01" in jsonl
+
+    from stateright_tpu.telemetry import load_trace
+
+    events = load_trace(jsonl)
+    validate_events(events)
+    summary = serve_summary(events)
+    assert summary is not None
+    assert len(summary["sessions"]) == 2
+    s0, s1 = summary["sessions"]
+    assert s0["unique"] == s1["unique"] == 288
+    assert s0["warm_start"] is False and s1["warm_start"] is True
+    assert s0["time_to_verdict_sec"] is not None
+    assert s1["time_to_verdict_sec"] is not None
+    wvc = summary["warm_vs_cold"]
+    assert len(wvc) == 1
+    assert wvc[0]["cold_session"] == s0["session"]
+    assert wvc[0]["warm_session"] == s1["session"]
+    assert wvc[0]["ttv_delta_sec"] is not None
+
+    # the report renders and the artifact round-trips
+    import os
+    import subprocess
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.dirname(
+        os.path.abspath(cli.__file__)
+    ))
+    out = subprocess.run(
+        [_sys.executable,
+         os.path.join(repo, "tools", "serve_report.py"),
+         jsonl, "--json", "--root", str(tmp_path)],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "serve report" in out.stdout
+    assert "warm vs cold" in out.stdout
+    serve_artifacts = list(tmp_path.glob("SERVE_r*.json"))
+    assert len(serve_artifacts) == 1
+    with open(serve_artifacts[0]) as fh:
+        doc = json.load(fh)
+    assert doc["trace"] == "TRACE_r01.jsonl"
+    assert len(doc["sessions"]) == 2
+    assert doc["provenance"]["git_sha"] is not None
+
+    from stateright_tpu.artifacts import latest_serve_summary
+
+    ref = latest_serve_summary(root=str(tmp_path))
+    assert ref is not None
+    assert ref["artifact"] == serve_artifacts[0].name
+    assert ref["sessions"] == 2
+    assert ref["warm_vs_cold"] is not None
+
+
+def test_serve_report_rejects_non_service_trace(tmp_path):
+    """serve_report exits 2 on a trace with no session events."""
+    from stateright_tpu.serve import serve_summary as ss
+
+    assert ss([{"ev": "run_begin", "run": 0}]) is None
+
+
+# -- make_server registry stays compatible --------------------------------
+
+
+def test_make_server_requires_checker_or_registry():
+    from stateright_tpu.explorer.server import Snapshot, make_server
+
+    with pytest.raises(ValueError, match="checker, a registry"):
+        make_server(None, Snapshot(), "127.0.0.1", 0)

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Serve report: the latency-per-query view of a resident-service
+trace — ROADMAP direction 4's first-class metric, rendered.
+
+Reads a ``TRACE_r*.jsonl`` artifact exported by the resident checking
+service (stateright_tpu/serve.py ``write_trace`` — one run index per
+session, bracketed by ``session_begin``/``session_end`` service
+events) and renders the tables the serving story is judged by:
+
+* **per-session table** — kind, lane, state, time-to-verdict, queue
+  wait (the FIFO device gate), admission wait, compile tier counts,
+  warm-start / resumed-from-wave, counts, and the Explorer
+  cache-hit ratio for explorer sessions,
+* **warm-vs-cold pairing** — repeat queries of one program key
+  against their cold first query: the time-to-verdict delta with the
+  ledger attribution split between the compile tier (build walls)
+  and dispatch proper (``dispatch_net_sec``) — the acceptance read
+  for "the warm query is faster BECAUSE the compile amortized, not
+  because dispatch changed",
+* **LRU evictions** — programs the byte budget dropped.
+
+The derived summary comes from ``serve.serve_summary`` (the block
+bench provenance embeds via ``artifacts.latest_serve_summary``), so
+this report and those artifacts cannot disagree. ``--json`` writes an
+auto-numbered ``SERVE_r*.json`` (its own round sequence — SERVE_r01
+first — cross-referenced to the TRACE it was derived from; numbering
+via stateright_tpu/artifacts.py).
+
+Usage:
+  python tools/serve_report.py TRACE_r30.jsonl
+  python tools/serve_report.py TRACE_r30.jsonl --json
+
+Exit status: 0 (report printed), 2 bad input / no session events in
+the trace (not a service trace).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _sec(x) -> str:
+    if x is None:
+        return "-"
+    return f"{x:,.4f} s" if x >= 0.001 else f"{x * 1e3:,.3f} ms"
+
+
+def format_report(summary: dict) -> str:
+    sessions = summary["sessions"]
+    lines = [
+        f"serve report: {len(sessions)} session(s)",
+        "",
+        f"  {'#':>3s} {'kind':<9s} {'lane':<26s} {'state':<8s} "
+        f"{'ttv':>12s} {'queue':>10s} {'tiers':<22s} "
+        f"{'warm':<5s} {'unique':>9s}",
+    ]
+    for s in sessions:
+        tiers = ",".join(
+            f"{k}:{v}" for k, v in sorted(
+                (s.get("builds") or {}).get("tiers", {}).items()
+            )
+        ) or "-"
+        warm = "yes" if s.get("warm_start") else "no"
+        if s.get("resumed_from_wave") is not None:
+            warm += f"@w{s['resumed_from_wave']}"
+        lines.append(
+            f"  {s['session']:>3d} {s['kind']:<9s} "
+            f"{(s.get('lane') or '')[:26]:<26s} "
+            f"{(s.get('state') or '?'):<8s} "
+            f"{_sec(s.get('time_to_verdict_sec')):>12s} "
+            f"{_sec(s.get('queue_wait_sec')):>10s} {tiers:<22s} "
+            f"{warm:<5s} "
+            f"{s['unique'] if s.get('unique') is not None else '-':>9}"
+        )
+        if s.get("error"):
+            lines.append(f"      ERROR: {s['error']}")
+        ex = s.get("explorer")
+        if ex:
+            hits = ex["cache_hits"]
+            n = ex["requests"]
+            lines.append(
+                f"      explorer: {n} request(s), {hits} cache "
+                f"hit(s) ({hits / n:.0%})" if n else
+                "      explorer: 0 requests"
+            )
+
+    wvc = summary.get("warm_vs_cold") or []
+    if wvc:
+        lines.append("")
+        lines.append("warm vs cold (repeat queries of one program):")
+        for p in wvc:
+            lines.append(
+                f"  program {p['program_key']}: cold #"
+                f"{p['cold_session']} ttv {_sec(p['cold_ttv_sec'])}"
+                f" -> warm #{p['warm_session']} ttv "
+                f"{_sec(p['warm_ttv_sec'])} "
+                f"(delta {_sec(p['ttv_delta_sec'])}; compile-tier "
+                f"{_sec(p['compile_delta_sec'])}, dispatch "
+                f"{_sec(abs(p['dispatch_net_delta_sec']))} "
+                f"{'less' if p['dispatch_net_delta_sec'] >= 0 else 'more'}"
+                f"; waves {p.get('waves_cold')} -> "
+                f"{p.get('waves_warm')}"
+                + (", warm-start" if p.get("warm_start") else "")
+                + ")"
+            )
+
+    ev = summary.get("evictions") or []
+    if ev:
+        lines.append("")
+        lines.append("program-LRU evictions:")
+        for e in ev:
+            lines.append(
+                f"  key {e.get('key')}: {e.get('bytes'):,} B "
+                f"(session run {e.get('run')})"
+            )
+    return "\n".join(lines)
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="per-session latency-per-query report over a "
+        "resident-service TRACE"
+    )
+    ap.add_argument("trace", help="TRACE_r*.jsonl artifact (from "
+                    "CheckService.write_trace)")
+    ap.add_argument(
+        "--json", action="store_true",
+        help="also write an auto-numbered SERVE_r*.json artifact",
+    )
+    ap.add_argument(
+        "--root", default=None,
+        help="artifact directory for --json (default: the repo root)",
+    )
+    args = ap.parse_args()
+
+    from stateright_tpu.serve import serve_summary, \
+        write_serve_artifact
+    from stateright_tpu.telemetry import load_trace, validate_events
+
+    try:
+        events = load_trace(args.trace)
+        validate_events(events)
+    except (OSError, ValueError) as exc:
+        print(f"serve_report: bad input: {exc}", file=sys.stderr)
+        sys.exit(2)
+
+    summary = serve_summary(events)
+    if summary is None:
+        print(
+            "serve_report: no session events in this trace — export "
+            "one from a resident service "
+            "(stateright_tpu/serve.py CheckService.write_trace, or "
+            "POST /.serve/trace on a running daemon)",
+            file=sys.stderr,
+        )
+        sys.exit(2)
+    print(format_report(summary))
+    if args.json:
+        summary = dict(summary, trace=os.path.basename(args.trace))
+        path = write_serve_artifact(summary, root=args.root)
+        print(f"\nwrote {path}")
+
+
+if __name__ == "__main__":
+    main()

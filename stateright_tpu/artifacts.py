@@ -331,6 +331,18 @@ def latest_serve_summary(root: str | None = None) -> dict | None:
                           "ttv_delta_sec", "compile_delta_sec",
                           "dispatch_net_delta_sec", "warm_start")
             }
+        batch_block = None
+        lt = report.get("loadtest")
+        if isinstance(lt, dict):
+            # the wave-batching A/B headline (tools/serve_loadtest.py
+            # — batched per-query dispatch+sync overhead vs the
+            # FIFO-serial baseline at identical counts)
+            batch_block = {
+                k: lt.get(k)
+                for k in ("clients", "lane", "amortization_x",
+                          "batched_per_query_overhead_sec",
+                          "fifo_per_query_overhead_sec")
+            }
     except (OSError, ValueError, TypeError, AttributeError, KeyError):
         return None
     repo = repo_root() if root is None else root
@@ -347,6 +359,7 @@ def latest_serve_summary(root: str | None = None) -> dict | None:
         ),
         "sessions": len(sessions),
         "warm_vs_cold": warm_block,
+        "batching": batch_block,
     }
 
 

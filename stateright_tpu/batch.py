@@ -1,0 +1,856 @@
+"""Multi-tenant wave batching: fuse N admitted sessions into ONE
+device dispatch (ROADMAP direction 2(a), PERF.md §batching).
+
+The round-18 resident service made the checker warm, but each admitted
+session still owns its waves: the ~106 ms per-chunk sync floor and the
+per-dispatch host round-trip are paid once PER QUERY, which dominates
+exactly where the serving story lives — small submitted models (2pc
+rm=4 settles in 14 waves). The fix is the serving-throughput analogue
+of continuous batching in an inference stack: a **session-id lane**
+rides alongside the existing (owner, fp) routing, so one wave-program
+dispatch advances the frontiers of N compatible sessions at once and
+the sync floor amortizes 1/N per session.
+
+Exactness comes from the same partition argument the mesh shards use:
+the sid limb is part of every fused state vector, so per-session
+visited prefixes and parent-log segments are **disjoint by
+sid-partition** — a fingerprint never crosses sessions, exactly as it
+never crosses shards. Counts, verdicts, and counterexample paths are
+therefore per-session facts the fused run computes bit-identically to
+a solo run (tests/test_serve.py pins 16,668 / 1,568 and trace_diff
+zero counter divergence batched-vs-solo).
+
+Layering:
+
+* :class:`FusedEncodedModel` / :class:`FusedModel` — the sid-lane
+  product encoding: member state vectors padded to a common width with
+  the sid in the LAST limb, ``step_vec`` dispatched per-row by
+  ``lax.switch``, property conditions vacuous off-lane (ALWAYS → True,
+  SOMETIMES → False), so one fused property list concatenates the
+  members' lists with zero cross-talk.
+* :class:`FusedWaveChecker` — the hash wave engine
+  (checkers/tpu.py) extended through its four fused-engine seams
+  (``_seed_extra`` / ``_body_extra`` / ``_stats_extra`` /
+  ``_on_chunk_stats``): per-session unique/depth/generated counters and
+  a per-wave per-session lane log ride the device carry and come back
+  in the SAME packed per-chunk stats readback — no extra sync. A
+  session whose lane settles (all its properties discovered, or its
+  lane frontier drains) has its rows masked dead in the very next
+  wave, so a settling session never holds the others' waves.
+* :class:`BatchGroup` — the host-side rendezvous the resident service
+  (serve.py) slots into its admission and dispatch-gate seams: sessions
+  of one compatibility class (:func:`batch_eligible`) join an open
+  group for a short window; the first member leads the fused run under
+  a throwaway tracer; every settled member is PEELED between chunks —
+  its thread wakes immediately, replays its demultiplexed lane view
+  into its own session tracer (zero cross-session bleed), and returns
+  its verdict while the batch keeps running. Anything that cannot fuse
+  (no peers, fused plan over budget, fused dispatch error) falls back
+  to the round-18 solo FIFO path with a one-line reason.
+
+Telemetry demux contract: a member's replayed trace is a valid solo
+trace — wave rows satisfy the running unique_total check, verdicts land
+at their true settle chunk, ``latency_profile`` derives from the
+replayed chunk events (each carrying this session's 1/N_active share of
+the fused dispatch+sync walls), and ``trace_diff`` against a solo run
+of the same model shows zero counter divergence. The fused compile is
+ledger-attributed via re-emitted ``program_build`` rows with
+1/N-amortized walls and a ``batch`` marker.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from .checker import CheckerBuilder
+from .checkers.tpu import TpuBfsChecker, _fp_int
+from .encoding import EncodedModelBase, has_trivial_boundary
+from .model import Expectation, Model, Property
+from .path import Path
+
+#: per-wave per-session lane-log fields (the sid-partitioned analogue
+#: of telemetry.WAVE_LOG_FIELDS, minus the fields a lane cannot own):
+#: frontier rows, candidates, new states, cumulative unique, depth
+#: entering the wave.
+LANE_LOG_FIELDS = 5
+
+
+def _pow2ceil(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+# -- the sid-lane product encoding ----------------------------------------
+
+
+class FusedModel(Model):
+    """Host-side product of N member models, disjoint by sid: states
+    are ``(sid, member_state)``, actions/successors delegate to the
+    owning member, and the property list concatenates the members'
+    lists under ``s{i}:`` name prefixes with off-lane-vacuous
+    conditions. This is the replay oracle the fused engine decodes
+    counterexample paths through; stripping the sid from a decoded
+    path yields the member's own path."""
+
+    def __init__(self, member_models: list):
+        self.members = list(member_models)
+        self._props = []
+        for i, m in enumerate(self.members):
+            for p in m.properties():
+                self._props.append(Property(
+                    p.expectation,
+                    f"s{i}:{p.name}",
+                    self._lane_condition(i, m, p),
+                ))
+
+    @staticmethod
+    def _lane_condition(i: int, member, prop):
+        vac = prop.expectation == Expectation.ALWAYS
+
+        def cond(model, st):
+            if st[0] != i:
+                return vac
+            return prop.condition(member, st[1])
+
+        return cond
+
+    def init_states(self):
+        return [
+            (i, s)
+            for i, m in enumerate(self.members)
+            for s in m.init_states()
+        ]
+
+    def actions(self, state):
+        sid, st = state
+        return self.members[sid].actions(st)
+
+    def next_state(self, state, action):
+        sid, st = state
+        nxt = self.members[sid].next_state(st, action)
+        return None if nxt is None else (sid, nxt)
+
+    def properties(self):
+        return list(self._props)
+
+    def format_action(self, action):
+        return str(action)
+
+
+class FusedEncodedModel(EncodedModelBase):
+    """Device-side product encoding: member vectors padded to
+    ``max(width) + 1`` lanes with the session id in the LAST limb.
+    The sid limb is fingerprinted with the rest of the state, so fused
+    visited keys are sid-partitioned — a fingerprint never crosses
+    sessions, exactly as it never crosses mesh shards.
+
+    ``step_vec`` dispatches per-row by ``lax.switch`` on the sid limb;
+    each branch pads its member's ``[K_i, W_i]`` successor block into
+    the fused ``[K_f, W_f]`` shape and stamps the sid on every row.
+    Property conditions evaluate every member's predicate (pure masked
+    math) and select the on-lane one, with off-lane slots vacuous
+    (ALWAYS → True so it can never fire off-lane; SOMETIMES → False so
+    it can never be satisfied off-lane). Members must have trivial
+    boundaries (:func:`batch_eligible` enforces it) so the fused
+    encoding's inherited trivial boundary is exact."""
+
+    def __init__(self, member_encs: list, host_model: FusedModel):
+        self.members = list(member_encs)
+        self.host_model = host_model
+        self.width = max(m.width for m in self.members) + 1
+        self.max_actions = max(m.max_actions for m in self.members)
+        #: off-lane truth per member property (ALWAYS → True), in
+        #: member property order — the vacuity vector step 2 selects.
+        self._off_lane = [
+            np.array(
+                [p.expectation == Expectation.ALWAYS
+                 for p in m.host_model.properties()],
+                dtype=bool,
+            )
+            for m in self.members
+        ]
+
+    # -- host side ---------------------------------------------------------
+
+    def init_vecs(self) -> np.ndarray:
+        rows = []
+        for i, m in enumerate(self.members):
+            iv = np.asarray(m.init_vecs(), np.uint32).reshape(
+                -1, m.width
+            )
+            pad = np.zeros((iv.shape[0], self.width), np.uint32)
+            pad[:, : m.width] = iv
+            pad[:, self.width - 1] = i
+            rows.append(pad)
+        return np.concatenate(rows, axis=0)
+
+    def encode(self, state) -> np.ndarray:
+        sid, st = state
+        m = self.members[sid]
+        row = np.zeros(self.width, np.uint32)
+        row[: m.width] = np.asarray(m.encode(st), np.uint32)
+        row[self.width - 1] = sid
+        return row
+
+    def cache_key(self):
+        """Composite program-cache identity: the fused program is a
+        function of every member's encoding identity and shape plus
+        the fusion arity."""
+        parts = []
+        for m in self.members:
+            key = m.cache_key() if hasattr(m, "cache_key") else None
+            parts.append(
+                (type(m).__name__, key, m.width, m.max_actions)
+            )
+        return ("fused", len(self.members), tuple(parts))
+
+    # -- device side -------------------------------------------------------
+
+    def step_vec(self, vec):
+        import jax
+        import jax.numpy as jnp
+
+        Wf, Kf = self.width, self.max_actions
+        sid = vec[Wf - 1]
+
+        def branch(i, m):
+            def f(v):
+                res = m.step_vec(v[: m.width])
+                if isinstance(res, tuple) and len(res) == 3:
+                    succs, valid, trunc = res
+                else:
+                    succs, valid = res
+                    trunc = jnp.bool_(False)
+                out = jnp.zeros((Kf, Wf), jnp.uint32)
+                out = out.at[: m.max_actions, : m.width].set(succs)
+                out = out.at[:, Wf - 1].set(jnp.uint32(i))
+                val = jnp.zeros((Kf,), bool)
+                val = val.at[: m.max_actions].set(valid)
+                return out, val, jnp.asarray(trunc, bool)
+
+            return f
+
+        branches = [branch(i, m) for i, m in enumerate(self.members)]
+        idx = jnp.clip(
+            sid.astype(jnp.int32), 0, len(branches) - 1
+        )
+        return jax.lax.switch(idx, branches, vec)
+
+    def property_conditions_vec(self, vec):
+        import jax.numpy as jnp
+
+        sid = vec[self.width - 1]
+        out = []
+        for i, m in enumerate(self.members):
+            conds = m.property_conditions_vec(vec[: m.width])
+            off = jnp.asarray(self._off_lane[i])
+            on = sid == jnp.uint32(i)
+            out.append(jnp.where(on, conds, off))
+        return jnp.concatenate(out)
+
+
+# -- eligibility / compatibility classes ----------------------------------
+
+
+def batch_eligible(checker) -> tuple:
+    """``(class_key, None)`` when ``checker`` can join a fused batch,
+    else ``(None, reason)`` with a one-line human reason (the FIFO
+    fallback message). Two sessions may fuse iff their class keys are
+    equal — the class groups sessions whose padded shapes are close
+    (pow2-bucketed width / action fan-out), so fusion never pays
+    unbounded padding for a mismatched pair."""
+    if not isinstance(checker, TpuBfsChecker):
+        return None, "not a device wave engine"
+    if getattr(checker, "mesh", None) is not None or getattr(
+        checker, "n_shards", 1
+    ) not in (None, 1):
+        return None, "sharded mesh sessions batch per-shard already"
+    b = checker.builder
+    if b._visitor is not None:
+        return None, "visitor sessions cannot batch"
+    if b._target_state_count is not None or \
+            b._target_max_depth is not None:
+        return None, "bounded-target sessions cannot batch"
+    if not checker.track_paths:
+        return None, "untracked-path sessions cannot batch"
+    if checker.checkpoint_every:
+        return None, "checkpointing sessions cannot batch"
+    if getattr(checker, "_resume", None) is not None:
+        return None, "warm-started sessions resume solo"
+    if getattr(checker, "tier_hot_rows", None):
+        return None, "tiered sessions cannot batch"
+    enc = checker.encoded
+    if not hasattr(enc, "cache_key"):
+        return None, "encoding lacks a cache_key identity"
+    if not has_trivial_boundary(enc):
+        return None, "bounded-boundary encodings cannot batch"
+    for p in checker.model.properties():
+        if p.expectation == Expectation.EVENTUALLY:
+            return None, "eventually properties cannot batch"
+    key = (
+        "batch",
+        _pow2ceil(enc.width),
+        _pow2ceil(enc.max_actions),
+    )
+    return key, None
+
+
+# -- the fused engine ------------------------------------------------------
+
+
+class FusedWaveChecker(TpuBfsChecker):
+    """The hash wave engine over the sid-lane product encoding, with
+    per-session lane accounting riding the existing per-chunk stats
+    readback. Extra packed-stat layout after the per-property
+    discovery lanes (``s[11 + 3P:]``):
+
+    ``[N unique][N depth][N gen][waves_per_sync * N * 5 lane log]``
+
+    where a lane-log row is ``LANE_LOG_FIELDS`` = (frontier rows,
+    candidates, new states, cumulative unique, depth entering the
+    wave) — exactly the engine-independent BFS facts trace_diff
+    compares (DIFF_COUNTERS), so a lane's rows reproduce a solo run's
+    wave counters bit-exactly."""
+
+    def __init__(self, member_checkers: list,
+                 waves_per_sync: Optional[int] = None):
+        members = list(member_checkers)
+        if len(members) < 2:
+            raise ValueError("a fused batch needs >= 2 sessions")
+        fm = FusedModel([c.model for c in members])
+        fe = FusedEncodedModel([c.encoded for c in members], fm)
+        super().__init__(
+            CheckerBuilder(fm),
+            encoded=fe,
+            # 4x the summed member capacities keeps the fused hash
+            # table's occupancy in the flat probe regime even when
+            # every member fills its own capacity.
+            capacity=_pow2ceil(
+                4 * sum(c.capacity for c in members)
+            ),
+            frontier_capacity=_pow2ceil(
+                sum(c.frontier_capacity for c in members)
+            ),
+            track_paths=True,
+            waves_per_sync=(
+                waves_per_sync
+                or min(c.waves_per_sync for c in members)
+            ),
+            cand_capacity=None,
+        )
+        self.n_sessions = len(members)
+        #: per-member slice into the fused property list
+        self.lane_slices: list[slice] = []
+        off = 0
+        for c in members:
+            n = len(c.model.properties())
+            self.lane_slices.append(slice(off, off + n))
+            off += n
+        #: host-side per-chunk lane observations (_on_chunk_stats)
+        self.chunk_records: list[dict] = []
+        #: optional callable(record) invoked at every chunk sync —
+        #: the BatchGroup peel hook
+        self.on_chunk: Optional[Callable[[dict], None]] = None
+        self._lane_prev_waves = 0
+        self._final_lanes: Optional[dict] = None
+
+    def _cache_extras(self) -> tuple:
+        return ("fused", self.n_sessions)
+
+    # -- device program extensions ----------------------------------------
+
+    def _seed_extra(self, out, init_rows, jnp) -> dict:
+        N = self.n_sessions
+        W = self.encoded.width
+        sid = init_rows[:, W - 1].astype(jnp.int32)
+        counts = jnp.zeros(N, jnp.uint32).at[sid].add(1)
+        return dict(
+            sid_unique=counts,
+            sid_depth=jnp.ones(N, jnp.uint32),
+            sid_gen=counts,
+            sid_log=jnp.zeros(
+                (self.waves_per_sync, N, LANE_LOG_FIELDS),
+                jnp.uint32,
+            ),
+        )
+
+    def _body_extra(self, c, out, ctx, jnp) -> dict:
+        N = self.n_sessions
+        W = self.encoded.width
+
+        def lane_counts(rows_sid, valid):
+            idx = jnp.where(
+                valid, rows_sid.astype(jnp.int32), jnp.int32(N)
+            )
+            return jnp.zeros(N + 1, jnp.uint32).at[idx].add(1)[:N]
+
+        f_rows = lane_counts(c["frontier"][:, W - 1], c["fval"])
+        cand = lane_counts(
+            ctx["ex"]["flat"][:, W - 1], ctx["ex"]["v"]
+        )
+        new_per = lane_counts(
+            ctx["b_ext"][:, W - 1], ctx["is_new"] & ctx["b_val"]
+        )
+        sid_unique = c["sid_unique"] + new_per
+        sid_gen = c["sid_gen"] + cand
+
+        # per-lane all-discovered (the lane's own early exit — the
+        # solo run's ``all_disc`` term, sid-partitioned)
+        lane_disc = jnp.stack([
+            (jnp.all(out["disc_found"][sl])
+             if sl.stop > sl.start else jnp.bool_(False))
+            for sl in self.lane_slices
+        ])
+        lane_cont = (new_per > 0) & ~lane_disc
+        sid_depth = jnp.where(
+            lane_cont, c["sid_depth"] + 1, c["sid_depth"]
+        )
+
+        row = jnp.stack(
+            [f_rows, cand, new_per, sid_unique, c["sid_depth"]],
+            axis=-1,
+        ).astype(jnp.uint32)
+        sid_log = jnp.asarray(c["sid_log"]).at[c["wchunk"]].set(row)
+
+        # Settlement masking: a lane whose properties all discovered
+        # must not keep exploring (the solo run would have stopped) —
+        # kill its rows in the NEXT frontier. Rows of drained lanes
+        # die on their own (no successors -> no rows).
+        next_sid = jnp.clip(
+            out["frontier"][:, W - 1].astype(jnp.int32), 0, N - 1
+        )
+        fval = out["fval"] & ~lane_disc[next_sid]
+        return dict(
+            sid_unique=sid_unique,
+            sid_depth=sid_depth,
+            sid_gen=sid_gen,
+            sid_log=sid_log,
+            fval=fval,
+        )
+
+    def _stats_extra(self, c, jnp) -> list:
+        return [
+            c["sid_unique"],
+            c["sid_depth"],
+            c["sid_gen"],
+            c["sid_log"].reshape(-1),
+        ]
+
+    # -- host-side demux ---------------------------------------------------
+
+    def _lane_stats(self, s: np.ndarray) -> dict:
+        N = self.n_sessions
+        P = len(self.model.properties())
+        base = 11 + 3 * P
+        unique = np.array(s[base: base + N], np.int64)
+        depth = np.array(s[base + N: base + 2 * N], np.int64)
+        gen = np.array(s[base + 2 * N: base + 3 * N], np.int64)
+        log = np.array(
+            s[base + 3 * N:
+              base + 3 * N
+              + self.waves_per_sync * N * LANE_LOG_FIELDS],
+            np.int64,
+        ).reshape(self.waves_per_sync, N, LANE_LOG_FIELDS)
+        return dict(
+            unique=unique, depth=depth, gen=gen, log=log,
+            disc=np.array(s[11: 11 + P], np.int64),
+            disc_lo=np.array(s[11 + P: 11 + 2 * P], np.uint32),
+            disc_hi=np.array(s[11 + 2 * P: 11 + 3 * P], np.uint32),
+        )
+
+    def _on_chunk_stats(self, s, carry, chunk_no, t0, t1,
+                        dispatch_sec, fetch_sec) -> None:
+        lanes = self._lane_stats(np.asarray(s))
+        waves_now = int(s[4])
+        n_waves = waves_now - self._lane_prev_waves
+        record = dict(
+            chunk_no=chunk_no,
+            wave0=self._lane_prev_waves,
+            n_waves=n_waves,
+            t0=t0,
+            t1=t1,
+            dispatch_sec=dispatch_sec,
+            fetch_sec=fetch_sec,
+            rows=lanes["log"][:n_waves].copy(),
+            unique=lanes["unique"],
+            depth=lanes["depth"],
+            gen=lanes["gen"],
+            disc=lanes["disc"],
+            disc_lo=lanes["disc_lo"],
+            disc_hi=lanes["disc_hi"],
+            done=bool(s[0]),
+            carry=carry,
+        )
+        self._lane_prev_waves = waves_now
+        self.chunk_records.append(record)
+        cb = self.on_chunk
+        if cb is not None:
+            cb(record)
+
+    def _consume_extra_stats(self, extra: np.ndarray) -> None:
+        N = self.n_sessions
+        self._final_lanes = dict(
+            unique=np.array(extra[:N], np.int64),
+            depth=np.array(extra[N: 2 * N], np.int64),
+            gen=np.array(extra[2 * N: 3 * N], np.int64),
+        )
+
+
+# -- the rendezvous / demux machinery -------------------------------------
+
+
+class BatchMember:
+    """One session's seat in a batch group."""
+
+    def __init__(self, index: int, checker, label: str = ""):
+        self.index = index
+        self.checker = checker
+        self.label = label
+        self.done = threading.Event()
+        #: set when this member settled inside the fused run
+        self.payload: Optional[dict] = None
+        #: set when this member must run solo instead (one-line reason)
+        self.fallback_reason: Optional[str] = None
+        #: serve.py installs these: called before the solo fallback
+        #: run (the round-18 solo admission), and to surface the
+        #: fallback reason on the session's own stdout.
+        self.solo_prepare: Optional[Callable[[], None]] = None
+        self.notify: Optional[Callable[[str], None]] = None
+
+
+class BatchGroup:
+    """A rendezvous of compatible sessions that fuses into one device
+    run. The FIRST member to call :meth:`member_run` leads: it waits
+    out the batching window, freezes membership, builds the
+    :class:`FusedWaveChecker`, prices it through the injected
+    ``admit`` hook, and drives the fused run under a throwaway tracer.
+    Every other member blocks on its own event and is woken the moment
+    its lane settles (the peel), then replays its demultiplexed lane
+    view into its own thread's tracer and returns. Any failure to fuse
+    degrades to the solo FIFO path with a one-line reason — fusion is
+    an optimization, never a correctness dependency."""
+
+    def __init__(self, group_id: int, class_key, *,
+                 max_sessions: int = 4, window_sec: float = 0.25,
+                 waves_per_sync: Optional[int] = None,
+                 admit: Optional[Callable] = None,
+                 make_gate: Optional[Callable] = None):
+        self.group_id = group_id
+        self.class_key = class_key
+        self.max_sessions = max_sessions
+        self.window_sec = window_sec
+        self.waves_per_sync = waves_per_sync
+        self.admit = admit
+        self.make_gate = make_gate
+        self.members: list[BatchMember] = []
+        self.fused: Optional[FusedWaveChecker] = None
+        self._lock = threading.Lock()
+        self._full = threading.Event()
+        self._frozen = False
+        self._alive: list[bool] = []
+        self._settle_error: Optional[str] = None
+        self._lead_tracer = None
+
+    # -- membership --------------------------------------------------------
+
+    def try_join(self, checker, label: str = "") -> Optional[BatchMember]:
+        """Claim a seat; None when the group already froze or filled
+        (the caller opens a fresh group)."""
+        with self._lock:
+            if self._frozen or len(self.members) >= self.max_sessions:
+                return None
+            m = BatchMember(len(self.members), checker, label)
+            self.members.append(m)
+            if len(self.members) >= self.max_sessions:
+                self._full.set()
+            return m
+
+    # -- per-session entry point ------------------------------------------
+
+    def member_run(self, member: BatchMember, reporter=None) -> None:
+        """Runs on the member's own session thread, replacing its
+        checker's ``_run``. The leader (seat 0) drives the fused run;
+        followers wait for their peel."""
+        if member.index == 0:
+            self._lead()
+        else:
+            member.done.wait()
+        if member.fallback_reason is not None:
+            if member.notify is not None:
+                member.notify(member.fallback_reason)
+            if member.solo_prepare is not None:
+                member.solo_prepare()
+            type(member.checker)._run(member.checker, reporter)
+            return
+        self._replay(member)
+
+    # -- the leader --------------------------------------------------------
+
+    def _fallback_all(self, members, reason: str) -> None:
+        for m in members:
+            if not m.done.is_set():
+                m.fallback_reason = reason
+                m.done.set()
+
+    def _lead(self) -> None:
+        self._full.wait(self.window_sec)
+        with self._lock:
+            self._frozen = True
+            members = list(self.members)
+        self._alive = [True] * len(members)
+        if len(members) < 2:
+            self._fallback_all(
+                members,
+                "batch: no compatible peers arrived within the "
+                "batching window; running solo via the FIFO gate",
+            )
+            return
+        try:
+            fused = FusedWaveChecker(
+                [m.checker for m in members],
+                waves_per_sync=self.waves_per_sync,
+            )
+        except Exception as exc:
+            self._fallback_all(
+                members,
+                f"batch: fused engine construction failed "
+                f"({type(exc).__name__}: {exc}); running solo",
+            )
+            return
+        if self.admit is not None:
+            reason = self.admit(fused, members)
+            if reason:
+                self._fallback_all(members, reason)
+                return
+        if self.make_gate is not None:
+            fused.dispatch_gate = self.make_gate()
+        fused.on_chunk = self._on_chunk
+        self.fused = fused
+        from .telemetry import RunTracer
+
+        tracer = RunTracer()
+        self._lead_tracer = tracer
+        try:
+            with tracer.activate_thread():
+                fused._ensure_run(None)
+        except Exception as exc:
+            # members already peeled keep their exact results; the
+            # rest degrade to solo
+            self._fallback_all(
+                members,
+                f"batch: fused dispatch failed "
+                f"({type(exc).__name__}: {exc}); running solo",
+            )
+            return
+        with self._lock:
+            last = len(fused.chunk_records) - 1
+            for m in members:
+                if not m.done.is_set():
+                    self._settle(m, last)
+
+    # -- the peel ----------------------------------------------------------
+
+    def _on_chunk(self, record: dict) -> None:
+        """Called at every fused chunk sync (leader thread): wake every
+        member whose lane settled in this chunk — the member replays
+        and returns while the batch keeps running."""
+        with self._lock:
+            chunk_idx = len(self.fused.chunk_records) - 1
+            for m in self.members:
+                if m.done.is_set() or not self._alive[m.index]:
+                    continue
+                if self._lane_settled(m.index, record):
+                    self._alive[m.index] = False
+                    self._settle(m, chunk_idx)
+
+    def _lane_settled(self, i: int, record: dict) -> bool:
+        if record["done"]:
+            return True
+        sl = self.fused.lane_slices[i]
+        if sl.stop > sl.start and all(record["disc"][sl]):
+            return True
+        rows = record["rows"][:, i, :]
+        live = rows[rows[:, 0] > 0]
+        # a live wave that committed nothing drains the lane frontier
+        # for good — the lane's exhaustion wave
+        return live.size > 0 and int(live[-1][2]) == 0
+
+    def _settle(self, member: BatchMember, chunk_idx: int) -> None:
+        # Materialize the parent forest NOW, while the settle chunk's
+        # carry buffers are still live — the engine donates them into
+        # the next chunk's dispatch. The lane's visited prefix and
+        # parent-log segment are complete and immutable at its settle
+        # chunk (fingerprints are sid-partitioned), so this snapshot
+        # decodes the lane's counterexample paths exactly.
+        carry = self.fused.chunk_records[chunk_idx]["carry"]
+        forest = {
+            k: np.asarray(carry[k])
+            for k in ("t_lo", "t_hi", "p_lo_t", "p_hi_t")
+        }
+        member.payload = dict(
+            upto=chunk_idx,
+            records=list(self.fused.chunk_records[: chunk_idx + 1]),
+            forest=forest,
+            # the fused compile's build rows as of this settle — the
+            # seed/chunk builds land before the first chunk sync, so
+            # even the earliest peel sees them (events append-only)
+            builds=[
+                dict(e) for e in self._lead_tracer.events
+                if e.get("ev") == "program_build"
+            ],
+        )
+        member.done.set()
+
+    # -- the member-side demux --------------------------------------------
+
+    def _replay(self, member: BatchMember) -> None:
+        """Replay this member's lane view of the fused run into the
+        member checker — on the member's OWN thread, under the
+        member's own thread-scoped tracer, so the session trace holds
+        only this session's events (zero cross-session bleed)."""
+        from . import telemetry
+
+        fused = self.fused
+        i = member.index
+        checker = member.checker
+        payload = member.payload
+        records = payload["records"]
+        tracer = telemetry.current_tracer()
+        n = len(self.members)
+
+        if tracer is not None:
+            tracer.event(
+                "batch", group=self.group_id, size=n, index=i,
+                chunks=len(records),
+            )
+            for b in payload["builds"]:
+                row = {
+                    k: v for k, v in b.items()
+                    if k not in ("ev", "run", "t")
+                }
+                for lane in ("wall_sec", "cold_sec"):
+                    if row.get(lane):
+                        row[lane] = round(row[lane] / n, 6)
+                row["batch"] = self.group_id
+                tracer.event("program_build", **row)
+
+        lane_waves = 0
+        verdicts_pending = {
+            gj: checker.model.properties()[gj - self.fused
+                                           .lane_slices[i].start]
+            for gj in range(self.fused.lane_slices[i].start,
+                            self.fused.lane_slices[i].stop)
+        }
+        emitted = set()
+        lat = dict(chunks=0, dispatch_sec=0.0, fetch_sec=0.0,
+                   device_sec=0.0, fetch_min=None,
+                   t_start=records[0]["t0"] if records else 0.0,
+                   t_first_sync=None)
+        chunk_out = 0
+        for r in records:
+            rows = r["rows"][:, i, :]
+            live = rows[rows[:, 0] > 0]
+            # sessions sharing this chunk's dispatch: each gets a
+            # 1/N_active share of its walls (the amortized sync floor)
+            n_active = max(
+                1,
+                int(np.sum(np.any(r["rows"][:, :, 0] > 0, axis=0))),
+            )
+            share_disp = r["dispatch_sec"] / n_active
+            share_fetch = r["fetch_sec"] / n_active
+            if live.size > 0:
+                wave_rows = [
+                    [int(row[0]), 0, int(row[1]), int(row[2]),
+                     int(row[3]), int(row[4]), 0, 0]
+                    for row in live
+                ]
+                if tracer is not None:
+                    tracer.record_chunk(
+                        chunk=chunk_out,
+                        wave0=lane_waves,
+                        t0=r["t0"],
+                        t1=r["t1"],
+                        dispatch_sec=share_disp,
+                        fetch_sec=share_fetch,
+                        n_waves=len(wave_rows),
+                        wave_rows=wave_rows,
+                        pairs_valid=False,
+                    )
+                lane_waves += len(wave_rows)
+                chunk_out += 1
+                lat["chunks"] += 1
+                lat["dispatch_sec"] += share_disp
+                lat["fetch_sec"] += share_fetch
+                if (lat["fetch_min"] is None
+                        or share_fetch < lat["fetch_min"]):
+                    lat["fetch_min"] = share_fetch
+                if lat["t_first_sync"] is None:
+                    lat["t_first_sync"] = r["t1"]
+            sl = self.fused.lane_slices[i]
+            for gj in list(verdicts_pending):
+                if r["disc"][gj] and gj not in emitted:
+                    emitted.add(gj)
+                    prop = verdicts_pending.pop(gj)
+                    fp = _fp_int(int(r["disc_lo"][gj]),
+                                 int(r["disc_hi"][gj]))
+                    checker._discovered_fps[prop.name] = fp
+                    if tracer is not None:
+                        tracer.event(
+                            "verdict",
+                            property=prop.name,
+                            expectation=prop.expectation.name.lower(),
+                            kind="discovery",
+                            wave=lane_waves,
+                            depth=int(r["depth"][i]),
+                            chunk=max(chunk_out - 1, 0),
+                        )
+            if r is records[-1]:
+                break
+
+        final = records[-1]
+        checker._total_states = int(final["gen"][i])
+        checker._unique_states = int(final["unique"][i])
+        checker._max_depth = int(final["depth"][i])
+        checker.metrics = dict(
+            frontier_size=0,
+            occupancy=(checker._unique_states
+                       / checker.total_capacity),
+            dedup_ratio=(
+                1.0 - checker._unique_states / checker._total_states
+                if checker._total_states else 0.0
+            ),
+            waves=lane_waves,
+            batch_size=n,
+        )
+        checker._lat = lat
+        checker.memory_plan = fused.memory_plan
+        checker._program_key_hash = fused._program_key_hash
+
+        # counterexample paths: decode through the FUSED parent forest
+        # (this lane's segment is complete at its settle chunk), then
+        # strip the sid — the member path replays on the member model.
+        if checker._discovered_fps:
+            forest = payload["forest"]
+            t_lo, t_hi, p_lo, p_hi = (
+                forest[k]
+                for k in ("t_lo", "t_hi", "p_lo_t", "p_hi_t")
+            )
+            occupied = (t_lo != 0) | (t_hi != 0)
+            child = (t_hi[occupied].astype(np.uint64) << np.uint64(32)
+                     ) | t_lo[occupied].astype(np.uint64)
+            parent = (p_hi[occupied].astype(np.uint64) << np.uint64(32)
+                      ) | p_lo[occupied].astype(np.uint64)
+            generated = {
+                int(c): (int(p) if p else None)
+                for c, p in zip(child.tolist(), parent.tolist())
+            }
+            for name, fp in checker._discovered_fps.items():
+                fused_path = fused._decode_path(generated, fp)
+                checker._discoveries[name] = Path([
+                    (st[1], act) for st, act in fused_path.steps
+                ])

@@ -698,7 +698,7 @@ class TpuBfsChecker(Checker):
                 table, lo0, hi0, jnp.ones(n0, dtype=bool), jnp,
                 rounds=probe_rounds,
             )
-            return dict(
+            out = dict(
                 t_lo=table.lo,
                 t_hi=table.hi,
                 # Parent 0 means "init/root": fingerprints are never 0.
@@ -724,6 +724,11 @@ class TpuBfsChecker(Checker):
                 e_overflow=jnp.bool_(False),
                 done=jnp.bool_(n0 == 0) | jnp.any(pending),
             )
+            # engine-variant carry extension (the fused multi-session
+            # engine adds per-session lanes — stateright_tpu/batch.py);
+            # base: no extra keys, identical program
+            out.update(self._seed_extra(out, init_rows, jnp))
+            return out
 
         def body(c):
             table = DeviceHashSet(c["t_lo"], c["t_hi"])
@@ -833,7 +838,7 @@ class TpuBfsChecker(Checker):
                 & ~c_overflow
                 & ~e_overflow
             )
-            return dict(
+            out = dict(
                 t_lo=table.lo,
                 t_hi=table.hi,
                 p_lo_t=p_lo_t,
@@ -856,6 +861,18 @@ class TpuBfsChecker(Checker):
                 e_overflow=e_overflow,
                 done=~cont,
             )
+            # Engine-variant wave extension: the hook sees the wave's
+            # internals (candidates, winners) and must return EVERY
+            # extra carry key it seeded (while_loop carries have a
+            # fixed structure); it may also override base keys (the
+            # fused engine masks fval by per-session settlement).
+            out.update(self._body_extra(
+                c, out,
+                dict(ex=ex, b_ext=b_ext, b_val=b_val, is_new=is_new,
+                     new_count=new_count, n_cand=n_cand),
+                jnp,
+            ))
+            return out
 
         def cond(c):
             return ~c["done"] & (c["wchunk"] < waves_per_sync)
@@ -907,6 +924,10 @@ class TpuBfsChecker(Checker):
                     c["disc_lo"],
                     c["disc_hi"],
                 ]
+                # engine-variant stat lanes AFTER the discovery lanes
+                # (consumed host-side by _consume_extra_stats /
+                # _on_chunk_stats); base: none
+                + list(self._stats_extra(c, jnp))
             )
             return c, stats
 
@@ -1419,6 +1440,14 @@ class TpuBfsChecker(Checker):
                 lat["fetch_min"] = fetch
             if lat["t_first_sync"] is None:
                 lat["t_first_sync"] = t1
+            # Per-chunk stats observation (stateright_tpu/batch.py):
+            # the fused multi-session engine demultiplexes its
+            # per-session stat lanes here, EVERY chunk — the end-of-run
+            # _consume_extra_stats is too late to peel a session that
+            # settled mid-batch. Base: no-op.
+            self._on_chunk_stats(
+                s, carry, chunk_no, t0, t1, t_disp - t0, t1 - t_dev
+            )
             if tracer is not None:
                 from ..memplan import device_bytes_in_use
 
@@ -1687,6 +1716,13 @@ class TpuBfsChecker(Checker):
         ``_fresh_build``: the seed and chunk rows then land at their
         real compile sites in ``_run`` (seed_upload, chunk-0
         dispatch), tier-attributed from the monitor deltas."""
+        # Admission-time pre-warm (stateright_tpu/serve.py): when the
+        # service kicked this build on a worker thread, join it first
+        # so the worker's _CHUNK_CACHE insert and this lookup cannot
+        # race — the run then takes the in-process-hit path.
+        pw = getattr(self, "_prewarm_wait", None)
+        if pw is not None:
+            pw()
         _enable_persistent_cache()
         cache_key = self._program_cache_key(n0)
         self._program_key_hash = _key_hash(cache_key)
@@ -1975,6 +2011,43 @@ class TpuBfsChecker(Checker):
     def _consume_extra_stats(self, extra: np.ndarray) -> None:
         """Hook for engine variants that append metric lanes after the
         per-property discovery lanes (see parallel/engine.py)."""
+
+    # -- fused multi-session hooks (stateright_tpu/batch.py) ---------------
+    #
+    # The wave batcher subclasses this engine and extends the device
+    # program through these four seams instead of forking it: extra
+    # carry lanes at seed, per-wave lane accounting (and per-session
+    # settlement masking) in the wave body, extra packed-stat lanes at
+    # the chunk sync, and a host-side per-chunk observation point for
+    # demultiplexing. All four are no-ops here — the base program and
+    # its compiled cache entries are byte-identical with the hooks in
+    # place (the subclass is a distinct _program_cache_key type).
+
+    def _seed_extra(self, out: dict, init_rows, jnp) -> dict:
+        """Extra carry keys merged into the seed program's output."""
+        return {}
+
+    def _body_extra(self, c: dict, out: dict, ctx: dict, jnp) -> dict:
+        """Extra (or overridden) carry keys merged into one wave's
+        output. Must return every key ``_seed_extra`` added — a
+        ``lax.while_loop`` carry's structure is fixed. ``ctx`` exposes
+        the wave internals: ``ex`` (expand_frontier output), ``b_ext``/
+        ``b_val`` (compacted candidate payload + validity), ``is_new``,
+        ``new_count``, ``n_cand``."""
+        return {}
+
+    def _stats_extra(self, c: dict, jnp) -> list:
+        """Extra 1-D uint32 lanes appended to the packed chunk stats
+        (host side: ``s[11 + 3 * n_props:]``)."""
+        return []
+
+    def _on_chunk_stats(self, s, carry, chunk_no: int, t0: float,
+                        t1: float, dispatch_sec: float,
+                        fetch_sec: float) -> None:
+        """Host observation of one chunk's packed stats, called every
+        chunk (unlike ``_consume_extra_stats``, which only fires at
+        run end/overflow — too late to peel a settled session out of
+        a live batch)."""
 
     def _wave_log_enabled(self) -> bool:
         """Whether the chunk carry includes the per-wave trace log.

@@ -417,16 +417,22 @@ def retain_final_snapshot(checker, path: str) -> Optional[dict]:
     Requires the run to have kept its final carry
     (``checker.keep_final_carry = True`` before join — the existing
     tools/profile_stages.py capture hook). Returns the manifest, or
-    None when there is nothing retainable: no final carry, a run that
-    raised, or a TIERED run (its visited set lives partly in host cold
-    runs; retaining only the device carry would warm-start from a
-    subset and silently re-explore — refuse instead of approximating).
+    None when there is nothing retainable: no final carry or a run
+    that raised. A TIERED run retains BOTH tiers — the snapshot
+    format already carries the cold runs (``tier_run*`` buffers) and
+    the host-drained parent log beside the device carry, so a tiered
+    re-check warm-starts exactly like a flat one (the forced-spill
+    regression test settles with zero new waves). Only a tiered run
+    whose ColdStore is gone (spills recorded but ``_tier_state``
+    cleared) still refuses: retaining the device carry alone would
+    warm-start from a subset and silently re-explore.
     """
     carry = getattr(checker, "_final_carry", None)
     if carry is None or checker._run_error is not None:
         return None
     metrics = getattr(checker, "metrics", None) or {}
-    if metrics.get("tier_spills"):
+    tier = getattr(checker, "_tier_state", None)
+    if metrics.get("tier_spills") and tier is None:
         return None
     lat = getattr(checker, "_lat", None) or {}
     return write_snapshot(
@@ -435,6 +441,8 @@ def retain_final_snapshot(checker, path: str) -> Optional[dict]:
         wave=int(metrics.get("waves") or 0),
         depth=int(checker._max_depth),
         unique=int(checker._unique_states),
+        tier=tier,
+        tier_plog=getattr(checker, "_tier_plog_rows", None),
     )
 
 

@@ -543,7 +543,9 @@ def _usage(model: str | None = None) -> None:
         print(
             "  python -m stateright_tpu serve [HOST:PORT] "
             "[--explore=MODEL[,COUNT]] [--program-budget-bytes=N] "
-            "[--device-budget-bytes=N] [--no-warm-start]"
+            "[--device-budget-bytes=N] [--no-warm-start] "
+            "[--batch-sessions[=N]] [--batch-window-sec=S] "
+            "[--snapshot-budget-bytes=N]"
         )
     print(f"NETWORK: {' | '.join(Network.names())}")
     print(
@@ -589,7 +591,13 @@ def _usage(model: str | None = None) -> None:
         "fingerprint-stable warm-start re-checks, and an optional "
         "Explorer mount; --connect=HOST:PORT on any check lane ships "
         "it to a running service (counts bit-identical, compile "
-        "amortized)"
+        "amortized); --batch-sessions[=N] fuses up to N concurrent "
+        "compatible sessions into ONE wave-program dispatch "
+        "(stateright_tpu/batch.py — per-session counts/verdicts/paths "
+        "stay bit-exact, the dispatch+sync floor is amortized 1/N; "
+        "--batch-window-sec=S sets the admission batching window); "
+        "--snapshot-budget-bytes=N caps the warm-start snapshot spool "
+        "with byte-budget LRU eviction (snapshot_evict events)"
     )
 
 

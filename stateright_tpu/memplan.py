@@ -148,6 +148,22 @@ def session_resident_bytes(checker) -> dict:
     )
 
 
+def fused_session_bytes(fused, n_sessions: int) -> dict:
+    """Admission pricing for a FUSED multi-session plan
+    (stateright_tpu/batch.py): :func:`session_resident_bytes` over the
+    fused engine's config, plus the per-session amortized share — the
+    number `CheckService._admit` compares against the device budget
+    when deciding whether N sessions fuse or spill to the solo FIFO.
+    Config-only, same as the solo pricing: no program build, no
+    device work."""
+    plan = session_resident_bytes(fused)
+    plan["n_sessions"] = int(n_sessions)
+    plan["per_session_bytes"] = plan["total_bytes"] // max(
+        1, int(n_sessions)
+    )
+    return plan
+
+
 def v_class_entries(v_ladder, nf_max: int) -> list:
     """Per-VISITED-ladder-class merge-scratch rows, shared by both
     sort-merge engines' ``_build_info`` (one pricing, no drift): the

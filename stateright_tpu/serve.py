@@ -160,6 +160,31 @@ class _GateHandle:
         return False
 
 
+class _FusedGateHandle:
+    """The fused-dispatch view of the device gate: one acquire per
+    fused chunk advances N sessions at once, so the queue wait is
+    attributed to every member session as a 1/N share — the same
+    amortization the latency profile applies to the sync floor."""
+
+    __slots__ = ("_gate", "_sessions")
+
+    def __init__(self, gate: FifoLock, sessions: list):
+        self._gate = gate
+        self._sessions = sessions
+
+    def __enter__(self):
+        t0 = time.monotonic()
+        self._gate.acquire()
+        share = (time.monotonic() - t0) / max(1, len(self._sessions))
+        for s in self._sessions:
+            s.gate_wait_sec += share
+        return self
+
+    def __exit__(self, *exc):
+        self._gate.release()
+        return False
+
+
 # -- per-thread stdout capture --------------------------------------------
 
 
@@ -240,6 +265,10 @@ class Session:
         self.unique: Optional[int] = None
         self.total: Optional[int] = None
         self.evictions: list = []
+        self.snapshot_evictions: list = []
+        #: set when this session ran as a lane of a fused batch
+        #: dispatch: {group, size, index}
+        self.batch: Optional[dict] = None
         self.gate_wait_sec = 0.0
         self.t_submit = time.monotonic()
         self.t_admit: Optional[float] = None
@@ -291,11 +320,30 @@ class CheckService:
                  device_budget_bytes: Optional[int] = None,
                  spool_dir: Optional[str] = None,
                  warm_start: bool = True,
-                 max_retained_sessions: int = 256):
+                 max_retained_sessions: int = 256,
+                 batch_sessions: Optional[int] = None,
+                 batch_window_sec: float = 0.25,
+                 batch_waves_per_sync: Optional[int] = None,
+                 snapshot_budget_bytes: Optional[int] = None):
         self.program_budget_bytes = program_budget_bytes
         self.device_budget_bytes = device_budget_bytes
         self.warm_start = warm_start
         self.max_retained_sessions = max_retained_sessions
+        #: wave batching (stateright_tpu/batch.py): fuse up to N
+        #: concurrent compatible check sessions into one device
+        #: dispatch (None = off, every session runs solo FIFO).
+        #: Sessions rendezvous for up to ``batch_window_sec`` — a
+        #: group that fills earlier dispatches immediately, one that
+        #: stays singleton falls back to the solo path with a
+        #: one-line reason.
+        self.batch_sessions = batch_sessions
+        self.batch_window_sec = batch_window_sec
+        self.batch_waves_per_sync = batch_waves_per_sync
+        #: retained-snapshot spool byte budget (None = unbounded):
+        #: the warm-start snapshots are priced by their on-disk
+        #: manifest bytes and evicted LRU past the budget — the
+        #: snapshot analogue of ``program_budget_bytes``.
+        self.snapshot_budget_bytes = snapshot_budget_bytes
         self.spool_dir = spool_dir or tempfile.mkdtemp(
             prefix="stpu_serve_"
         )
@@ -304,11 +352,24 @@ class CheckService:
         self._gate = FifoLock()
         self._sessions: list[Session] = []
         self._ids = itertools.count()
-        #: encoding fingerprint -> retained warm-start snapshot path
-        self._warm: dict[str, str] = {}
+        #: encoding fingerprint -> {path, bytes}: the byte-priced
+        #: retained warm-start snapshot spool (most-recently-used
+        #: last, same policy as the program LRU)
+        self._warm: "OrderedDict[str, dict]" = OrderedDict()
         #: program-key-hash -> {key, bytes}: the byte-priced LRU view
         #: over the engines' _CHUNK_CACHE (most-recently-used last)
         self._lru: "OrderedDict[str, dict]" = OrderedDict()
+        #: compatibility class key -> the currently-OPEN BatchGroup
+        self._groups: dict = {}
+        self._group_ids = itertools.count(1)
+        #: settled fused groups (serve_summary's batches block rides
+        #: the per-session ``batch`` trace events; this is the
+        #: service-side admission record)
+        self._batches: list[dict] = []
+        #: encoding fingerprints ever admitted — the pre-warm
+        #: registry: a repeat fingerprint kicks its program
+        #: build-or-fetch on a worker thread at admission
+        self._fp_registry: set = set()
         self._explorer = None  # (checker, snapshot, session)
 
     # -- check sessions ---------------------------------------------------
@@ -414,23 +475,229 @@ class CheckService:
                 session.running = True
                 return
             session.device = True
-            self._admit(session, checker)
-            if self.warm_start:
-                fp = checkpoint.encoding_fingerprint(checker)
-                session.encoding_fp = fp
-                path = self._warm.get(fp)
-                if path is not None:
-                    try:
-                        checker.resume_from(path)
-                        session.warm_start = True
-                    except checkpoint.SnapshotError:
-                        # stale/incompatible retention: run cold —
-                        # correctness never rides the cache
-                        session.warm_start = False
-            checker.keep_final_carry = True
-            checker.dispatch_gate = _GateHandle(self._gate, session)
+            fp = checkpoint.encoding_fingerprint(checker)
+            session.encoding_fp = fp
+            warm_entry = (self._warm.get(fp)
+                          if self.warm_start else None)
+            # wave batching: a warm-startable session settles in one
+            # chunk solo — resuming beats fusing, so only sessions
+            # with no retained snapshot rendezvous
+            if self.batch_sessions and warm_entry is None:
+                from .batch import batch_eligible
+
+                key, _reason = batch_eligible(checker)
+                if key is not None and self._join_batch(
+                    key, session, checker
+                ):
+                    return
+            self._solo_setup(session, checker)
 
         return hook
+
+    def _solo_setup(self, session: Session, checker) -> None:
+        """The round-18 solo session path: admission, pre-warm,
+        warm-start staging, retention arming, and the FIFO gate. Also
+        the landing spot when a batch seat falls back (the member's
+        ``solo_prepare``)."""
+        self._admit(session, checker)
+        fp = session.encoding_fp
+        if fp is not None:
+            with self._lock:
+                seen = fp in self._fp_registry
+                self._fp_registry.add(fp)
+            if seen and not getattr(checker, "_prewarm_wait", None):
+                # ROADMAP 3(d): cold time-to-first-wave is
+                # compile-dominated — a repeat fingerprint's program
+                # build-or-fetch starts NOW, off-thread, instead of
+                # inside the session's first dispatch
+                self._prewarm(session, checker)
+        if self.warm_start and fp is not None:
+            entry = self._warm.get(fp)
+            if entry is not None:
+                try:
+                    checker.resume_from(entry["path"])
+                    session.warm_start = True
+                    with self._lock:
+                        self._warm.move_to_end(fp)
+                except checkpoint.SnapshotError:
+                    # stale/incompatible retention: run cold —
+                    # correctness never rides the cache
+                    session.warm_start = False
+        checker.keep_final_carry = True
+        checker.dispatch_gate = _GateHandle(self._gate, session)
+
+    # -- admission-time program pre-warm ----------------------------------
+
+    def _prewarm(self, session: Session, checker) -> None:
+        """Kick the program build-or-fetch on a worker thread and
+        install the ``_prewarm_wait`` seam (checkers/tpu.py
+        ``_lookup_programs`` joins it before its own lookup, so the
+        worker's cache insert and the run's lookup cannot race). The
+        joined result is ledger-attributed as a ``program_build``
+        event with a ``prewarm`` marker under the session tracer."""
+        from .checkers import tpu as _tpu
+
+        res: dict = {}
+
+        def worker():
+            snap = _tpu._monitor_snapshot()
+            t0 = time.monotonic()
+            try:
+                iv = checker.encoded.init_vecs()
+                n0 = len({
+                    checker._vec_fp(iv[i]) for i in range(len(iv))
+                })
+                seed_fn, chunk_fn = checker._lookup_programs(n0)
+                import jax
+                import jax.numpy as jnp
+
+                spec = jax.eval_shape(
+                    seed_fn,
+                    jax.ShapeDtypeStruct(
+                        (n0, checker.encoded.width), jnp.uint32
+                    ),
+                )
+                # AOT backend compile-or-fetch: the run's own jit call
+                # re-traces, but its backend half dedups against the
+                # persistent XLA cache this compile just populated
+                chunk_fn.lower(spec).compile()
+                tier, wall, cold = _tpu._resolve_tier(
+                    _tpu._monitor_delta(snap)
+                )
+                res.update(
+                    tier=tier,
+                    wall=wall or (time.monotonic() - t0),
+                    cold=cold,
+                )
+            except Exception as exc:
+                res["error"] = f"{type(exc).__name__}: {exc}"
+
+        th = threading.Thread(
+            target=worker, name=f"prewarm-{session.id}", daemon=True
+        )
+        emitted = [False]
+
+        def wait():
+            if threading.current_thread() is th:
+                return  # the worker's own lookup must not self-join
+            th.join()
+            if emitted[0] or "tier" not in res:
+                return
+            emitted[0] = True
+            tracer = telemetry.current_tracer()
+            if tracer is not None:
+                tracer.event(
+                    "program_build", program="programs",
+                    tier=res["tier"],
+                    key=getattr(checker, "_program_key_hash", None),
+                    wall_sec=round(res["wall"], 6),
+                    cold_sec=(None if res.get("cold") is None
+                              else round(res["cold"], 6)),
+                    prewarm=True,
+                )
+
+        checker._prewarm_wait = wait
+        th.start()
+
+    # -- wave batching -----------------------------------------------------
+
+    def _join_batch(self, key, session: Session, checker) -> bool:
+        """Claim a seat in the open batch group of this compatibility
+        class (opening a fresh group when none is open or the open one
+        froze), and swap the checker's ``_run`` for the group's
+        member entry point. Returns False when a seat could not be
+        claimed (the session runs solo)."""
+        from .batch import BatchGroup
+
+        with self._lock:
+            group = self._groups.get(key)
+            member = (group.try_join(checker, " ".join(session.argv))
+                      if group is not None else None)
+            if member is None:
+                group = BatchGroup(
+                    next(self._group_ids), key,
+                    max_sessions=int(self.batch_sessions),
+                    window_sec=self.batch_window_sec,
+                    waves_per_sync=self.batch_waves_per_sync,
+                )
+                group.admit = (
+                    lambda fused, members, g=group:
+                    self._admit_fused(g, fused, members)
+                )
+                group.make_gate = (
+                    lambda g=group: _FusedGateHandle(
+                        self._gate,
+                        [m.session for m in g.members],
+                    )
+                )
+                self._groups[key] = group
+                member = group.try_join(
+                    checker, " ".join(session.argv)
+                )
+            if member is None:
+                return False
+        member.session = session
+        member.notify = print  # session thread: the stdout proxy
+
+        def solo_prepare():
+            session.batch = None  # this session did not batch
+            self._solo_setup(session, checker)
+
+        member.solo_prepare = solo_prepare
+        session.batch = dict(
+            group=group.group_id, size=None, index=member.index
+        )
+        checker._run = (
+            lambda reporter=None: group.member_run(member, reporter)
+        )
+        return True
+
+    def _admit_fused(self, group, fused, members) -> Optional[str]:
+        """Admission for a FUSED plan (the batch analogue of
+        :meth:`_admit`, invoked by the group leader at freeze):
+        price the fused engine's resident plan via the memplan ledger
+        against the device budget minus other in-flight sessions.
+        Returns None (admitted — every member session is marked
+        running with its amortized byte share) or a one-line refusal
+        reason (the group falls back to solo FIFO, where each session
+        faces the ordinary solo admission)."""
+        plan = memplan.fused_session_bytes(fused, len(members))
+        sessions = [m.session for m in members]
+        with self._lock:
+            in_flight = sum(
+                s.admitted_bytes or 0
+                for s in self._sessions
+                if s.running and s.device and s not in sessions
+            )
+            budget = self.device_budget_bytes
+            if (budget is not None
+                    and plan["total_bytes"] + in_flight > budget):
+                return (
+                    f"batch: fused plan of {len(members)} session(s) "
+                    f"projects {plan['total_bytes']:,} resident "
+                    f"bytes ({in_flight:,} already in flight, device "
+                    f"budget {budget:,}); falling back to solo FIFO"
+                )
+            now = time.monotonic()
+            for s in sessions:
+                s.admitted_bytes = plan["per_session_bytes"]
+                s.t_admit = now
+                s.running = True
+                if s.batch is not None:
+                    s.batch["size"] = len(members)
+            self._batches.append(dict(
+                group=group.group_id,
+                size=len(members),
+                sessions=[s.id for s in sessions],
+                class_key=str(group.class_key),
+                plan_bytes=plan["total_bytes"],
+                per_session_bytes=plan["per_session_bytes"],
+            ))
+            # this group is dispatching: close the class slot so the
+            # next arrival opens a fresh group
+            if self._groups.get(group.class_key) is group:
+                del self._groups[group.class_key]
+        return None
 
     def _admit(self, session: Session, checker) -> None:
         """The admission check (ISSUE contract: against the capacity
@@ -487,7 +754,15 @@ class CheckService:
                     checker, path
                 )
                 if manifest is not None:
-                    self._warm[session.encoding_fp] = path
+                    with self._lock:
+                        self._warm[session.encoding_fp] = dict(
+                            key=key, path=path,
+                            bytes=int(
+                                manifest.get("snapshot_bytes") or 0
+                            ),
+                        )
+                        self._warm.move_to_end(session.encoding_fp)
+                    self._spool_evict(session)
             except Exception:
                 pass  # retention is an optimization, never a failure
         # the retained snapshot (or nothing) is the warm state now —
@@ -495,6 +770,41 @@ class CheckService:
         # don't pin HBM
         checker._final_carry = None
         self._lru_note(session, checker)
+
+    # -- retained-snapshot spool LRU --------------------------------------
+
+    def _spool_evict(self, session: Session) -> None:
+        """Bound the warm-start snapshot spool by BYTES, the same LRU
+        policy the compiled-program cache uses: evict the
+        least-recently-used retained snapshots past
+        ``snapshot_budget_bytes`` (never the one just retained). An
+        evicted fingerprint's next re-check runs cold — counts
+        unaffected, only the warm start is lost."""
+        budget = self.snapshot_budget_bytes
+        if budget is None:
+            return
+        evicted = []
+        with self._lock:
+            total = sum(e["bytes"] for e in self._warm.values())
+            while total > budget and len(self._warm) > 1:
+                fp, entry = next(iter(self._warm.items()))
+                if fp == session.encoding_fp:
+                    break
+                self._warm.pop(fp)
+                total -= entry["bytes"]
+                evicted.append(entry)
+                session.snapshot_evictions.append(
+                    (entry["key"], entry["bytes"])
+                )
+        for entry in evicted:
+            try:
+                os.remove(entry["path"])
+            except OSError:
+                pass
+
+    def spool_bytes(self) -> int:
+        with self._lock:
+            return sum(e["bytes"] for e in self._warm.values())
 
     # -- compiled-program LRU ---------------------------------------------
 
@@ -635,6 +945,9 @@ class CheckService:
             sessions = [s.describe() for s in self._sessions]
             lru_bytes = sum(e["bytes"] for e in self._lru.values())
             lru_len = len(self._lru)
+            warm_n = len(self._warm)
+            spool = sum(e["bytes"] for e in self._warm.values())
+            n_batches = len(self._batches)
         return dict(
             sessions=sessions,
             programs=dict(
@@ -643,7 +956,17 @@ class CheckService:
                 budget_bytes=self.program_budget_bytes,
             ),
             device_budget_bytes=self.device_budget_bytes,
-            warm_models=len(self._warm),
+            warm_models=warm_n,
+            snapshots=dict(
+                retained=warm_n,
+                bytes=spool,
+                budget_bytes=self.snapshot_budget_bytes,
+            ),
+            batching=dict(
+                batch_sessions=self.batch_sessions,
+                window_sec=self.batch_window_sec,
+                groups_dispatched=n_batches,
+            ),
         )
 
     # -- merged trace export ----------------------------------------------
@@ -717,6 +1040,11 @@ class CheckService:
                     ev="program_evict", run=rb, key=key_hash,
                     bytes=int(nbytes), t=round(t_end - self._t0, 6),
                 ))
+            for key_hash, nbytes in s.snapshot_evictions:
+                out.append(dict(
+                    ev="snapshot_evict", run=rb, key=key_hash,
+                    bytes=int(nbytes), t=round(t_end - self._t0, 6),
+                ))
             base += len(runs)
         return out
 
@@ -750,6 +1078,8 @@ def serve_summary(events: list) -> Optional[dict]:
         return None
     ends = {e["session"]: e for e in events
             if e.get("ev") == "session_end"}
+    batch_by_run = {e["run"]: e for e in events
+                    if e.get("ev") == "batch"}
     sessions = []
     for sb in sorted(begins, key=lambda e: e["session"]):
         run = sb["run"]
@@ -808,6 +1138,12 @@ def serve_summary(events: list) -> Optional[dict]:
                 cold_sec=round(cold, 6),
             ),
             program_key=se.get("program_key"),
+            batch=(
+                {k: batch_by_run[run][k]
+                 for k in ("group", "size", "index", "chunks")
+                 if k in batch_by_run[run]}
+                if run in batch_by_run else None
+            ),
             explorer=(dict(
                 requests=len(spans),
                 cache_hits=sum(
@@ -819,11 +1155,58 @@ def serve_summary(events: list) -> Optional[dict]:
         {k: v for k, v in e.items() if k != "ev"}
         for e in events if e.get("ev") == "program_evict"
     ]
+    snapshot_evictions = [
+        {k: v for k, v in e.items() if k != "ev"}
+        for e in events if e.get("ev") == "snapshot_evict"
+    ]
     return dict(
         sessions=sessions,
         evictions=evictions,
+        snapshot_evictions=snapshot_evictions,
         warm_vs_cold=_warm_vs_cold(sessions),
+        batches=_batch_groups(sessions),
     )
+
+
+def _batch_groups(sessions: list) -> list:
+    """Aggregate the per-session ``batch`` lanes into per-group rows:
+    occupancy (which sessions shared the fused dispatch, how many
+    fused chunks they rode) and the amortized floor per query — each
+    member's dispatch+sync overhead is already its 1/N_active share
+    of the fused walls, so the mean per-query overhead IS the
+    amortized sync floor serve_report tables against the solo
+    baseline."""
+    groups: dict = {}
+    for s in sessions:
+        b = s.get("batch")
+        if not b:
+            continue
+        g = groups.setdefault(b["group"], dict(
+            group=b["group"],
+            size=b.get("size"),
+            sessions=[],
+            chunks=b.get("chunks"),
+            members=[],
+        ))
+        g["sessions"].append(s["session"])
+        overhead = ((s.get("dispatch_net_sec") or 0.0)
+                    + (s.get("fetch_sec") or 0.0))
+        g["members"].append(dict(
+            session=s["session"],
+            waves=s.get("waves"),
+            dispatch_net_sec=s.get("dispatch_net_sec"),
+            fetch_sec=s.get("fetch_sec"),
+            overhead_sec=round(overhead, 6),
+            time_to_verdict_sec=s.get("time_to_verdict_sec"),
+        ))
+    out = []
+    for g in sorted(groups.values(), key=lambda g: g["group"]):
+        ov = [m["overhead_sec"] for m in g["members"]]
+        g["per_query_overhead_sec"] = (
+            round(sum(ov) / len(ov), 6) if ov else None
+        )
+        out.append(g)
+    return out
 
 
 def _warm_vs_cold(sessions: list) -> list:
@@ -937,9 +1320,13 @@ def explorer_builder(name: str, count: Optional[int] = None):
 def daemon_main(argv: list) -> int:
     """``python -m stateright_tpu serve [HOST:PORT] [--explore=MODEL
     [,COUNT]] [--program-budget-bytes=N] [--device-budget-bytes=N]
-    [--no-warm-start]`` — run the resident service until interrupted.
-    Clients reach it with ``--connect=HOST:PORT`` on any check lane,
-    a browser at ``/`` when an Explorer model is mounted."""
+    [--batch-sessions[=N]] [--batch-window-sec=S]
+    [--snapshot-budget-bytes=N] [--no-warm-start]`` — run the
+    resident service until interrupted. Clients reach it with
+    ``--connect=HOST:PORT`` on any check lane, a browser at ``/``
+    when an Explorer model is mounted. ``--batch-sessions`` fuses up
+    to N (default 4) concurrent compatible check sessions into one
+    device dispatch (stateright_tpu/batch.py)."""
     addr = "localhost:3000"
     explore = None
     kw: dict = {}
@@ -952,6 +1339,14 @@ def daemon_main(argv: list) -> int:
             kw["program_budget_bytes"] = int(a.split("=", 1)[1])
         elif a.startswith("--device-budget-bytes="):
             kw["device_budget_bytes"] = int(a.split("=", 1)[1])
+        elif a == "--batch-sessions":
+            kw["batch_sessions"] = 4
+        elif a.startswith("--batch-sessions="):
+            kw["batch_sessions"] = int(a.split("=", 1)[1])
+        elif a.startswith("--batch-window-sec="):
+            kw["batch_window_sec"] = float(a.split("=", 1)[1])
+        elif a.startswith("--snapshot-budget-bytes="):
+            kw["snapshot_budget_bytes"] = int(a.split("=", 1)[1])
         elif a == "--no-warm-start":
             kw["warm_start"] = False
         elif a.startswith("--"):

@@ -834,6 +834,17 @@ _REQUIRED = {
     "session_begin": ("run", "session", "kind", "t"),
     "session_end": ("run", "session", "state", "t"),
     "program_evict": ("run", "key", "bytes", "t"),
+    # The wave batcher (stateright_tpu/batch.py): ``batch`` — this
+    # session's run was a lane of a fused multi-session dispatch
+    # (group id, fused size, this session's lane index); its chunk
+    # walls carry the 1/N_active amortized shares and its
+    # program_build rows are re-emitted 1/N-amortized with a
+    # ``batch`` marker. ``snapshot_evict`` — the retained-warm-start
+    # snapshot spool dropped an entry to stay under its byte budget
+    # (the snapshot analogue of ``program_evict``; the next re-check
+    # of that fingerprint runs cold, counts unaffected).
+    "batch": ("run", "group", "size", "index", "t"),
+    "snapshot_evict": ("run", "key", "bytes", "t"),
 }
 
 

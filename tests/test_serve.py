@@ -252,6 +252,10 @@ def test_lru_eviction_recompiles_and_matches_counts(tmp_path):
         spool_dir=str(tmp_path), program_budget_bytes=1,
         warm_start=False,
     )
+    # the round-19 repeat-fingerprint prewarm would pay the rebuild
+    # on its worker thread (it has its own test); disable it so the
+    # RUN's own lookup pays it and the ledger shows the eviction
+    service._prewarm = lambda *a, **kw: None
     a = service.check(["2pc", "check-tpu", "3"])
     assert a.unique == 288
     assert a.program_key is not None
@@ -446,3 +450,252 @@ def test_make_server_requires_checker_or_registry():
 
     with pytest.raises(ValueError, match="checker, a registry"):
         make_server(None, Snapshot(), "127.0.0.1", 0)
+
+
+# -- wave batching: fused multi-session dispatch --------------------------
+
+
+def _concurrent(service, lanes, stagger_sec=0.0):
+    """Submit lanes on real threads (staggered when the join ORDER
+    matters — seat 0 leads the fused dispatch) and return the
+    sessions in submission order."""
+    import time as _time
+
+    results: dict = {}
+
+    def run(i, argv):
+        results[i] = service.check(argv)
+
+    threads = []
+    for i, argv in enumerate(lanes):
+        t = threading.Thread(target=run, args=(i, argv))
+        t.start()
+        threads.append(t)
+        if stagger_sec and i + 1 < len(lanes):
+            _time.sleep(stagger_sec)
+    for t in threads:
+        t.join()
+    return [results[i] for i in range(len(lanes))]
+
+
+def test_batched_sessions_pinned_counts_zero_bleed(tmp_path):
+    """The batching acceptance row (ISSUE 16 tests a+b): four
+    concurrent sessions — paxos 2c/3s x2 and 2pc rm=4 x2 — fuse into
+    TWO groups (paxos and 2pc encode to different compatibility
+    classes), every lane reproduces its pinned solo count, and each
+    session's trace validates independently with only its own lane's
+    events (zero cross-session bleed through the fused dispatch)."""
+    service = CheckService(
+        spool_dir=str(tmp_path), warm_start=False,
+        batch_sessions=2, batch_window_sec=30.0,
+    )
+    sessions = _concurrent(service, [
+        ["paxos", "check-tpu", "2"],
+        ["paxos", "check-tpu", "2"],
+        ["2pc", "check-tpu", "4"],
+        ["2pc", "check-tpu", "4"],
+    ])
+    pinned = {"paxos": 16668, "2pc": 1568}
+    for s in sessions:
+        assert s.state == "done", s.error
+        assert s.unique == pinned[s.argv[0]]
+        assert f"unique={pinned[s.argv[0]]}" in s.output
+        # every seat actually rode a size-2 fused dispatch
+        assert s.batch is not None and s.batch["size"] == 2
+
+    # different encoding shapes never share a group
+    paxos_groups = {s.batch["group"] for s in sessions[:2]}
+    twopc_groups = {s.batch["group"] for s in sessions[2:]}
+    assert len(paxos_groups) == len(twopc_groups) == 1
+    assert paxos_groups != twopc_groups
+
+    # zero cross-session bleed: each member trace validates on its
+    # own, names only its own lane, and its per-wave running unique
+    # total lands on the pinned count
+    for s, enc in zip(sessions, ("PaxosEncoded",) * 2
+                      + ("TwoPhaseSysEncoded",) * 2):
+        validate_events(s.tracer.events)
+        begins = [e for e in s.tracer.events if e["ev"] == "run_begin"]
+        assert len(begins) == 1
+        assert begins[0]["lane"]["encoding"] == enc
+        assert {e.get("run") for e in s.tracer.events} == {0}
+        assert _wave_events(s)[-1]["unique_total"] == \
+            pinned[s.argv[0]]
+        # the batch marker rode the trace too (serve_summary demuxes
+        # groups from it)
+        marks = [e for e in s.tracer.events if e["ev"] == "batch"]
+        assert len(marks) == 1 and marks[0]["size"] == 2
+
+    # the merged service trace validates with disjoint runs, and the
+    # summary's batches block shows both groups fully occupied
+    merged = service.events()
+    validate_events(merged)
+    summary = serve_summary(merged)
+    batches = summary["batches"]
+    assert len(batches) == 2
+    for g in batches:
+        assert g["size"] == 2 and len(g["sessions"]) == 2
+        assert g["per_query_overhead_sec"] is not None
+
+
+def test_batched_vs_solo_trace_diff_zero_divergence(tmp_path):
+    """trace_diff treats a batched member run vs a solo run of the
+    same model as comparable with ZERO counter divergence — the
+    per-wave proof that the sid-partition keeps each session's
+    frontier/candidate/new/unique stream bit-exact through the fused
+    dispatch."""
+    from stateright_tpu.telemetry import diff_traces
+
+    service = CheckService(
+        spool_dir=str(tmp_path), warm_start=False,
+        batch_sessions=2, batch_window_sec=30.0,
+    )
+    batched = _concurrent(service, [
+        ["2pc", "check-tpu", "4"],
+        ["2pc", "check-tpu", "4"],
+    ])
+    solo_dir = tmp_path / "solo"
+    solo_dir.mkdir()
+    solo = CheckService(
+        spool_dir=str(solo_dir), warm_start=False,
+    ).check(["2pc", "check-tpu", "4"])
+    assert solo.unique == 1568
+    for s in batched:
+        assert s.unique == 1568 and s.batch["size"] == 2
+        rep = diff_traces(s.tracer.events, solo.tracer.events)
+        assert rep["divergences"] == []
+        assert rep["latency"]["divergences"] == []
+        assert rep["memory"]["divergences"] == []
+        # batched counterexample paths replay like solo ones
+        assert sorted(s.checker.discoveries()) == \
+            sorted(solo.checker.discoveries())
+
+
+def test_batch_early_settle_peels_out(tmp_path):
+    """ISSUE 16 test c: a session that settles early peels OUT of the
+    fused dispatch between chunks — it does not hold the surviving
+    session's waves. 2pc rm=3 (11 waves) fuses with rm=4 (14 waves)
+    in one class; at waves_per_sync=4 the rm=3 seat wakes after chunk
+    3 while rm=4 rides all 4 fused chunks. Seat 0 leads the fused
+    run, so the early settler must join second (the stagger)."""
+    service = CheckService(
+        spool_dir=str(tmp_path), warm_start=False,
+        batch_sessions=2, batch_window_sec=30.0,
+        batch_waves_per_sync=4,
+    )
+    big, small = _concurrent(service, [
+        ["2pc", "check-tpu", "4"],
+        ["2pc", "check-tpu", "3"],
+    ], stagger_sec=1.0)
+    assert big.state == "done" and big.unique == 1568
+    assert small.state == "done" and small.unique == 288
+    assert big.batch["index"] == 0 and small.batch["index"] == 1
+    assert big.batch["size"] == small.batch["size"] == 2
+
+    def chunks(s):
+        prof = [e for e in s.tracer.events
+                if e["ev"] == "latency_profile"][-1]
+        return prof["chunks"]
+
+    assert chunks(small) < chunks(big)  # peeled out early
+
+
+def test_batch_incompatible_shapes_fall_back_solo(tmp_path):
+    """ISSUE 16 test d: sessions whose encodings land in different
+    compatibility classes never fuse — each falls back to the solo
+    FIFO gate with a one-line reason in its output, counts
+    unaffected."""
+    service = CheckService(
+        spool_dir=str(tmp_path), warm_start=False,
+        batch_sessions=2, batch_window_sec=0.5,
+    )
+    inc, twopc = _concurrent(service, [
+        ["increment", "check-tpu", "2"],
+        ["2pc", "check-tpu", "3"],
+    ])
+    assert inc.state == "done" and twopc.state == "done"
+    assert twopc.unique == 288
+    for s in (inc, twopc):
+        assert s.batch is None  # solo_prepare cleared the seat
+        assert ("batch: no compatible peers arrived within the "
+                "batching window") in s.output
+    # no group ever dispatched
+    assert serve_summary(service.events())["batches"] == []
+
+
+def test_batch_fused_admission_refusal(tmp_path):
+    """ISSUE 16 test e: the fused plan is priced via the memplan
+    ledger BEFORE device work — when it exceeds the device budget the
+    group refuses with a one-line reason and falls back to solo FIFO
+    (where each seat faces ordinary solo admission)."""
+    service = CheckService(
+        spool_dir=str(tmp_path), warm_start=False,
+        batch_sessions=2, batch_window_sec=30.0,
+        device_budget_bytes=1024,
+    )
+    sessions = _concurrent(service, [
+        ["2pc", "check-tpu", "3"],
+        ["2pc", "check-tpu", "3"],
+    ])
+    for s in sessions:
+        assert "batch: fused plan of 2 session(s)" in s.output
+        assert "falling back to solo FIFO" in s.output
+        # the solo fallback then refused under the same tiny budget,
+        # before any program build or device work
+        assert s.state == "refused"
+        assert "admission refused" in s.error
+        assert s.checker._programs is None
+
+
+# -- admission-time program pre-warm (satellite) --------------------------
+
+
+def test_prewarm_on_repeat_fingerprint(tmp_path):
+    """A repeat encoding fingerprint kicks the program build-or-fetch
+    on a worker thread at admission (ROADMAP 3(d)); the joined result
+    is ledger-attributed as a ``program_build`` event with a
+    ``prewarm`` marker, and counts are unaffected."""
+    service = CheckService(spool_dir=str(tmp_path), warm_start=False)
+    a = service.check(["2pc", "check-tpu", "3"])
+    b = service.check(["2pc", "check-tpu", "3"])
+    assert a.unique == b.unique == 288
+    assert not [e for e in _builds(a) if e.get("prewarm")]
+    pre = [e for e in _builds(b, "programs") if e.get("prewarm")]
+    assert len(pre) == 1
+    # the tier depends on what the shared XLA caches already hold in
+    # this process; the ledger attribution itself is the contract
+    assert pre[0]["tier"] in ("in_process", "disk", "cold", "mixed")
+    assert pre[0]["wall_sec"] >= 0
+    validate_events(b.tracer.events)
+
+
+# -- snapshot spool: byte-budget LRU (satellite) --------------------------
+
+
+def test_snapshot_spool_budget_evicts_lru(tmp_path):
+    """Retained warm-start snapshots ride the same byte-budget LRU
+    policy as compiled programs: a forced-tiny spool budget evicts
+    the LRU fingerprint's snapshot (``snapshot_evict`` events), the
+    evicted model's next re-check runs cold, and counts never ride
+    the cache."""
+    service = CheckService(
+        spool_dir=str(tmp_path), snapshot_budget_bytes=1,
+    )
+    a = service.check(["2pc", "check-tpu", "3"])
+    b = service.check(["2pc", "check-tpu", "4"])
+    assert a.unique == 288 and b.unique == 1568
+    # b's retention pushed a's snapshot out of the byte budget (one
+    # entry always survives: b's own)
+    assert b.snapshot_evictions
+    assert service.spool_bytes() > 1
+
+    c = service.check(["2pc", "check-tpu", "3"])
+    assert c.unique == 288  # counts survive eviction
+    assert not c.warm_start  # the evicted snapshot could not serve
+    assert len(_wave_events(c)) > 0
+
+    merged = service.events()
+    validate_events(merged)
+    ev = [e for e in merged if e["ev"] == "snapshot_evict"]
+    assert ev and ev[0]["key"] == b.snapshot_evictions[0][0]
+    assert ev[0]["bytes"] == b.snapshot_evictions[0][1]

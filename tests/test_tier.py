@@ -429,3 +429,55 @@ def test_tier_auto_ceiling_dormant():
     assert c2.unique_state_count() == 288
     assert c2._tier_hot_ceiling == 64
     assert c2.metrics["tier_spills"] >= 1
+
+
+# -- tiered retention: warm-start for forced-spill runs -------------------
+
+
+def test_tiered_retention_warm_start_zero_new_waves(tmp_path):
+    """``retain_final_snapshot`` no longer refuses tiered sessions:
+    the final carry serializes with BOTH tiers (hot carry + cold runs
+    + the host parent-log segment), and a fresh checker resuming from
+    the retained snapshot settles at its first sync with ZERO new
+    waves dispatched at the pinned count — the forced-spill analogue
+    of the resident warm-start re-check."""
+    import os
+
+    from stateright_tpu import checkpoint
+    from stateright_tpu.telemetry import RunTracer
+
+    def build():
+        # frontier gets the tiered headroom notch (cand 4096) so the
+        # forced-spill run cannot f_overflow mid-run
+        return TwoPhaseSys(rm_count=4).checker().spawn_tpu_sortmerge(
+            capacity=1 << 11, frontier_capacity=4096,
+            cand_capacity=4096, waves_per_sync=4, tier_hot_rows=256,
+        )
+
+    cold = build()
+    cold.keep_final_carry = True
+    cold.join()
+    assert cold.unique_state_count() == 1568
+    assert cold.metrics["tier_spills"] >= 2  # the refusal's old trigger
+
+    path = os.path.join(str(tmp_path), "tiered.ckpt")
+    manifest = checkpoint.retain_final_snapshot(cold, path)
+    assert manifest is not None
+    tier = manifest["tier"]
+    assert tier["spills"] == cold.metrics["tier_spills"]
+    assert tier["cold_rows_total"] == cold.metrics["cold_rows"]
+    assert tier["plog_host_rows"] > 0  # paths survive the spill
+
+    warm = build()
+    tracer = RunTracer()
+    with tracer.activate():
+        warm.resume_from(path)
+        warm.join()
+    assert warm.unique_state_count() == 1568
+    assert warm._total_states == cold._total_states
+    # zero NEW waves: the retained carry is already done — the warm
+    # run settles at its first sync
+    assert [e for e in tracer.events if e["ev"] == "wave"] == []
+    for name, p in warm.discoveries().items():
+        prop = warm.model.property_by_name(name)
+        assert prop.condition(warm.model, p.last_state()), name

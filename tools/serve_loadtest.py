@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Serve load test: the wave-batching A/B — N concurrent clients,
+batched fused dispatch vs the FIFO-serial baseline.
+
+Runs the SAME small-model fleet twice against two resident services
+(stateright_tpu/serve.py):
+
+* **batched** — ``batch_sessions=N``: the fleet rendezvouses in one
+  compatibility class and rides ONE fused wave dispatch
+  (stateright_tpu/batch.py), each session billed its 1/N_active
+  share of the fused dispatch+sync walls,
+* **fifo-serial** — batching off: the round-18 baseline, whole
+  chunks FIFO-interleaved, every session paying the full per-chunk
+  sync floor alone.
+
+Counts must be bit-identical across both arms (they are asserted).
+The headline is **per-query dispatch+sync overhead** — each
+session's ``dispatch_net_sec + fetch_sec`` from the latency ledger,
+compile already subtracted, so the delta is attributed to the fused
+dispatch and not to compile amortization — plus p50/p99
+time-to-verdict for both arms.
+
+``--json`` exports the batched service's TRACE_r* pair and writes an
+auto-numbered ``SERVE_r*.json`` whose summary embeds the
+``fifo_baseline`` block, the ``latency_quantiles``, and the
+``loadtest`` headline (clients, lane, amortization_x) that bench
+provenance surfaces via ``artifacts.latest_serve_summary``.
+
+Usage:
+  JAX_PLATFORMS=cpu python tools/serve_loadtest.py
+  JAX_PLATFORMS=cpu python tools/serve_loadtest.py --clients=4 \\
+      --lane="2pc check-tpu 4" --json
+
+Exit status: 0 on success (amortization printed), 1 when any session
+errors or counts diverge between the arms.
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+import threading
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _quantile(values, q):
+    """Linear-interpolated quantile of a small sample (no numpy
+    dependency for the report path)."""
+    if not values:
+        return None
+    xs = sorted(values)
+    pos = q * (len(xs) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(xs) - 1)
+    return round(xs[lo] + (xs[hi] - xs[lo]) * (pos - lo), 6)
+
+
+def _run_fleet(service, lane_argv, n):
+    """N concurrent client threads submitting the same lane; returns
+    the sessions in submission order."""
+    results = {}
+
+    def run(i):
+        results[i] = service.check(list(lane_argv))
+
+    threads = [
+        threading.Thread(target=run, args=(i,)) for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return [results[i] for i in range(n)]
+
+
+def _arm_stats(summary):
+    """Per-session overhead rows + the arm's aggregate: the latency
+    ledger's dispatch_net+fetch (compile subtracted) and the ttv
+    quantiles."""
+    rows = []
+    for s in summary["sessions"]:
+        overhead = ((s.get("dispatch_net_sec") or 0.0)
+                    + (s.get("fetch_sec") or 0.0))
+        rows.append(dict(
+            session=s["session"],
+            unique=s.get("unique"),
+            waves=s.get("waves"),
+            batch=s.get("batch"),
+            time_to_verdict_sec=s.get("time_to_verdict_sec"),
+            dispatch_net_sec=s.get("dispatch_net_sec"),
+            fetch_sec=s.get("fetch_sec"),
+            overhead_sec=round(overhead, 6),
+            compile_wall_sec=(s.get("builds") or {}).get("wall_sec"),
+        ))
+    ttvs = [r["time_to_verdict_sec"] for r in rows
+            if r["time_to_verdict_sec"] is not None]
+    ov = [r["overhead_sec"] for r in rows]
+    return dict(
+        sessions=rows,
+        per_query_overhead_sec=(
+            round(sum(ov) / len(ov), 6) if ov else None
+        ),
+        ttv_p50_sec=_quantile(ttvs, 0.50),
+        ttv_p99_sec=_quantile(ttvs, 0.99),
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="N-client wave-batching A/B against the "
+        "resident checking service"
+    )
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument(
+        "--lane", default="2pc check-tpu 4",
+        help='lane argv, e.g. "2pc check-tpu 4" (default)',
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="export the batched TRACE_r* pair and write an "
+        "auto-numbered SERVE_r*.json with the A/B embedded",
+    )
+    ap.add_argument(
+        "--root", default=None,
+        help="artifact directory for --json (default: the repo root)",
+    )
+    args = ap.parse_args()
+    lane = args.lane.split()
+
+    from stateright_tpu.serve import (
+        CheckService,
+        serve_summary,
+        write_serve_artifact,
+    )
+
+    print(
+        f"serve loadtest: {args.clients} concurrent clients x "
+        f"'{args.lane}' (batched vs fifo-serial)"
+    )
+
+    with tempfile.TemporaryDirectory() as spool:
+        batched_svc = CheckService(
+            spool_dir=os.path.join(spool, "batched"),
+            warm_start=False,
+            batch_sessions=args.clients,
+            batch_window_sec=60.0,
+        )
+        batched_sessions = _run_fleet(batched_svc, lane, args.clients)
+        fifo_svc = CheckService(
+            spool_dir=os.path.join(spool, "fifo"),
+            warm_start=False,
+        )
+        fifo_sessions = _run_fleet(fifo_svc, lane, args.clients)
+
+        for arm, sessions in (("batched", batched_sessions),
+                              ("fifo", fifo_sessions)):
+            for s in sessions:
+                if s.state != "done":
+                    print(f"{arm} session {s.id} failed: {s.error}",
+                          file=sys.stderr)
+                    return 1
+        counts = {s.unique for s in batched_sessions} | \
+            {s.unique for s in fifo_sessions}
+        if len(counts) != 1:
+            print(f"count divergence across arms: {counts}",
+                  file=sys.stderr)
+            return 1
+
+        summary = serve_summary(batched_svc.events())
+        fifo_summary = serve_summary(fifo_svc.events())
+        batched = _arm_stats(summary)
+        fifo = _arm_stats(fifo_summary)
+        amortization = (
+            round(fifo["per_query_overhead_sec"]
+                  / batched["per_query_overhead_sec"], 2)
+            if batched["per_query_overhead_sec"] else None
+        )
+
+        print(f"  counts: unique={counts.pop():,} on every session, "
+              "both arms")
+        for label, arm in (("batched", batched),
+                           ("fifo-serial", fifo)):
+            print(
+                f"  {label:<12s} per-query dispatch+sync "
+                f"{arm['per_query_overhead_sec']:.4f} s | ttv p50 "
+                f"{arm['ttv_p50_sec']:.4f} s p99 "
+                f"{arm['ttv_p99_sec']:.4f} s"
+            )
+        print(f"  amortization: {amortization}x lower per-query "
+              "overhead under the fused dispatch")
+
+        summary["fifo_baseline"] = fifo
+        summary["latency_quantiles"] = dict(
+            batched={k: batched[k]
+                     for k in ("ttv_p50_sec", "ttv_p99_sec")},
+            fifo_serial={k: fifo[k]
+                         for k in ("ttv_p50_sec", "ttv_p99_sec")},
+        )
+        summary["loadtest"] = dict(
+            clients=args.clients,
+            lane=args.lane,
+            amortization_x=amortization,
+            batched_per_query_overhead_sec=(
+                batched["per_query_overhead_sec"]
+            ),
+            fifo_per_query_overhead_sec=(
+                fifo["per_query_overhead_sec"]
+            ),
+        )
+        if args.json:
+            jsonl, _chrome = batched_svc.write_trace(root=args.root)
+            summary["trace"] = os.path.basename(jsonl)
+            path = write_serve_artifact(summary, root=args.root)
+            print(f"\nwrote {jsonl}\nwrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

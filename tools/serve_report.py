@@ -17,7 +17,13 @@ events) and renders the tables the serving story is judged by:
   and dispatch proper (``dispatch_net_sec``) — the acceptance read
   for "the warm query is faster BECAUSE the compile amortized, not
   because dispatch changed",
-* **LRU evictions** — programs the byte budget dropped.
+* **batch occupancy** — fused wave-dispatch groups
+  (``--batch-sessions``): which sessions shared one device dispatch,
+  how many fused chunks they rode, and the amortized floor per query
+  (each member's dispatch+sync overhead is its 1/N_active share of
+  the fused walls),
+* **LRU evictions** — programs the byte budget dropped, and
+  snapshots the warm-start spool budget dropped.
 
 The derived summary comes from ``serve.serve_summary`` (the block
 bench provenance embeds via ``artifacts.latest_serve_summary``), so
@@ -108,11 +114,61 @@ def format_report(summary: dict) -> str:
                 + ")"
             )
 
+    batches = summary.get("batches") or []
+    if batches:
+        lines.append("")
+        lines.append(
+            "batch occupancy (fused wave dispatch, "
+            "stateright_tpu/batch.py):"
+        )
+        lines.append(
+            f"  {'grp':>4s} {'size':>4s} {'chunks':>6s} "
+            f"{'sessions':<18s} {'per-query overhead':>19s}"
+        )
+        for g in batches:
+            sess = ",".join(f"#{s}" for s in g["sessions"])
+            lines.append(
+                f"  {g['group']:>4d} "
+                f"{g.get('size') or len(g['sessions']):>4d} "
+                f"{g.get('chunks') if g.get('chunks') is not None else '-':>6} "
+                f"{sess:<18s} "
+                f"{_sec(g.get('per_query_overhead_sec')):>19s}"
+            )
+        lines.append("")
+        lines.append(
+            "amortized floor per query (each member's dispatch+sync "
+            "is its 1/N_active share of the fused walls):"
+        )
+        lines.append(
+            f"  {'grp':>4s} {'#':>4s} {'waves':>6s} "
+            f"{'dispatch':>12s} {'fetch':>12s} {'overhead':>12s} "
+            f"{'ttv':>12s}"
+        )
+        for g in batches:
+            for m in g["members"]:
+                lines.append(
+                    f"  {g['group']:>4d} {m['session']:>4d} "
+                    f"{m.get('waves') if m.get('waves') is not None else '-':>6} "
+                    f"{_sec(m.get('dispatch_net_sec')):>12s} "
+                    f"{_sec(m.get('fetch_sec')):>12s} "
+                    f"{_sec(m.get('overhead_sec')):>12s} "
+                    f"{_sec(m.get('time_to_verdict_sec')):>12s}"
+                )
+
     ev = summary.get("evictions") or []
     if ev:
         lines.append("")
         lines.append("program-LRU evictions:")
         for e in ev:
+            lines.append(
+                f"  key {e.get('key')}: {e.get('bytes'):,} B "
+                f"(session run {e.get('run')})"
+            )
+    sev = summary.get("snapshot_evictions") or []
+    if sev:
+        lines.append("")
+        lines.append("snapshot-spool evictions (byte-budget LRU):")
+        for e in sev:
             lines.append(
                 f"  key {e.get('key')}: {e.get('bytes'):,} B "
                 f"(session run {e.get('run')})"

@@ -119,6 +119,26 @@ def tpu_workloads(quick=False):
 
         return spawn
 
+    def twopc_sym(rm, **kw):
+        # The device symmetry-reduction lane (ROADMAP 4(a)): the
+        # same protocol with candidates canonicalized before dedup
+        # (ops/canonical.py), so the engine explores the orbit
+        # quotient — 8,832 -> 314 at rm=5. Counts are the PERFECT
+        # canonicalizer's, order-independent and host-oracle-pinned
+        # (tests/test_device_symmetry.py; the reference's 665 is a
+        # DFS-order artifact, see symmetry.py).
+        def spawn():
+            return (
+                TwoPhaseSys(rm_count=rm)
+                .checker()
+                .symmetry()
+                .spawn_tpu_sortmerge(
+                    track_paths=False, cand_capacity="auto", **kw
+                )
+            )
+
+        return spawn
+
     from stateright_tpu.models.paxos import PaxosModelCfg, paxos_model
     from stateright_tpu.models.paxos_tpu import STRUCTURAL_SIZES
 
@@ -274,6 +294,15 @@ def tpu_workloads(quick=False):
             8832,
         ),
         (
+            # the rm=5..7 symmetry sweep rides beside its raw lanes:
+            # same protocol, canonical-fingerprint dedup, the lane
+            # detail records the reduction ratio (SYM_LANES below)
+            "2pc rm=5 (sym)",
+            twopc_sym(5, capacity=1 << 11, frontier_capacity=256),
+            None,
+            314,
+        ),
+        (
             "paxos 2c/3s",
             paxos(2),
             paxos(2, hybrid=True),
@@ -287,6 +316,21 @@ def tpu_workloads(quick=False):
             50816,
         ),
         (
+            "2pc rm=6 (sym)",
+            twopc_sym(6, capacity=1 << 12, frontier_capacity=512),
+            None,
+            553,
+        ),
+        (
+            "2pc rm=7 (sym)",
+            twopc_sym(7, capacity=1 << 13, frontier_capacity=1024),
+            None,
+            920,
+        ),
+        (
+            # stays LAST among the quick lanes: the raw rm=7 is the
+            # --quick headline (the sym lanes are reduction lanes,
+            # not throughput headlines)
             "2pc rm=7",
             twopc(7, capacity=1 << 19, frontier_capacity=1 << 16),
             None,
@@ -408,6 +452,40 @@ def tpu_workloads(quick=False):
             )
         )
     return loads
+
+
+#: the symmetry sweep's raw-space denominators (ROADMAP 4(a)): lane
+#: name -> (raw unique states, rm). The raw counts are the pinned
+#: unreduced 2pc spaces (the non-sym lanes above them); the ratio the
+#: lane detail records is raw / canonical.
+SYM_LANES = {
+    "2pc rm=5 (sym)": (8832, 5),
+    "2pc rm=6 (sym)": (50816, 6),
+    "2pc rm=7 (sym)": (296448, 7),
+}
+
+
+def bench_sym_host_oracle(rm):
+    """The host DFS symmetry oracle (the perfect canonicalizer,
+    representative_full) — the device-vs-host-DFS comparison the
+    "2pc rm=5 (sym)" lane records: same reduced count, host wall for
+    the A/B."""
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    c = (
+        TwoPhaseSys(rm_count=rm)
+        .checker()
+        .symmetry_fn(lambda s: s.representative_full())
+        .spawn_dfs()
+    )
+    t0 = time.monotonic()
+    c.join()
+    dt = time.monotonic() - t0
+    _stderr(
+        f"host-dfs-sym 2pc rm={rm}: unique={c.unique_state_count()} "
+        f"sec={dt:.2f}"
+    )
+    return c.unique_state_count(), dt
 
 
 def bench_ttfc(runs=2):
@@ -759,6 +837,33 @@ def main():
                 detail[name]["shard_balance"] = {
                     k: v for k, v in bal.items() if k != "per_wave"
                 }
+        if name in SYM_LANES:
+            # the reduction record (ROADMAP 4(a)): raw space vs the
+            # canonical quotient this lane explored, plus — on the
+            # rm=5 lane — the live host-DFS-sym oracle A/B (count
+            # parity asserted; the deeper parity matrix lives in
+            # tests/test_device_symmetry.py)
+            raw, rm = SYM_LANES[name]
+            detail[name]["symmetry"] = {
+                "raw_unique": raw,
+                "canonical_unique": unique,
+                "reduction_ratio": round(raw / unique, 2),
+            }
+            _stderr(
+                f"     symmetry: {raw:,} raw -> {unique:,} canonical "
+                f"(x{raw / unique:.1f} reduction)"
+            )
+            if rm == 5:
+                o_unique, o_sec = bench_sym_host_oracle(rm)
+                if o_unique != unique:
+                    _stderr(
+                        f"ERROR {name}: host DFS sym oracle "
+                        f"{o_unique} != device {unique}"
+                    )
+                    sys.exit(1)
+                detail[name]["symmetry"]["host_dfs_sym_sec"] = round(
+                    o_sec, 4
+                )
         _stderr(
             f"tpu  {name}: unique={unique} sec={sec:.3f} "
             f"states/sec={sps:,.0f}"

@@ -228,6 +228,8 @@ def compile_actor_model(
     closure_queue_bound=None,
     max_domain: int = 1 << 15,
     closure_max_states: int = 1 << 21,
+    device_rewrite_spec=None,
+    ample_mask=None,
 ) -> "CompiledActorEncoding":
     """Compile ``model`` into a TPU :class:`EncodedModel`.
 
@@ -266,6 +268,13 @@ def compile_actor_model(
     truncation flag when the successor is in boundary, so an
     under-declared bound fails loudly rather than silently
     truncating. Ignored for unordered networks.
+
+    ``device_rewrite_spec`` (an ``ops.canonical.DeviceRewriteSpec``)
+    declares the encoding's interchangeable limb group for device
+    symmetry reduction — validated against the compiled lane layout.
+    ``ample_mask`` is a packed slot-word tuple (ops/bitmask.py layout)
+    for the static ample-set filter; the caller owns its soundness
+    argument (see encoding.SymmetricEncodedModel / ample_mask_host).
     """
     return CompiledActorEncoding(
         model,
@@ -277,6 +286,8 @@ def compile_actor_model(
         max_domain,
         closure_max_states,
         closure_queue_bound=closure_queue_bound,
+        device_rewrite_spec=device_rewrite_spec,
+        ample_mask=ample_mask,
     )
 
 
@@ -292,6 +303,8 @@ class CompiledActorEncoding(EncodedModelBase):
         max_domain: int,
         closure_max_states: int,
         closure_queue_bound=None,
+        device_rewrite_spec=None,
+        ample_mask=None,
     ):
         if closure_mode not in ("overapprox", "reachable"):
             raise ValueError(f"unknown closure mode {closure_mode!r}")
@@ -342,6 +355,30 @@ class CompiledActorEncoding(EncodedModelBase):
         self._close()
         self._build_layout()
         self._build_tables()
+        self._spec = device_rewrite_spec
+        self._ample_mask = ample_mask
+        if device_rewrite_spec is not None:
+            from ..ops.canonical import validate_spec
+
+            validate_spec(device_rewrite_spec, width=self.width)
+        if ample_mask is not None:
+            from ..ops.bitmask import mask_words
+
+            if len(ample_mask) != mask_words(self.max_actions):
+                raise ValueError(
+                    f"ample_mask has {len(ample_mask)} words; this "
+                    f"encoding's {self.max_actions}-slot mask needs "
+                    f"{mask_words(self.max_actions)}"
+                )
+
+    def device_rewrite_spec(self):
+        """The declared symmetry spec (compile_actor_model's
+        ``device_rewrite_spec``), or None."""
+        return self._spec
+
+    def ample_mask_host(self):
+        """The declared ample-set slot words, or None."""
+        return self._ample_mask
 
     def cache_key(self):
         """Identity for compiled-program sharing. Includes the property
@@ -375,6 +412,10 @@ class CompiledActorEncoding(EncodedModelBase):
                 for name, fn in sorted(self.property_specs.items())
             ),
             spec_fp(self.boundary_spec),
+            # Symmetry / ample declarations are baked into the chunk
+            # program (canonicalization kernel, enabled-word AND).
+            repr(self._spec) if self._spec is not None else None,
+            tuple(self._ample_mask) if self._ample_mask else None,
             # Ordered: the queue bounds shape the integer-queue layout
             # (field widths), so two compilations differing only in
             # declared bounds must not share a chunk program.

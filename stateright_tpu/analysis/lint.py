@@ -97,6 +97,77 @@ def trace_encoding_paths(enc, n: int = LINT_N) -> dict:
     }
 
 
+def _shard_map_1dev(fn, in_specs):
+    """Wrap ``fn`` in ``shard_map`` over a 1-device mesh with
+    ``axis_name="shard"`` — the sharded engines' axis plumbing, which
+    is what a sharded trace pins (feature-detecting the check_rep /
+    check_vma kwarg rename across jax versions)."""
+    import inspect
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    kw = {}
+    try:
+        sm_params = inspect.signature(shard_map).parameters
+        if "check_rep" in sm_params:
+            kw["check_rep"] = False
+        elif "check_vma" in sm_params:
+            kw["check_vma"] = False
+    except (TypeError, ValueError):
+        kw["check_rep"] = False
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("shard",))
+    return shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=P(), **kw
+    )
+
+
+def trace_canonical_paths(enc, n: int = LINT_N) -> dict:
+    """``{label: ClosedJaxpr}`` of the symmetry-canonicalization
+    kernel paths (registry.CANONICAL_PATHS) — empty when the encoding
+    declares no ``DeviceRewriteSpec``, so the audit is gated on the
+    SAME capability probe the engines use. Three invocation styles:
+    row-major ``canonicalize_rows`` (the host-replay contract view),
+    the transposed ``canonicalize_t`` over ``[W, N]`` the engines run
+    between step and fingerprint (``canon[t]``), and that invocation
+    under ``shard_map`` (``canon:sharded`` — the sharded engine
+    canonicalizes before the (owner, fp) routing seam)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..encoding import device_rewrite_spec
+    from ..ops.canonical import canonicalize_rows, canonicalize_t
+
+    spec = device_rewrite_spec(enc)
+    if spec is None:
+        return {}
+    from jax.sharding import PartitionSpec as P
+
+    rows = jnp.zeros((n, enc.width), jnp.uint32)
+    cols = jnp.zeros((enc.width, n), jnp.uint32)
+    return {
+        "canon": jax.make_jaxpr(
+            lambda r: canonicalize_rows(spec, r, jnp)
+        )(rows),
+        "canon[t]": jax.make_jaxpr(
+            lambda c: canonicalize_t(spec, c, jnp)
+        )(cols),
+        "canon:sharded": jax.make_jaxpr(
+            _shard_map_1dev(
+                lambda c: canonicalize_t(spec, c, jnp), (P(),)
+            )
+        )(cols),
+    }
+
+
 def engine_pair_width(enc) -> int:
     K = enc.max_actions
     return min(getattr(enc, "pair_width_hint", None) or K, K)
@@ -374,6 +445,18 @@ def _ctx_for_path(spec: EncodingSpec, enc, label: str,
         return TraceCtx(path=label, encoding=spec.name, n=n, k=K,
                         sparse=True, allow_gathers=0,
                         check_lane_alu=True)
+    if label in ("canon", "canon[t]", "canon:sharded"):
+        # the canonicalization kernel is held to the bits-path bar:
+        # gather-free (rank via comparison counts + one-hot
+        # select-sums — a permutation gather here is exactly the
+        # priced artifact) and no lane-padded ALU; the sharded
+        # invocation additionally runs the comms rules (the kernel is
+        # collective-free by construction — canonicalization happens
+        # BEFORE the routing seam, per shard, with no coordination).
+        return TraceCtx(path=label, encoding=spec.name, n=n, k=K,
+                        sparse=True, allow_gathers=0,
+                        check_lane_alu=True,
+                        check_comms=label == "canon:sharded")
     if label == "mask":
         # bool[K] is this path's CONTRACT (the dense view); only the
         # gather rule applies.
@@ -412,6 +495,9 @@ def lint_encoding(spec: EncodingSpec,
     findings: list = []
     stats: list = []
     traced = trace_encoding_paths(enc, n)
+    # capability-gated (registry.CANONICAL_PATHS): empty dict for
+    # encodings without a DeviceRewriteSpec
+    traced.update(trace_canonical_paths(enc, n))
     for engine in engines:
         # both the small-wave shape and the production
         # compaction/tiled-mask shape (the branch the big bench

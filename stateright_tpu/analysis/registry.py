@@ -68,6 +68,20 @@ MERGE_KERNEL_PATHS = (
     "merge:member:pallas", "merge:append:pallas",
 )
 
+#: the symmetry-canonicalization kernel paths (ops/canonical.py) the
+#: lint traces for every encoding that declares a
+#: ``DeviceRewriteSpec`` (encoding.device_rewrite_spec — the same
+#: capability probe the engines use, so a newly symmetric encoding is
+#: audited the moment the engines would canonicalize it): the
+#: row-major contract view, the transposed ``[W, N]`` invocation the
+#: engines actually run between step and fingerprint, and that same
+#: invocation under ``shard_map`` (the sharded engine canonicalizes
+#: BEFORE the (owner, fp) routing seam, so whole orbits route to one
+#: shard). All three are held to the bits-path bar: gather-free
+#: (rank-by-comparison-counts + one-hot select-sums, never a
+#: permutation gather) and no lane-padded ALU.
+CANONICAL_PATHS = ("canon", "canon[t]", "canon:sharded")
+
 #: the sharded engine's TRACED wave-body fixture (round 11): the full
 #: per-wave program of parallel/engine_sortmerge.py — routing sort,
 #: dest tiles, ``all_to_all``, merge switches — with the per-shard

@@ -284,6 +284,18 @@ def batch_eligible(checker) -> tuple:
         return None, "warm-started sessions resume solo"
     if getattr(checker, "tier_hot_rows", None):
         return None, "tiered sessions cannot batch"
+    # Symmetry is a shape-compatibility property, not a padding one: a
+    # reduced session's visited keys are canonical fingerprints while a
+    # raw session's are plain, so fusing them would mix incomparable key
+    # spaces in one visited set. The fused engine is the hash wave
+    # engine, which has no canonicalization pass at all — refuse both
+    # modes outright rather than minting a class nobody can serve.
+    if getattr(checker, "sym_spec", None) is not None:
+        return None, "symmetry-reduced sessions cannot fuse (canonical" \
+            " keys are a different compatibility class)"
+    if getattr(checker, "ample_set", False):
+        return None, "ample-set filtered sessions cannot fuse (reduced" \
+            " action sets are a different compatibility class)"
     enc = checker.encoded
     if not hasattr(enc, "cache_key"):
         return None, "encoding lacks a cache_key identity"

@@ -34,7 +34,7 @@ from ..model import Expectation
 from ..fingerprint import fingerprint
 from ..path import Path
 from ..report import ReportData, Reporter
-from .common import ParentTraceMixin
+from .common import ParentTraceMixin, symmetry_refusal
 
 #: states handed to a worker per lock acquisition (bfs.rs:124).
 JOB_BLOCK = 1500
@@ -44,10 +44,7 @@ class BfsChecker(ParentTraceMixin, Checker):
     def __init__(self, builder: CheckerBuilder):
         super().__init__(builder)
         if builder._symmetry is not None:
-            raise ValueError(
-                "symmetry reduction requires spawn_dfs or spawn_simulation "
-                "(as in the reference: dfs.rs:300-311, simulation.rs:252-256)"
-            )
+            raise symmetry_refusal("spawn_bfs")
         #: child fingerprint -> parent fingerprint (None for init states);
         #: the complete parent-pointer forest (bfs.rs:28-29).
         self.generated: dict[int, Optional[int]] = {}

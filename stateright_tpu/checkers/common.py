@@ -13,6 +13,29 @@ from typing import Optional
 from ..path import Path
 
 
+def symmetry_refusal(engine: str,
+                     missing: Optional[str] = None) -> ValueError:
+    """The ONE symmetry-refusal error every checker raises.
+
+    Three engines used to hand-roll divergent messages (bfs,
+    on_demand, tpu); this helper owns the wording so they cannot
+    drift, and the device path's capability refusal (an encoding
+    without a ``DeviceRewriteSpec``) names what is missing through
+    the same channel. ``engine`` names the refusing spawn;
+    ``missing`` names the absent capability, if the engine could
+    otherwise honor the reduction."""
+    parts = [f"symmetry reduction: {engine} cannot honor it"]
+    if missing:
+        parts.append(f"missing capability: {missing}")
+    parts.append(
+        "supported: spawn_dfs / spawn_simulation on the host (as in "
+        "the reference: dfs.rs:300-311, simulation.rs:252-256), and "
+        "the TPU sort-merge engines when the encoding declares "
+        "device_rewrite_spec() (stateright_tpu/ops/canonical.py)"
+    )
+    return ValueError("; ".join(parts))
+
+
 class ParentTraceMixin:
     """Requires ``self.generated: dict[int, Optional[int]]``,
     ``self.model`` and ``self._discoveries``."""

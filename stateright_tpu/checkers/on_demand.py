@@ -22,14 +22,14 @@ from ..model import Expectation
 from ..fingerprint import fingerprint
 from ..path import Path
 from ..report import Reporter
-from .common import ParentTraceMixin
+from .common import ParentTraceMixin, symmetry_refusal
 
 
 class OnDemandChecker(ParentTraceMixin, Checker):
     def __init__(self, builder: CheckerBuilder):
         super().__init__(builder)
         if builder._symmetry is not None:
-            raise ValueError("symmetry reduction requires spawn_dfs")
+            raise symmetry_refusal("spawn_on_demand")
         self.generated: dict[int, Optional[int]] = {}
         #: fp -> (state, ebits, depth), awaiting expansion.
         self.pending: dict[int, tuple[object, int, int]] = {}

@@ -56,6 +56,7 @@ from ..ops.hashset import DeviceHashSet, insert
 from ..ops.u64 import U64, u64_add
 from ..path import Path
 from ..report import ReportData, Reporter
+from .common import symmetry_refusal
 
 _SENTINEL = 0xFFFFFFFF  # sort key for invalid successor rows
 
@@ -247,7 +248,8 @@ def _props_and_ebits(cond_raw, F, fval, ebits, n_props, evt_idx, jnp):
     return cond, ebits
 
 
-def frontier_props(enc, props, evt_idx, frontier, fval, ebits):
+def frontier_props(enc, props, evt_idx, frontier, fval, ebits,
+                   sym_spec=None):
     """The step-free half of a wave: frontier fingerprints, the
     property bitmap, and eventually-bit clearing (shared between the
     dense expansion below and the sparse-dispatch path, which computes
@@ -255,13 +257,21 @@ def frontier_props(enc, props, evt_idx, frontier, fval, ebits):
     extracting the pairs from the encoding's packed enabled-mask
     bitmap, ops/bitmask.py).
 
+    ``sym_spec``: see :func:`frontier_props_t` — canonical
+    fingerprints, concrete property evaluation.
+
     Returns ``(cond[F, P], ebits[F], f_lo[F], f_hi[F])``."""
     import jax
     import jax.numpy as jnp
 
     F = frontier.shape[0]
     n_props = len(props)
-    f_lo, f_hi = fingerprint_u32v(frontier, jnp)
+    fp_src = frontier
+    if sym_spec is not None:
+        from ..ops.canonical import canonicalize_rows
+
+        fp_src = canonicalize_rows(sym_spec, frontier, jnp)
+    f_lo, f_hi = fingerprint_u32v(fp_src, jnp)
     cond_raw = (
         jax.vmap(enc.property_conditions_vec)(frontier)
         if n_props else None
@@ -272,7 +282,8 @@ def frontier_props(enc, props, evt_idx, frontier, fval, ebits):
     return cond, ebits, f_lo, f_hi
 
 
-def frontier_props_t(enc, props, evt_idx, frontier_t, fval, ebits):
+def frontier_props_t(enc, props, evt_idx, frontier_t, fval, ebits,
+                     sym_spec=None):
     """Transposed-resident variant of :func:`frontier_props`:
     ``frontier_t`` is the column-major ``uint32[W, F]`` block the
     sort-merge engines carry (PERF.md §layout). The fingerprint fold
@@ -282,7 +293,16 @@ def frontier_props_t(enc, props, evt_idx, frontier_t, fval, ebits):
     the mask/ebits tail is the SAME ``_props_and_ebits`` body.
 
     Returns ``(cond[F, P], ebits[F], f_lo[F], f_hi[F])`` — identical
-    values to ``frontier_props(frontier_t.T, ...)``."""
+    values to ``frontier_props(frontier_t.T, ...)``.
+
+    With ``sym_spec`` set (device symmetry reduction), the returned
+    fingerprints are CANONICAL — fingerprint(representative(state)) —
+    while properties still evaluate on the concrete frontier
+    (symmetric property sets give identical verdicts either way, and
+    the concrete evaluation keeps counterexample states exact). The
+    parent-log keys these fps seed must match the canonical child
+    keys the dedup writes, which is why the canonicalization lives
+    here and not only in the candidate pass."""
     import jax.numpy as jnp
 
     from ..encoding import property_conditions_cols
@@ -290,7 +310,12 @@ def frontier_props_t(enc, props, evt_idx, frontier_t, fval, ebits):
 
     F = frontier_t.shape[1]
     n_props = len(props)
-    f_lo, f_hi = fingerprint_u32v_t(frontier_t, jnp)
+    fp_src = frontier_t
+    if sym_spec is not None:
+        from ..ops.canonical import canonicalize_t
+
+        fp_src = canonicalize_t(sym_spec, frontier_t, jnp)
+    f_lo, f_hi = fingerprint_u32v_t(fp_src, jnp)
     cond_raw = (
         property_conditions_cols(enc, frontier_t)
         if n_props else None
@@ -302,7 +327,7 @@ def frontier_props_t(enc, props, evt_idx, frontier_t, fval, ebits):
 
 
 def expand_frontier(enc, props, evt_idx, frontier, fval, ebits, expand,
-                    with_repeats=True):
+                    with_repeats=True, sym_spec=None):
     """The shared first half of a wave (single-chip and sharded): from a
     frontier block to property verdicts + flattened candidate successors.
 
@@ -335,7 +360,7 @@ def expand_frontier(enc, props, evt_idx, frontier, fval, ebits, expand,
     K, W = enc.max_actions, enc.width
 
     cond, ebits, f_lo, f_hi = frontier_props(
-        enc, props, evt_idx, frontier, fval, ebits
+        enc, props, evt_idx, frontier, fval, ebits, sym_spec=sym_spec
     )
 
     succs, valid, trunc = step_with_trunc(enc, frontier, jnp)
@@ -420,6 +445,12 @@ class TpuBfsChecker(Checker):
     """``CheckerBuilder.spawn_tpu()`` — the reference's ``spawn_bfs``
     offloaded to a device (BASELINE.json north star)."""
 
+    #: the hash engine keys its visited set on raw-state fingerprints
+    #: with no canonicalization pass; the sort-merge subclasses flip
+    #: this and honor symmetry via the encoding's DeviceRewriteSpec.
+    _supports_device_symmetry = False
+    _engine_name = "spawn_tpu (hash engine)"
+
     def __init__(
         self,
         builder: CheckerBuilder,
@@ -434,8 +465,6 @@ class TpuBfsChecker(Checker):
         checkpoint_path: Optional[str] = None,
     ):
         super().__init__(builder)
-        if builder._symmetry is not None:
-            raise ValueError("symmetry reduction requires spawn_dfs")
         if encoded is None:
             to_encoded = getattr(builder.model, "to_encoded", None)
             if to_encoded is None:
@@ -445,6 +474,29 @@ class TpuBfsChecker(Checker):
                 )
             encoded = to_encoded()
         self.encoded = encoded
+        #: the device symmetry spec, when the reduction is ON for this
+        #: run: the engines canonicalize candidates with it before the
+        #: fingerprint fold (ops/canonical.py), so visited keys are
+        #: canonical fingerprints while the frontier keeps concrete
+        #: states. None = no reduction.
+        self.sym_spec = None
+        if builder._symmetry is not None:
+            from ..encoding import device_rewrite_spec
+
+            if not self._supports_device_symmetry:
+                raise symmetry_refusal(self._engine_name)
+            spec = device_rewrite_spec(encoded)
+            if spec is None:
+                raise symmetry_refusal(
+                    self._engine_name,
+                    missing=(
+                        f"encoding {type(encoded).__name__} declares no "
+                        "device_rewrite_spec() — the vectorized "
+                        "canonicalization needs the strided bit-field "
+                        "layout of the interchangeable limb group"
+                    ),
+                )
+            self.sym_spec = spec
         self.capacity = capacity
         #: summed across shards in sharded variants (occupancy metric).
         self.total_capacity = capacity
@@ -2172,6 +2224,16 @@ class TpuBfsChecker(Checker):
         return self.generated
 
     def _vec_fp(self, row: np.ndarray) -> int:
+        # Symmetry: visited keys are canonical fingerprints, so host
+        # replay must canonicalize the encoded row with the SAME
+        # (xp-generic) kernel before fingerprinting — bit-equal to
+        # what the device wrote, or path reconstruction would miss.
+        if self.sym_spec is not None:
+            from ..ops.canonical import canonicalize_rows
+
+            row = canonicalize_rows(
+                self.sym_spec, np.asarray(row, np.uint32), np
+            )
         lo, hi = fingerprint_u32v(row.reshape(1, -1), np)
         return _fp_int(lo[0], hi[0])
 

@@ -217,7 +217,8 @@ def _divisor_at_least(n: int, want: int) -> int:
 
 
 def frontier_enabled_bits(enc, frontier_t, fval_f, expand, *,
-                          mask_budget_cells, n_rows=None, pv=None):
+                          mask_budget_cells, n_rows=None, pv=None,
+                          ample_words=None):
     """The enabled-bitmap pass of :func:`sparse_pair_candidates` —
     per-row packed ``uint32[F_f, L]`` words plus per-row enabled
     counts over the transposed ``[W, F]`` block, tiled through a
@@ -229,7 +230,15 @@ def frontier_enabled_bits(enc, frontier_t, fval_f, expand, *,
     gather seam: a mask-path change that lands here is the pipeline
     the profiler times, by construction — no hand-synced mirror to
     drift. ``pv`` marks loop-carry seeds shard-varying under
-    ``shard_map`` (identity otherwise)."""
+    ``shard_map`` (identity otherwise).
+
+    ``ample_words`` (off by default) is a host-constant packed
+    ``uint32[L]`` ample-set mask (ops/canonical.py companion: the
+    partial-order-reduction filter the encoding precomputes,
+    ``ample_mask_host``): the filter is ONE word-AND folded into this
+    pass — slots outside the ample set never reach the peel, the
+    compaction, or the candidate counts. The encoding owns the
+    soundness argument for its mask; engines only apply it."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -243,17 +252,26 @@ def frontier_enabled_bits(enc, frontier_t, fval_f, expand, *,
     K = enc.max_actions
     L = mask_words(K)
     bits_fn = getattr(enc, "enabled_bits_vec", None)
+    aw = (None if ample_words is None
+          else jnp.asarray(np.asarray(ample_words, np.uint32)))
 
     def mask_bits(tf_t, tfv):
         if bits_fn is not None:
             tb = enabled_bits_cols(enc, tf_t)
             tb = jnp.where(expand, tb, jnp.uint32(0))
             tb = jnp.where(tfv[:, None], tb, jnp.uint32(0))
+            if aw is not None:
+                tb = tb & aw[None, :]
             return tb, popcount_words(jnp, tb)
         m = enabled_mask_cols(enc, tf_t)
         m = m & tfv[:, None] & expand
+        w = mask_to_words(jnp, m)
+        if aw is not None:
+            # counts must see the filtered bitmap too
+            w = w & aw[None, :]
+            return w, popcount_words(jnp, w)
         tc = jnp.sum(m, axis=1, dtype=jnp.uint32)
-        return mask_to_words(jnp, m), tc
+        return w, tc
 
     if F_f * K > mask_budget_cells:
         NTm = _divisor_at_least(F_f, -(-F_f * K // mask_budget_cells))
@@ -285,7 +303,8 @@ def frontier_enabled_bits(enc, frontier_t, fval_f, expand, *,
 
 def sparse_pair_candidates(enc, frontier_t, fval_f, expand, *, EV, B_p,
                            NT, T, mask_budget_cells, Ba,
-                           axis_name=None, n_rows=None):
+                           axis_name=None, n_rows=None,
+                           ample_words=None):
     """The sparse-dispatch pair pipeline, shared by the single-chip and
     sharded sort-merge engines (PERF.md §sparse): per-slot enabled
     mask → per-row bitmaps (tiled so the [F, K] bool mask never
@@ -347,6 +366,7 @@ def sparse_pair_candidates(enc, frontier_t, fval_f, expand, *, EV, B_p,
     bits, cnt = frontier_enabled_bits(
         enc, frontier_t, fval_f, expand,
         mask_budget_cells=mask_budget_cells, n_rows=n_rows, pv=pv,
+        ample_words=ample_words,
     )
     n_pairs = jnp.sum(cnt, dtype=jnp.uint32)
     pair_ovf = jnp.any(cnt > jnp.uint32(EV)) | (
@@ -450,6 +470,13 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
     suite exercises at toy scale.
     """
 
+    #: Device symmetry capability (checkers/common.symmetry_refusal):
+    #: the sort-merge engine canonicalizes candidate blocks before the
+    #: fingerprint fold (ops/canonical.py) when the encoding declares
+    #: a DeviceRewriteSpec — the base TpuBfsChecker refuses instead.
+    _supports_device_symmetry = True
+    _engine_name = "spawn_tpu_sortmerge"
+
     def __init__(
         self,
         builder,
@@ -476,6 +503,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         tier_hot_rows=None,
         tier_budget_bytes: int | None = None,
         tier_max_runs: int = 8,
+        ample_set: bool = False,
         **kwargs,
     ):
         #: ``cand_capacity="auto"`` (VERDICT r4 item 7): size the
@@ -524,6 +552,15 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         self.tier_hot_rows = tier_hot_rows
         self.tier_budget_bytes = tier_budget_bytes
         self.tier_max_runs = tier_max_runs
+        #: Partial-order-reduction ample-set filter (off by default):
+        #: AND the encoding's host-precomputed ample_mask_host() words
+        #: into the packed enabled bitmap, dropping redundant
+        #: interleavings before pair compaction. Sparse path only —
+        #: the mask is a bitmap-domain object. The ENCODING owns the
+        #: soundness argument for its mask (see
+        #: two_phase_commit_tpu.ample_mask_host); the engine only
+        #: validates shape and applies the AND.
+        self.ample_set = bool(ample_set)
         #: tiered-mode frontier-headroom pre-check policy
         #: (memplan.tier_frontier_headroom, checked BEFORE device
         #: work): "warn" — surface the PR 12 known bound up front
@@ -1477,6 +1514,37 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         hint = getattr(self.encoded, "pair_width_hint", None)
         return min(hint, K) if hint else K
 
+    def _resolve_ample_words(self):
+        """The validated host-constant ample mask (``uint32[L]``), or
+        None when the filter is off. ONE home for the single-chip and
+        sharded program builders — the refusals must not drift."""
+        if not self.ample_set:
+            return None
+        from ..encoding import ample_mask_host
+
+        enc = self.encoded
+        if not self._use_sparse():
+            raise ValueError(
+                "ample_set requires the sparse dispatch path (the "
+                "filter is an AND over the packed enabled bitmap); "
+                "this run resolved to the dense wave"
+            )
+        aw = ample_mask_host(enc)
+        if aw is None:
+            raise ValueError(
+                f"ample_set: encoding {type(enc).__name__} declares "
+                "no ample_mask_host() — the engine cannot invent a "
+                "sound reduction; declare the mask on the encoding "
+                "(it owns the soundness argument) or drop the flag"
+            )
+        if aw.shape[0] != mask_words(enc.max_actions):
+            raise ValueError(
+                f"ample_mask_host() returned {aw.shape[0]} words; "
+                f"max_actions={enc.max_actions} needs "
+                f"{mask_words(enc.max_actions)}"
+            )
+        return np.asarray(aw, np.uint32)
+
     def _cache_extras(self) -> tuple:
         return (
             "sortmerge",
@@ -1495,6 +1563,11 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             self.merge_impl,
             # traced runs carry the wave log: a different program.
             self._wave_log_enabled(),
+            # device symmetry / ample-set change the compiled wave
+            # programs (canonicalization pass, enabled-bits AND) for
+            # the SAME encoding, so they key the program cache too.
+            self.sym_spec is not None,
+            self.ample_set,
         )
 
     # -- telemetry (stateright_tpu/telemetry.py) ---------------------------
@@ -1530,6 +1603,8 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             mask_budget_cells=self.mask_budget_cells,
             merge_impl=self.merge_impl,
             tier_hot_rows=self.tier_hot_rows,
+            symmetry=self.sym_spec is not None,
+            ample_set=self.ample_set,
         )
         return lane
 
@@ -1634,6 +1709,16 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
 
         tier_mode = bool(tiered)
         enc = self.encoded
+        # Device symmetry reduction (ops/canonical.py): when the
+        # builder asked for symmetry, __init__ resolved the encoding's
+        # DeviceRewriteSpec (or refused loudly). Every fingerprint
+        # site below folds the CANONICAL block; the frontier keeps the
+        # concrete states — the visited-through-representatives /
+        # search-through-originals split of the host DFS.
+        sym = self.sym_spec
+        if sym is not None:
+            from ..ops.canonical import canonicalize_rows, canonicalize_t
+        ample_words = self._resolve_ample_words()
         props = list(self.model.properties())
         n_props = len(props)
         evt_idx = [
@@ -1702,7 +1787,13 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             # transpose ONCE into the [W, F] resident layout (PERF.md
             # §layout — boundary transposes live here and at the
             # gather seams only).
-            lo0, hi0 = fingerprint_u32v(init_rows, jnp)
+            # Canonical visited keys from wave zero: the init rows
+            # fingerprint through their orbit representatives, same
+            # as every candidate wave below (the frontier still
+            # stores the concrete init states).
+            fp_rows = (canonicalize_rows(sym, init_rows, jnp)
+                       if sym is not None else init_rows)
+            lo0, hi0 = fingerprint_u32v(fp_rows, jnp)
             lo0, hi0 = clamp_keys(lo0, hi0)
             # Seed the SORTED invariant: the init keys are the first
             # visited prefix, so they go in (hi, lo)-ordered (an
@@ -1722,6 +1813,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 dict(
                     wlog=jnp.zeros((waves_per_sync, WL), jnp.uint32),
                     wv_pairs=jnp.uint32(0),
+                    wv_canon=jnp.uint32(0),
                 )
                 if trace_log
                 else {}
@@ -1784,7 +1876,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
         def merge_stage(c, v_class, B_eff, ck_lo, ck_hi, fetch, n_cand,
                         disc_found, disc_lo, disc_hi, c_overflow,
                         e_overflow, max_tile_cand, max_rowen=None,
-                        wv_pairs=None):
+                        wv_pairs=None, wv_canon=None):
             """The streaming-merge dedup (round 10, PERF.md
             §merge-kernel), class-collapsed per round 9: no switch
             branch ever returns more than one resident buffer.
@@ -2031,6 +2123,8 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                         pstash=c["pstash"],
                         wv_pairs=(n_cand if wv_pairs is None
                                   else wv_pairs).astype(jnp.uint32),
+                        wv_canon=(jnp.uint32(0) if wv_canon is None
+                                  else wv_canon.astype(jnp.uint32)),
                     )
                 return dict(
                     **trace_extra,
@@ -2092,6 +2186,8 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     wlog=c["wlog"],
                     wv_pairs=(n_cand if wv_pairs is None
                               else wv_pairs).astype(jnp.uint32),
+                    wv_canon=(jnp.uint32(0) if wv_canon is None
+                              else wv_canon.astype(jnp.uint32)),
                 )
             return dict(
                 **trace_extra,
@@ -2158,6 +2254,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     ex = expand_frontier(
                         enc, props, evt_idx, frontier_rows, fval_f,
                         ebits_f, expand, with_repeats=False,
+                        sym_spec=sym,
                     )
                     e_overflow = c["e_overflow"] | jnp.any(ex["trunc"])
                     disc_found, disc_lo, disc_hi = discovery_update(
@@ -2165,7 +2262,17 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                         c["disc_found"], c["disc_lo"], c["disc_hi"],
                     )
                     flat, valid = ex["flat"], ex["v"]
-                    k_lo, k_hi = fingerprint_u32v(flat, jnp)
+                    wv_canon = None
+                    if sym is not None:
+                        cflat = canonicalize_rows(sym, flat, jnp)
+                        k_lo, k_hi = fingerprint_u32v(cflat, jnp)
+                        if trace_log:
+                            wv_canon = jnp.sum(
+                                valid & (cflat != flat).any(axis=1),
+                                dtype=jnp.uint32,
+                            )
+                    else:
+                        k_lo, k_hi = fingerprint_u32v(flat, jnp)
                     k_lo, k_hi = clamp_keys(k_lo, k_hi)
                     k_lo = jnp.where(valid, k_lo, jnp.uint32(_SENT))
                     k_hi = jnp.where(valid, k_hi, jnp.uint32(_SENT))
@@ -2258,6 +2365,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                         n_cand, disc_found, disc_lo, disc_hi,
                         c_overflow, e_overflow,
                         jnp.maximum(c["max_tile_cand"], tile_max),
+                        wv_canon=wv_canon,
                     )
 
                 # Per-tile payload path (successor tensor too big to
@@ -2283,14 +2391,20 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     teb = lax.dynamic_slice(c["ebits"], (off,), (T,))
                     ex = expand_frontier(
                         enc, props, evt_idx, tf, tfv, teb, expand,
-                        with_repeats=False,
+                        with_repeats=False, sym_spec=sym,
                     )
                     e_ovf = e_ovf | jnp.any(ex["trunc"])
                     dfound, dlo, dhi = discovery_update(
                         props, ex, tfv, dfound, dlo, dhi
                     )
                     flat, valid = ex["flat"], ex["v"]
-                    k_lo, k_hi = fingerprint_u32v(flat, jnp)
+                    # Canonical keys; the payload keeps the CONCRETE
+                    # successor rows (the hits lane rides the sparse
+                    # and full-flat paths only — this fallback path
+                    # reports wv_canon=0).
+                    fp_flat = (canonicalize_rows(sym, flat, jnp)
+                               if sym is not None else flat)
+                    k_lo, k_hi = fingerprint_u32v(fp_flat, jnp)
                     k_lo, k_hi = clamp_keys(k_lo, k_hi)
                     k_lo = jnp.where(valid, k_lo, jnp.uint32(_SENT))
                     k_hi = jnp.where(valid, k_hi, jnp.uint32(_SENT))
@@ -2487,7 +2601,8 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 fval_f = c["fval"][:F_f]
                 ebits_f = c["ebits"][:F_f]
                 cond, eb, f_lo, f_hi = frontier_props_t(
-                    enc, props, evt_idx, frontier_t, fval_f, ebits_f
+                    enc, props, evt_idx, frontier_t, fval_f, ebits_f,
+                    sym_spec=sym,
                 )
 
                 pidx, live, pslot, cnt, n_pairs, pair_ovf, tile_max = (
@@ -2499,7 +2614,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                         enc, c["frontier"], fval_f, expand,
                         EV=EV, B_p=B_p, NT=NT, T=T,
                         mask_budget_cells=self.mask_budget_cells,
-                        Ba=Ba, n_rows=F_f,
+                        Ba=Ba, n_rows=F_f, ample_words=ample_words,
                     )
                 )
                 # Pair-state gather seam: the shared backend policy
@@ -2543,34 +2658,51 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     if ptr_b is not None:
                         eov = eov | jnp.any(ok & ptr_b)
                         ok = ok & ~ptr_b
-                    lo, hi = fingerprint_u32v_t(succ_t, jnp)
+                    hits = None
+                    if sym is not None:
+                        # Canonical fingerprint, concrete successor
+                        # block: succ_t flows untouched to the fetch /
+                        # frontier write — the canonical block exists
+                        # only to feed the fold (and the hits lane).
+                        canon_t = canonicalize_t(sym, succ_t, jnp)
+                        lo, hi = fingerprint_u32v_t(canon_t, jnp)
+                        if trace_log:
+                            hits = jnp.sum(
+                                ok & (canon_t != succ_t).any(axis=0),
+                                dtype=jnp.uint32,
+                            )
+                    else:
+                        lo, hi = fingerprint_u32v_t(succ_t, jnp)
                     lo, hi = clamp_keys(lo, hi)
                     lo = jnp.where(ok, lo, jnp.uint32(_SENT))
                     hi = jnp.where(ok, hi, jnp.uint32(_SENT))
-                    return lo, hi, ok, prow_b, eov, succ_t
+                    return lo, hi, ok, prow_b, eov, succ_t, hits
 
                 if chunked:
                     # Chunked fingerprint pass: the [Ba, W] successor
                     # tensor is never materialized.
                     def fchunk(ti, acc):
-                        cl, ch, nc, eov, rok = acc
+                        cl, ch, nc, eov, rok, wvc = acc
                         off = ti * Bc
                         pidx_b = lax.dynamic_slice(pidx, (off,), (Bc,))
                         live_b = lax.dynamic_slice(live, (off,), (Bc,))
                         slot_b = lax.dynamic_slice(pslot, (off,), (Bc,))
-                        lo, hi, ok, prow_b, ev, _succ = eval_pairs(
+                        lo, hi, ok, prow_b, ev, _succ, hits = eval_pairs(
                             pidx_b, live_b, slot_b
                         )
                         cl = lax.dynamic_update_slice(cl, lo, (off,))
                         ch = lax.dynamic_update_slice(ch, hi, (off,))
+                        if hits is not None:
+                            wvc = wvc + hits
                         if needs_scan:
                             nc = nc + jnp.sum(ok, dtype=jnp.uint32)
                             rok = rok.at[
                                 jnp.where(ok, prow_b, jnp.uint32(F_f))
                             ].max(jnp.uint32(1), mode="drop")
-                        return cl, ch, nc, eov | ev, rok
+                        return cl, ch, nc, eov | ev, rok, wvc
 
-                    ck_lo, ck_hi, nc_acc, eov_acc, row_ok = lax.fori_loop(
+                    (ck_lo, ck_hi, nc_acc, eov_acc, row_ok,
+                     wv_canon) = lax.fori_loop(
                         0,
                         NC,
                         fchunk,
@@ -2581,6 +2713,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                             jnp.bool_(False),
                             jnp.zeros(F_f if needs_scan else 1,
                                       jnp.uint32),
+                            jnp.uint32(0),
                         ),
                     )
                     e_overflow = e_overflow | eov_acc
@@ -2592,7 +2725,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                         n_cand = n_pairs
                 else:
                     (ck_lo, ck_hi, pair_ok, prow, eov,
-                     succ_t) = eval_pairs(pidx, live, pslot)
+                     succ_t, wv_canon) = eval_pairs(pidx, live, pslot)
                     if pay_fetch and not cpu_backend:
                         # Without this barrier XLA fuses the pair-step
                         # producer (frontier/params/sendtab gathers +
@@ -2714,6 +2847,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     jnp.maximum(c["max_tile_cand"], tile_max),
                     jnp.maximum(c["max_rowen"], jnp.max(cnt)),
                     wv_pairs=n_pairs,
+                    wv_canon=wv_canon,
                 )
 
             return wave
@@ -2751,7 +2885,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                             c["depth"].astype(jnp.uint32),
                             f_class.astype(jnp.uint32),
                             v_class.astype(jnp.uint32),
-                            jnp.uint32(0),
+                            c2["wv_canon"],
                             jnp.uint32(0),
                         ]
                     ),
@@ -2774,6 +2908,10 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                         c["depth"].astype(jnp.uint32),
                         f_class.astype(jnp.uint32),
                         v_class.astype(jnp.uint32),
+                        # optional lane 8 (WAVE_LOG_OPT_FIELDS):
+                        # candidates whose canonical form differed
+                        # from the raw successor this wave.
+                        c2["wv_canon"],
                     ]
                 )
                 c2 = dict(
@@ -2826,6 +2964,15 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                     buffer_entry("pair_index", (3, p["Ba"]), "uint32"),
                     buffer_entry("cand_keys", (2, p["Ba"]), "uint32"),
                 ]
+                if sym is not None:
+                    # the canonicalization pass materializes the
+                    # canonical successor block beside the concrete
+                    # one (per chunk when memory-lean)
+                    staging.append(buffer_entry(
+                        "canonical_t",
+                        (W, p["Bc"] if p["chunked"] else p["Ba"]),
+                        "uint32",
+                    ))
                 if p["chunked"]:
                     mode = "chunked"
                     staging.append(
@@ -2866,6 +3013,10 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                         buffer_entry("succ_flat", (FK, W), "uint32"),
                         buffer_entry("cand_keys", (3, rows), "uint32"),
                     ]
+                    if sym is not None:
+                        staging.append(buffer_entry(
+                            "canonical_rows", (FK, W), "uint32"
+                        ))
                 else:
                     mode = "tile_payload"
                     rows = B_eff
@@ -3045,7 +3196,7 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 st = c["pstash"]
                 row = jnp.stack([
                     st[0], st[1], st[2], conf, new2,
-                    st[3], st[4], st[5],
+                    st[3], st[4], st[5], st[6],
                 ])
                 out["wlog"] = lax.dynamic_update_slice(
                     c["wlog"], row[None, :],

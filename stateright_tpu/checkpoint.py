@@ -97,7 +97,7 @@ _SENT = 0xFFFFFFFF
 #: waves_per_sync — their content is telemetry, rewritten inside the
 #: chunk before any row is read.
 _SYNTH_LEAVES = frozenset({"wlog", "slog", "swave", "wv_pairs",
-                           "pstash"})
+                           "wv_canon", "pstash"})
 
 #: tiered-mode carry leaves a snapshot may carry on top of the
 #: untiered spec (the deferred-commit staging of stateright_tpu/

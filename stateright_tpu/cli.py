@@ -90,7 +90,19 @@ _RUNTIME = _ThreadLocalRuntime(
     checkpoint_every=None, checkpoint_path=None, resume=False,
     resume_any_sha=False, waves_per_sync=None, tier_hot_rows=None,
     degrade_on_fault=False, watchdog=None, straggler_factor=None,
+    symmetry=False, ample_set=False,
 )
+
+
+def _maybe_symmetry(builder):
+    """``--symmetry``: arm the builder's symmetry reduction BEFORE the
+    spawn (the capability refusal fires in the engine constructor,
+    checkers/common.symmetry_refusal) — device engines canonicalize
+    candidate fingerprints through the encoding's DeviceRewriteSpec
+    (ops/canonical.py)."""
+    if _RUNTIME["symmetry"]:
+        return builder.symmetry()
+    return builder
 
 
 def _apply_runtime(checker) -> None:
@@ -98,6 +110,26 @@ def _apply_runtime(checker) -> None:
     (before its first join). Device engines only: the flags configure
     the chunk loop, which host checkers don't have."""
     cfg = _RUNTIME
+    if cfg["symmetry"]:
+        # pre-spawn flag (_maybe_symmetry); by the time the checker
+        # reaches this seam the builder must already carry it — a lane
+        # that never called _maybe_symmetry would otherwise silently
+        # run unreduced
+        builder = getattr(checker, "builder", None)
+        if builder is not None and builder._symmetry is None:
+            raise SystemExit(
+                "--symmetry: this lane does not arm the symmetry "
+                "reduction (supported: 2pc check-tpu — the device "
+                "canonicalization lane — and the host check-sym lanes)"
+            )
+    if cfg["ample_set"]:
+        if not hasattr(checker, "ample_set"):
+            raise SystemExit(
+                "--ample-set needs a sort-merge check-tpu lane (the "
+                "filter ANDs the encoding's ample mask into the "
+                "sparse enabled bitmap, checkers/tpu_sortmerge.py)"
+            )
+        checker.ample_set = True
     if not (cfg["checkpoint_every"] or cfg["resume"]
             or cfg["waves_per_sync"] or cfg["tier_hot_rows"]
             or cfg["degrade_on_fault"] or cfg["watchdog"]
@@ -210,7 +242,7 @@ def _2pc(sub: str, args: list[str]) -> None:
 
         capacity = 1 << max(10, math.ceil(2.6 * rm_count + 1.5))
         _report(
-            sys_model.checker().spawn_tpu_sortmerge(
+            _maybe_symmetry(sys_model.checker()).spawn_tpu_sortmerge(
                 capacity=capacity,
                 frontier_capacity=max(256, capacity // 4),
                 cand_capacity="auto",
@@ -585,6 +617,14 @@ def _usage(model: str | None = None) -> None:
         "classifier)"
     )
     print(
+        "       --symmetry on 2pc check-tpu runs the device symmetry "
+        "reduction (canonical-form fingerprints before dedup, "
+        "ops/canonical.py; 2pc rm=5: 8,832 -> 314 states); "
+        "--ample-set on sort-merge check-tpu lanes ANDs the "
+        "encoding's partial-order ample mask into the sparse "
+        "enabled-bits pass (fewer interleavings, same verdicts)"
+    )
+    print(
         "       `serve` runs the resident multi-tenant checking "
         "service (stateright_tpu/serve.py): one warm process, a FIFO "
         "device queue, a byte-budget LRU of compiled programs, "
@@ -693,6 +733,16 @@ def _pop_runtime_flags(argv: list[str]) -> list[str]:
                     f"--watchdog={val}: the factor must be > 0"
                 )
             _RUNTIME["watchdog"] = f
+        elif a == "--symmetry":
+            # device symmetry reduction (ops/canonical.py): canonical
+            # fingerprints before dedup — armed on the builder pre-
+            # spawn (_maybe_symmetry); engines that can't honor it
+            # refuse loudly at spawn
+            _RUNTIME["symmetry"] = True
+        elif a == "--ample-set":
+            # partial-order-reduction enabled-bits filter: AND the
+            # encoding's ample mask into the sparse bitmap pass
+            _RUNTIME["ample_set"] = True
         elif a.startswith("--straggler-factor="):
             val = a.split("=", 1)[1]
             f = float(val)
@@ -717,7 +767,7 @@ def main(argv: list[str] | None = None) -> None:
         checkpoint_every=None, checkpoint_path=None, resume=False,
         resume_any_sha=False, waves_per_sync=None,
         tier_hot_rows=None, degrade_on_fault=False, watchdog=None,
-        straggler_factor=None,
+        straggler_factor=None, symmetry=False, ample_set=False,
     )
     # resident-service lanes (ROADMAP direction 4, serve.py): the
     # daemon, and the client mode that ships a lane to one

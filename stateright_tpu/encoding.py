@@ -140,6 +140,56 @@ class SparseEncodedModel(Protocol):
         ...
 
 
+@runtime_checkable
+class SymmetricEncodedModel(Protocol):
+    """Optional extension of :class:`EncodedModel`: device symmetry
+    reduction (ops/canonical.py).
+
+    An encoding whose interchangeable participants occupy uniformly
+    strided bit-fields declares the layout as a ``DeviceRewriteSpec``;
+    the wave engines then canonicalize every candidate block before
+    the fingerprint fold, so the visited key is the canonical
+    fingerprint while the frontier keeps the concrete states —
+    counterexample paths stay replayable, exactly the host DFS split
+    (dfs.rs:300-311). Everything downstream of the fingerprint — the
+    sharded ``(owner, fp)`` seam, tiered spills, checkpoints — then
+    operates on the reduced space without knowing symmetry exists.
+
+    The spec MUST be a perfect canonicalizer (constant on orbits):
+    sort on the FULL per-member tuple, not a subset — see the
+    symmetry.py module docstring for why a partial sort key makes the
+    visited count search-order-dependent."""
+
+    def device_rewrite_spec(self):
+        """``DeviceRewriteSpec`` for this encoding's interchangeable
+        limb group, or None when the instance has none (e.g. a
+        single-member configuration)."""
+        ...
+
+
+def device_rewrite_spec(enc):
+    """The encoding's ``DeviceRewriteSpec``, or None when it declares
+    none — the engines' single capability probe."""
+    fn = getattr(enc, "device_rewrite_spec", None)
+    return fn() if callable(fn) else None
+
+
+def ample_mask_host(enc):
+    """The encoding's host-precomputed ample-set slot mask
+    (``uint32[ceil(max_actions/32)]``, ops/bitmask.py word layout), or
+    None when it declares none. The sparse engines AND the words into
+    every row's enabled bits — a static partial-order-reduction
+    filter; the encoding owns the soundness argument for the slots it
+    drops (see models/two_phase_commit_tpu.py)."""
+    fn = getattr(enc, "ample_mask_host", None)
+    if not callable(fn):
+        return None
+    words = fn()
+    if words is None:
+        return None
+    return np.asarray(words, dtype=np.uint32)
+
+
 # -- transposed ([W, N]) invocation adapters (PERF.md §layout) -------------
 #
 # The sort-merge engines keep resident state column-major ``[W, N]``
@@ -189,6 +239,22 @@ def within_boundary_cols(enc, succ_t: Any) -> Any:
     import jax
 
     return jax.vmap(enc.within_boundary_vec, in_axes=1)(succ_t)
+
+
+def canonicalize_cols(enc, states_t: Any) -> Any:
+    """``uint32[W, N] -> uint32[W, N]`` — map each column to its orbit
+    representative under the encoding's ``DeviceRewriteSpec``
+    (identity passthrough when the encoding declares none). Already
+    lane-batched: the kernel is elementwise over lane rows, so no vmap
+    is needed."""
+    spec = device_rewrite_spec(enc)
+    if spec is None:
+        return states_t
+    import jax.numpy as jnp
+
+    from .ops.canonical import canonicalize_t
+
+    return canonicalize_t(spec, states_t, jnp)
 
 
 def step_slot_cols_fn(enc, states_axis: int = 0):

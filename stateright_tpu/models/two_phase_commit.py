@@ -59,6 +59,40 @@ class TwoPhaseState:
             ),
         )
 
+    def representative_full(self) -> "TwoPhaseState":
+        """Perfect canonicalizer: stable-sort RMs by their FULL
+        per-member tuple ``(rm_state, tm_prepared, prepared-msg)``.
+
+        ``representative()`` above sorts on rm_state alone (like the
+        reference), which is not constant on orbits — the reduced
+        visited count then depends on search order (DFS 665 vs BFS 508
+        at rm=5). This variant is constant on orbits, so host DFS and
+        the device wave BFS agree exactly (rm=5: 314 classes); it is
+        the host oracle for the TPU engines' DeviceRewriteSpec
+        canonicalization (ops/canonical.py), which sorts the same
+        tuple in the same encoded order."""
+        prep_bits = [
+            int(("prepared", i) in self.msgs)
+            for i in range(len(self.rm_state))
+        ]
+        plan = RewritePlan.from_values_to_sort(
+            [
+                (s.value, int(p), b)
+                for s, p, b in zip(
+                    self.rm_state, self.tm_prepared, prep_bits
+                )
+            ]
+        )
+        return TwoPhaseState(
+            rm_state=tuple(plan.reindex(self.rm_state)),
+            tm_state=self.tm_state,
+            tm_prepared=tuple(plan.reindex(self.tm_prepared)),
+            msgs=frozenset(
+                ("prepared", plan.rewrite(m[1])) if m[0] == "prepared" else m
+                for m in self.msgs
+            ),
+        )
+
 
 @dataclass
 class TwoPhaseSys(Model):

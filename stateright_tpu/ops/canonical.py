@@ -1,0 +1,245 @@
+"""Device-side symmetry canonicalization: vectorized RewritePlan.
+
+The host symmetry reduction (stateright_tpu/symmetry.py, reference
+representative.rs) maps each state to a canonical member of its
+permutation orbit before visited-set insertion — 2pc with 5 RMs drops
+from 8,832 to 665 states. This module is the device analog: an
+encoding whose interchangeable participants occupy UNIFORMLY STRIDED
+bit-fields declares a :class:`DeviceRewriteSpec`, and the engines run
+:func:`canonicalize_t` over every candidate block BEFORE the
+fingerprint fold, so the visited key is the canonical fingerprint
+while the frontier keeps the concrete states (the same
+visited-through-representatives / search-through-originals split the
+host DFS implements, dfs.rs:300-311).
+
+The kernel is deliberately GATHER-FREE (the codegen lint rules,
+analysis/rules.py, gate it like the enabled-bits pass):
+
+* the stable sort permutation is computed as comparison-count RANKS —
+  ``rank[m] = sum_j (key[j] < key[m])`` over keys made distinct by an
+  embedded member-index tiebreak, which reproduces EXACTLY the host
+  ``RewritePlan.from_values_to_sort`` stable sort (Python ``sorted``
+  is stable; ties resolve by original index there too);
+* the permutation is APPLIED as comparison-based one-hot select-sums
+  — ``out[p] = sum_m (rank[m] == p) * val[m]`` — R^2 lane-ALU ops for
+  R members, no ``jnp.take``, no dense [B, K] masks.
+
+Everything here is module-generic over the array namespace (``xp`` =
+``jax.numpy`` on device, ``numpy`` on host), the same pattern as
+ops/fingerprint.py — host path reconstruction canonicalizes encoded
+rows with BIT-IDENTICAL math before fingerprinting, so the parent-log
+keys the device wrote and the keys the host replay computes can never
+drift.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class MemberField:
+    """One per-member bit-field: member ``m``'s value occupies bits
+    ``[shift + m*stride, shift + m*stride + width)`` of ``lane``.
+
+    Fields with ``sort_key=True`` form the stable-sort key, major to
+    minor in declaration order. To mirror a host representative that
+    sorts on a SUBSET of the per-member state (e.g. 2pc sorts on
+    rm_state only), mark exactly that subset as keys — the comparison
+    ranks embed the member index as the final tiebreak, so the device
+    permutation equals the host's stable sort."""
+
+    lane: int
+    shift: int
+    stride: int
+    width: int
+    sort_key: bool = False
+
+
+@dataclass(frozen=True)
+class DeviceRewriteSpec:
+    """The declared symmetry of an encoding's interchangeable limb
+    group: ``n_members`` participants whose per-member state lives in
+    the strided :class:`MemberField` s. Canonicalization permutes ALL
+    fields by the stable sort over the key fields — the vectorized
+    counterpart of ``RewritePlan.from_values_to_sort`` + ``reindex``
+    (+ the prepared-message rewrite, which for a strided bitmask IS a
+    reindex of the mask bits)."""
+
+    n_members: int
+    fields: Tuple[MemberField, ...] = field(default_factory=tuple)
+
+    def __post_init__(self):
+        validate_spec(self)
+
+
+def _idx_bits(n_members: int) -> int:
+    bits = 1
+    while (1 << bits) < n_members:
+        bits += 1
+    return bits
+
+
+def validate_spec(spec: DeviceRewriteSpec,
+                  width: Optional[int] = None) -> None:
+    """Loud structural validation — a malformed spec must refuse at
+    declaration, not mis-canonicalize (silent under-exploration is the
+    checker's worst failure mode)."""
+    R = spec.n_members
+    if R < 2:
+        raise ValueError(
+            f"DeviceRewriteSpec needs >= 2 interchangeable members "
+            f"(got {R}); a singleton group has nothing to permute"
+        )
+    if not spec.fields:
+        raise ValueError("DeviceRewriteSpec declares no member fields")
+    key_bits = 0
+    for f in spec.fields:
+        if f.width < 1 or f.stride < f.width:
+            raise ValueError(
+                f"MemberField(lane={f.lane}): width {f.width} must be "
+                f">= 1 and <= stride {f.stride} (members must not "
+                "overlap)"
+            )
+        top = f.shift + (R - 1) * f.stride + f.width
+        if top > 32:
+            raise ValueError(
+                f"MemberField(lane={f.lane}, shift={f.shift}): member "
+                f"{R - 1}'s bits end at {top} > 32 — the strided group "
+                "must fit one uint32 lane"
+            )
+        if width is not None and not (0 <= f.lane < width):
+            raise ValueError(
+                f"MemberField lane {f.lane} outside encoding width "
+                f"{width}"
+            )
+        if f.sort_key:
+            key_bits += f.width
+    if key_bits == 0:
+        raise ValueError(
+            "DeviceRewriteSpec has no sort_key fields — the canonical "
+            "order would be undefined"
+        )
+    if key_bits + _idx_bits(R) > 32:
+        raise ValueError(
+            f"sort key ({key_bits} bits) + member-index tiebreak "
+            f"({_idx_bits(R)} bits) exceeds 32 — the packed rank key "
+            "must fit one uint32"
+        )
+
+
+def _field_mask(f: MemberField) -> int:
+    return (1 << f.width) - 1
+
+
+def _group_clear_mask(spec: DeviceRewriteSpec, lane: int) -> int:
+    """Host-constant: every member bit of every field on ``lane``."""
+    m = 0
+    for f in spec.fields:
+        if f.lane != lane:
+            continue
+        for i in range(spec.n_members):
+            m |= _field_mask(f) << (f.shift + i * f.stride)
+    return m & 0xFFFFFFFF
+
+
+def _canonicalize_lanes(spec: DeviceRewriteSpec, lanes: list, xp):
+    """The kernel body over a list of uint32 lane arrays (any common
+    batch shape). Returns the canonical lanes; untouched lanes pass
+    through by reference."""
+    R = spec.n_members
+    u32 = lanes[0].dtype
+    ib = _idx_bits(R)
+
+    # Per-member field values, extracted once (shift-mask lane ALU).
+    vals = []  # vals[fi][m]
+    for f in spec.fields:
+        fm = np.uint32(_field_mask(f))
+        vals.append([
+            (lanes[f.lane] >> np.uint32(f.shift + m * f.stride)) & fm
+            for m in range(R)
+        ])
+
+    # Packed stable-sort keys: key fields major-to-minor, the member
+    # index as the final tiebreak — distinct by construction, so the
+    # comparison ranks ARE the host stable-sort permutation
+    # (rank[m] = new position of member m; RewritePlan.inverse).
+    keys = []
+    for m in range(R):
+        k = None
+        for fi, f in enumerate(spec.fields):
+            if not f.sort_key:
+                continue
+            v = vals[fi][m].astype(u32)
+            k = v if k is None else (
+                (k << np.uint32(f.width)) | v
+            )
+        k = (k << np.uint32(ib)) | np.uint32(m)
+        keys.append(k)
+    ranks = [
+        sum(
+            (keys[j] < keys[m]).astype(u32)
+            for j in range(R) if j != m
+        )
+        for m in range(R)
+    ]
+    # One-hot permutation grid, computed once and reused per field:
+    # sel[p][m] is True where member m lands at output position p.
+    sel = [[ranks[m] == np.uint32(p) for m in range(R)]
+           for p in range(R)]
+
+    out = list(lanes)
+    touched = sorted({f.lane for f in spec.fields})
+    for lane in touched:
+        acc = out[lane] & np.uint32(
+            ~_group_clear_mask(spec, lane) & 0xFFFFFFFF
+        )
+        for fi, f in enumerate(spec.fields):
+            if f.lane != lane:
+                continue
+            for p in range(R):
+                v = sum(
+                    xp.where(sel[p][m], vals[fi][m], np.uint32(0))
+                    for m in range(R)
+                )
+                acc = acc | (v << np.uint32(f.shift + p * f.stride))
+        out[lane] = acc
+    return out
+
+
+def canonicalize_t(spec: DeviceRewriteSpec, states_t, xp):
+    """``uint32[W, N] -> uint32[W, N]`` — canonicalize a TRANSPOSED
+    (column-major, PERF.md §layout) state block: each column maps to
+    its orbit representative. Lane reads are row slices of the
+    resident block; all math is elementwise over ``[N]`` lane rows."""
+    W = states_t.shape[0]
+    lanes = [states_t[i] for i in range(W)]
+    return xp.stack(_canonicalize_lanes(spec, lanes, xp))
+
+
+def canonicalize_rows(spec: DeviceRewriteSpec, rows, xp):
+    """Row-major variant: ``uint32[..., W] -> uint32[..., W]`` (used
+    by the dense engine paths and the HOST replay — a single encoded
+    ``uint32[W]`` row canonicalizes with the identical math, which is
+    what keeps the parent-log keys and the host ``_vec_fp`` bit-equal)."""
+    W = rows.shape[-1]
+    lanes = [rows[..., i] for i in range(W)]
+    return xp.stack(_canonicalize_lanes(spec, lanes, xp), axis=-1)
+
+
+def canonical_hits(raw_t, canon_t, xp):
+    """``uint32`` count of columns whose canonical form differs from
+    the raw successor — the per-wave ``canonical_hits`` telemetry lane
+    (how much symmetry is actually folding this wave)."""
+    changed = (raw_t != canon_t).any(axis=0)
+    return changed.sum().astype(raw_t.dtype)
+
+
+def canonicalize_vec(spec: DeviceRewriteSpec, vec, xp):
+    """One state: ``uint32[W] -> uint32[W]`` (the lint registry's
+    row-contract view; vmapping this equals :func:`canonicalize_t`
+    up to layout)."""
+    return canonicalize_rows(spec, vec, xp)

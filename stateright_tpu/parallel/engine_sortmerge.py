@@ -119,6 +119,8 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
     from the single-chip sort-merge engine; the device programs and the
     parent-log layout differ."""
 
+    _engine_name = "spawn_tpu_sharded_sortmerge"
+
     def __init__(
         self,
         builder: CheckerBuilder,
@@ -331,6 +333,16 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
 
         tier_mode = bool(tiered)
         enc = self.encoded
+        # Device symmetry (ops/canonical.py, see the single-chip
+        # engine): fingerprints fold the CANONICAL block, the resident
+        # frontier keeps concrete states. The routing ownership below
+        # (k_lo % S) then hashes the canonical key, so all members of
+        # an orbit route to ONE shard and the per-shard dedup is a
+        # global orbit dedup — no cross-shard coordination added.
+        sym = self.sym_spec
+        if sym is not None:
+            from ..ops.canonical import canonicalize_rows, canonicalize_t
+        ample_words = self._resolve_ample_words()
         props = list(self.model.properties())
         n_props = len(props)
         # XLA:CPU needs a gather-arrangement workaround in the tile
@@ -471,7 +483,10 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
             # transpose ONCE into the [W, F] resident layout
             # (PERF.md §layout).
             me = lax.axis_index("shard").astype(jnp.uint32)
-            lo0, hi0 = fingerprint_u32v(init_rows, jnp)
+            # canonical keys from wave zero (ownership included)
+            fp_rows = (canonicalize_rows(sym, init_rows, jnp)
+                       if sym is not None else init_rows)
+            lo0, hi0 = fingerprint_u32v(fp_rows, jnp)
             lo0, hi0 = clamp_keys(lo0, hi0)
             mine = (lo0 % jnp.uint32(S)) == me
             pos = jnp.cumsum(mine) - 1
@@ -893,7 +908,7 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                     # enter the routing sort and the shuffle.
                     cond, eb, fp_lo, fp_hi = frontier_props_t(
                         enc, props, evt_idx, frontier_t, fval_c,
-                        ebits_c,
+                        ebits_c, sym_spec=sym,
                     )
                     (
                         pidx, live, pslot, cnt, n_pairs, pair_ovf, _tm,
@@ -906,6 +921,7 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                         EV=EV, B_p=B_c, NT=NT, T=T,
                         mask_budget_cells=self.mask_budget_cells,
                         Ba=R_src, axis_name="shard", n_rows=F_c,
+                        ample_words=ample_words,
                     )
                     # Pair-state gather seam: the shared backend
                     # policy (encoding.pair_step_seam).
@@ -936,7 +952,12 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                         if ptr_b is not None:
                             eov = eov | jnp.any(ok & ptr_b)
                             ok = ok & ~ptr_b
-                        lo, hi = fingerprint_u32v_t(succ_t, jnp)
+                        if sym is not None:
+                            # canonical key, concrete successor block
+                            canon_t = canonicalize_t(sym, succ_t, jnp)
+                            lo, hi = fingerprint_u32v_t(canon_t, jnp)
+                        else:
+                            lo, hi = fingerprint_u32v_t(succ_t, jnp)
                         lo, hi = clamp_keys(lo, hi)
                         return succ_t, lo, hi, ok, prow_b, eov
 
@@ -1053,13 +1074,16 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
                     ex = expand_frontier(
                         enc, props, evt_idx, frontier_rows, fval_c,
                         ebits_c, expand, with_repeats=False,
+                        sym_spec=sym,
                     )
                     e_overflow = e_overflow | bool_any(
                         jnp.any(ex["trunc"])
                     )
                     cand_state, cand_valid = ex["flat"], ex["v"]
                     n_cand = jnp.sum(cand_valid).astype(jnp.uint32)
-                    k_lo, k_hi = fingerprint_u32v(cand_state, jnp)
+                    fp_flat = (canonicalize_rows(sym, cand_state, jnp)
+                               if sym is not None else cand_state)
+                    k_lo, k_hi = fingerprint_u32v(fp_flat, jnp)
                     k_lo, k_hi = clamp_keys(k_lo, k_hi)
                     cand_par = None  # parent row = candidate // K
 

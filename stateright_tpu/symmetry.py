@@ -11,15 +11,26 @@ symmetric collection and rewrite every embedded index accordingly.
 
 Usage: give states a ``representative()`` method (the
 :class:`Representative` protocol) built from a :class:`RewritePlan`,
-then enable ``CheckerBuilder.symmetry()``. Only the DFS and simulation
-checkers support symmetry, as in the reference (dfs.rs:300-311,
+then enable ``CheckerBuilder.symmetry()``. The host DFS and simulation
+checkers take any such callable, as in the reference (dfs.rs:300-311,
 simulation.rs:252-256) — the visited key is the representative's
 fingerprint while the search continues from the original state, so
 counterexample paths stay replayable.
 
-On the TPU engine the analogous canonicalization is a per-wave gather:
-``reindex`` is ``jnp.take`` and index rewriting is a lookup into the
-inverse permutation — see stateright_tpu/ops.
+On the TPU wave engines the analogous canonicalization is the
+GATHER-FREE vectorized kernel in stateright_tpu/ops/canonical.py: an
+encoding declares a ``DeviceRewriteSpec`` (the strided bit-field
+layout of its interchangeable limb group) and the engines canonicalize
+every candidate block before the fingerprint fold. One caveat the
+device path surfaces that the host default hides: a representative
+that sorts on a strict SUBSET of the per-member state (e.g. 2pc's
+rm_state-only sort) is not constant on orbits, so the reduced visited
+count depends on search order — the reference's pinned 665 for 2pc
+rm=5 is a DFS-order artifact (a BFS with the same representative
+visits 508). The device spec therefore sorts on the FULL per-member
+tuple, a perfect canonicalizer whose count (314 for 2pc rm=5) is
+order-independent and agrees between the wave BFS and a host DFS
+given the matching ``representative_full`` oracle.
 """
 
 from __future__ import annotations

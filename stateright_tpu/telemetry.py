@@ -101,7 +101,7 @@ SCHEMA_VERSION = 1
 #: can't see the enabled popcount from the log wrapper (the sharded
 #: engine) — those pass ``pairs_valid=False`` and the event carries
 #: ``enabled_pairs: null``.
-WAVE_LOG_LANES = 8
+WAVE_LOG_LANES = 9
 WAVE_LOG_FIELDS = (
     "frontier_rows",   # live rows entering the wave
     "enabled_pairs",   # enabled-bitmap popcount (sparse single-chip)
@@ -111,6 +111,19 @@ WAVE_LOG_FIELDS = (
     "depth",           # depth entering the wave
     "f_class",         # frontier ladder class dispatched
     "v_class",         # visited ladder class dispatched
+)
+#: OPTIONAL trailing lanes past the required WAVE_LOG_FIELDS — lanes
+#: an engine writes only when the matching feature is on (writers
+#: that stack the 8 required lanes leave the tail zero via the
+#: dynamic_update_slice into the [wps, WAVE_LOG_LANES] log, and rows
+#: shorter than the lane count simply omit the field from the wave
+#: event). ``canonical_hits``: candidates this wave whose canonical
+#: form differed from the raw successor (device symmetry reduction,
+#: ops/canonical.py) — the per-wave measure of how much symmetry is
+#: folding. NOT in the trace-validation REQUIRED set: pre-symmetry
+#: traces and engines without the pass stay valid.
+WAVE_LOG_OPT_FIELDS = (
+    "canonical_hits",  # candidates remapped by canonicalization
 )
 
 #: per-SHARD device wave-log lane layout (the round-11 mesh
@@ -568,6 +581,10 @@ class RunTracer:
         for i in range(n_waves):
             row = [int(x) for x in wave_rows[i]]
             fields = dict(zip(WAVE_LOG_FIELDS, row))
+            for j, name in enumerate(WAVE_LOG_OPT_FIELDS):
+                k = len(WAVE_LOG_FIELDS) + j
+                if k < len(row):
+                    fields[name] = row[k]
             if not pairs_valid:
                 if shard_rows is not None:
                     # lane 1 of SHARD_LOG_FIELDS, summed over shards

@@ -1,0 +1,377 @@
+"""Device symmetry-reduction gate (``sym`` marker).
+
+The tentpole contract (ops/canonical.py + the sort-merge engines):
+candidates canonicalize to their orbit representative BEFORE the
+fingerprint fold, so the visited key space is the reduced quotient
+while the frontier keeps CONCRETE states — counterexample paths stay
+replayable, exactly the host DFS split (dfs.rs:300-311). The gate
+pins:
+
+* kernel unit facts — spec validation refuses malformed layouts
+  loudly; the canonicalization is bit-identical between the numpy
+  host replay and the jax device path, idempotent, and constant on
+  orbits (it matches ``representative_full`` through encode/decode);
+* device-vs-host parity — the sort-merge engine under ``--symmetry``
+  reproduces the host DFS symmetry oracle's count (80 at rm=3, 314
+  at rm=5 — the PERFECT canonicalizer's order-independent counts;
+  see symmetry.py on why the reference's 665 is a DFS-order
+  artifact), same verdicts, replayable discovery paths;
+* the reduction survives the machinery downstream of the fingerprint:
+  tiered forced-spill, kill/resume (S=2 -> S=2 and the 2 -> 4
+  re-shard route canonical keys), the sharded S=2 run itself;
+* the ample-set enabled-bits filter preserves verdicts against the
+  unfiltered oracle and REFUSES when the encoding declares no mask;
+* the three former hand-rolled refusal messages are one helper
+  (checkers/common.symmetry_refusal) — every refusing engine and the
+  missing-capability device path speak the same words;
+* a traced sym-vs-sym pair diffs to zero counter divergence, and the
+  per-wave ``canonical_hits`` telemetry lane is live.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from stateright_tpu.models.two_phase_commit import (  # noqa: E402
+    TwoPhaseSys,
+)
+from stateright_tpu.models.two_phase_commit_tpu import (  # noqa: E402
+    TwoPhaseSysEncoded,
+)
+from stateright_tpu.ops.canonical import (  # noqa: E402
+    DeviceRewriteSpec,
+    MemberField,
+    canonicalize_rows,
+    validate_spec,
+)
+
+pytestmark = pytest.mark.sym
+
+
+def _host_sym(rm):
+    """The host DFS symmetry oracle: the PERFECT (full per-member
+    tuple) canonicalizer, the one the device kernel implements."""
+    return (
+        TwoPhaseSys(rm_count=rm)
+        .checker()
+        .symmetry_fn(lambda s: s.representative_full())
+        .spawn_dfs()
+        .join()
+    )
+
+
+def _sym3(**kw):
+    kw.setdefault("capacity", 1 << 10)
+    kw.setdefault("frontier_capacity", 128)
+    kw.setdefault("cand_capacity", 512)
+    kw.setdefault("waves_per_sync", 2)
+    return (
+        TwoPhaseSys(rm_count=3)
+        .checker()
+        .symmetry()
+        .spawn_tpu_sortmerge(**kw)
+    )
+
+
+# -- kernel unit facts -----------------------------------------------------
+
+
+def test_validate_spec_refuses_malformed_layouts():
+    f = MemberField(lane=0, shift=0, stride=2, width=2, sort_key=True)
+    with pytest.raises(ValueError, match="singleton"):
+        DeviceRewriteSpec(n_members=1, fields=(f,))
+    with pytest.raises(ValueError, match="no member fields"):
+        DeviceRewriteSpec(n_members=3, fields=())
+    with pytest.raises(ValueError, match="overlap"):
+        DeviceRewriteSpec(
+            n_members=3,
+            fields=(MemberField(0, 0, stride=1, width=2,
+                                sort_key=True),),
+        )
+    with pytest.raises(ValueError, match="fit one uint32 lane"):
+        DeviceRewriteSpec(
+            n_members=8,
+            fields=(MemberField(0, 8, stride=4, width=4,
+                                sort_key=True),),
+        )
+    with pytest.raises(ValueError, match="no sort_key"):
+        DeviceRewriteSpec(
+            n_members=3,
+            fields=(MemberField(0, 0, stride=2, width=2,
+                                sort_key=False),),
+        )
+    with pytest.raises(ValueError, match="outside encoding width"):
+        validate_spec(
+            DeviceRewriteSpec(
+                n_members=3,
+                fields=(MemberField(5, 0, stride=2, width=2,
+                                    sort_key=True),),
+            ),
+            width=2,
+        )
+
+
+def test_canonicalize_matches_representative_full_bit_identical():
+    """Over EVERY reachable rm=4 state: the kernel (numpy host path
+    AND jax device path, bit-identical to each other) equals
+    encode(representative_full(decode(s))) — the device reduction is
+    the host oracle's, limb for limb. Also idempotent."""
+    import jax.numpy as jnp
+
+    enc = TwoPhaseSysEncoded(4)
+    spec = enc.device_rewrite_spec()
+    model = TwoPhaseSys(rm_count=4)
+    seen, queue = {}, list(model.init_states())
+    while queue:
+        s = queue.pop()
+        k = tuple(enc.encode(s).tolist())
+        if k in seen:
+            continue
+        seen[k] = s
+        queue.extend(model.next_states(s))
+    states = list(seen.values())
+    assert len(states) == 1568  # the pinned rm=4 raw count
+    rows = np.stack([enc.encode(s) for s in states])
+    want = np.stack([
+        enc.encode(s.representative_full()) for s in states
+    ])
+    got_np = canonicalize_rows(spec, rows, np)
+    got_jnp = np.asarray(
+        canonicalize_rows(spec, jnp.asarray(rows), jnp)
+    )
+    np.testing.assert_array_equal(got_np, want)
+    np.testing.assert_array_equal(got_jnp, want)
+    # idempotent: canonical forms are fixed points
+    np.testing.assert_array_equal(
+        canonicalize_rows(spec, got_np, np), got_np
+    )
+
+
+# -- device-vs-host parity -------------------------------------------------
+
+
+def test_device_symmetry_rm3_matches_host_oracle():
+    host = _host_sym(3)
+    c = _sym3().join()
+    assert c.unique_state_count() == host.unique_state_count() == 80
+    assert sorted(c.discoveries()) == sorted(host.discoveries())
+    # counterexample paths replay through CONCRETE states: the path
+    # machinery never sees a canonical form it could not re-step
+    for name, path in c.discoveries().items():
+        prop = c.model.property_by_name(name)
+        assert prop.condition(c.model, path.last_state())
+
+
+def test_device_symmetry_rm5_is_314_order_independent():
+    """rm=5: 8,832 raw states reduce to 314 — the perfect
+    canonicalizer's count, which is search-order-independent (the
+    reference's pinned 665 is an artifact of its PARTIAL sort key
+    meeting DFS expansion order; see symmetry.py)."""
+    host = _host_sym(5)
+    c = (
+        TwoPhaseSys(rm_count=5)
+        .checker()
+        .symmetry()
+        .spawn_tpu_sortmerge(
+            capacity=1 << 11, frontier_capacity=256,
+            cand_capacity=2048, waves_per_sync=4,
+        )
+        .join()
+    )
+    assert c.unique_state_count() == host.unique_state_count() == 314
+    assert sorted(c.discoveries()) == sorted(host.discoveries())
+
+
+# -- the reduction survives the downstream machinery -----------------------
+
+
+def test_tiered_forced_spill_keeps_canonical_counts():
+    """Canonical fingerprints survive the device-hot/host-cold spill:
+    the tier layer dedups KEYS and never re-derives them, so a
+    forced spill must not change the reduced count."""
+    c = _sym3(capacity=1 << 10, tier_hot_rows=32).join()
+    assert c.unique_state_count() == 80
+    assert sorted(c.discoveries()) == sorted(
+        _host_sym(3).discoveries()
+    )
+
+
+def test_sharded_s2_symmetry_parity():
+    """S=2: ownership hashes the CANONICAL fingerprint, so whole
+    orbits route to one shard and per-shard dedup IS global orbit
+    dedup — same 80, same verdicts, replayable paths."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the virtual multi-device CPU mesh")
+    c = (
+        TwoPhaseSys(rm_count=3)
+        .checker()
+        .symmetry()
+        .spawn_tpu_sharded_sortmerge(
+            n_shards=2, capacity=1 << 10, frontier_capacity=128,
+            cand_capacity=1024, bucket_capacity=512,
+            waves_per_sync=2,
+        )
+        .join()
+    )
+    assert c.unique_state_count() == 80
+    for name, path in c.discoveries().items():
+        prop = c.model.property_by_name(name)
+        assert prop.condition(c.model, path.last_state())
+
+
+def test_kill_resume_and_reshard_keep_canonical_counts(tmp_path):
+    """Kill at a chunk boundary, resume — and resume onto a DIFFERENT
+    shard count: the snapshot carries canonical fingerprints, and the
+    (owner, fp) re-route hashes them again, so both resumes land on
+    the oracle's 80."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the virtual multi-device CPU mesh")
+    from stateright_tpu import faultinject
+
+    def spawn(n_shards, **kw):
+        return (
+            TwoPhaseSys(rm_count=3)
+            .checker()
+            .symmetry()
+            .spawn_tpu_sharded_sortmerge(
+                n_shards=n_shards, capacity=1 << 10,
+                frontier_capacity=128, cand_capacity=1024,
+                bucket_capacity=512, waves_per_sync=2, **kw,
+            )
+        )
+
+    snap = str(tmp_path / "sym.ckpt")
+    c = spawn(2, checkpoint_every=1, checkpoint_path=snap)
+    c.max_fault_retries = 0
+    faultinject.arm("raise", "chunk_boundary", 1)
+    try:
+        with pytest.raises(faultinject.InjectedFault):
+            c.join()
+    finally:
+        faultinject.disarm_all()
+
+    same = spawn(2)
+    same.resume_from(snap)
+    same.join()
+    assert same.unique_state_count() == 80
+
+    re4 = spawn(4)
+    re4.resume_from(snap)
+    re4.join()
+    assert re4.unique_state_count() == 80
+
+
+# -- the ample-set enabled-bits filter -------------------------------------
+
+
+def test_ample_set_preserves_verdicts():
+    """The 2pc ample mask (drop the redundant abort-choice slot for
+    rm >= 1) explores fewer states but reaches the SAME verdicts as
+    the unfiltered oracle — on its own and composed with symmetry."""
+    full = TwoPhaseSys(rm_count=3).checker().spawn_bfs().join()
+    amp = (
+        TwoPhaseSys(rm_count=3)
+        .checker()
+        .spawn_tpu_sortmerge(
+            capacity=1 << 10, frontier_capacity=128,
+            cand_capacity=512, waves_per_sync=2, ample_set=True,
+        )
+        .join()
+    )
+    assert amp.unique_state_count() == 260  # < full's 288
+    assert full.unique_state_count() == 288
+    assert sorted(amp.discoveries()) == sorted(full.discoveries())
+    for name, path in amp.discoveries().items():
+        prop = amp.model.property_by_name(name)
+        assert prop.condition(amp.model, path.last_state())
+
+    both = _sym3(ample_set=True).join()
+    assert both.unique_state_count() == 76  # < sym-only's 80
+    assert sorted(both.discoveries()) == sorted(full.discoveries())
+
+
+def test_ample_set_refuses_without_encoding_mask():
+    """No declared ample mask -> loud refusal at program build (the
+    engine cannot invent a sound reduction), not a silent full run."""
+    from stateright_tpu.models.paxos import PaxosModelCfg, paxos_model
+
+    c = (
+        paxos_model(PaxosModelCfg(client_count=2, server_count=3))
+        .checker()
+        .spawn_tpu_sortmerge(
+            capacity=1 << 12, frontier_capacity=512,
+            cand_capacity=2048, ample_set=True,
+        )
+    )
+    with pytest.raises(ValueError, match="sound reduction"):
+        c.join()
+
+
+# -- one refusal voice -----------------------------------------------------
+
+
+def test_refusal_messages_are_unified():
+    """Every refusing engine raises checkers/common.symmetry_refusal's
+    wording: the engine name, the supported list, and — on the device
+    capability path — the missing capability by name."""
+    from stateright_tpu.models.paxos import PaxosModelCfg, paxos_model
+
+    host_engines = (
+        ("spawn_bfs", "spawn_bfs"),
+        ("spawn_on_demand", "spawn_on_demand"),
+        ("spawn_tpu", "spawn_tpu (hash engine)"),
+    )
+    for name, label in host_engines:
+        b = TwoPhaseSys(rm_count=3).checker().symmetry()
+        with pytest.raises(ValueError) as ei:
+            getattr(b, name)()
+        msg = str(ei.value)
+        assert f"symmetry reduction: {label} cannot honor it" in msg
+        assert "spawn_dfs / spawn_simulation" in msg
+        assert "device_rewrite_spec()" in msg
+
+    # the sort-merge engine CAN honor it — but only for encodings
+    # that declare the capability; paxos does not
+    b = (
+        paxos_model(PaxosModelCfg(client_count=2, server_count=3))
+        .checker()
+        .symmetry()
+    )
+    with pytest.raises(ValueError) as ei:
+        b.spawn_tpu_sortmerge(capacity=1 << 12)
+    msg = str(ei.value)
+    assert "spawn_tpu_sortmerge cannot honor it" in msg
+    assert "missing capability" in msg
+
+
+# -- telemetry: the canonical_hits lane + traced A/B zero divergence ------
+
+
+def test_traced_sym_pair_diffs_clean_and_logs_canonical_hits(tmp_path):
+    from stateright_tpu.telemetry import (
+        RunTracer,
+        diff_traces,
+        load_trace,
+        write_artifacts,
+    )
+
+    def traced(name):
+        tr = RunTracer()
+        with tr.activate():
+            c = _sym3(waves_per_sync=4).join()
+        assert c.unique_state_count() == 80
+        jsonl, _ = write_artifacts(tr, root=str(tmp_path))
+        return jsonl
+
+    a = load_trace(traced("a"))
+    b = load_trace(traced("b"))
+    rep = diff_traces(a, b)
+    assert rep["ok"], rep["divergences"]
+    assert not rep["divergences"]
+    # the optional lane is LIVE on a symmetry run: some wave merged
+    # candidates whose canonical form differed from the raw state
+    waves = [e for e in a if e["ev"] == "wave"]
+    assert waves, "no wave events in the traced run"
+    hits = sum(int(w.get("canonical_hits") or 0) for w in waves)
+    assert hits > 0, waves

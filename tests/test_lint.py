@@ -430,3 +430,83 @@ def test_lint_report_shape():
     assert bad and all(
         {"rule", "encoding", "path", "message"} <= set(f) for f in bad
     )
+
+
+# -- the symmetry-canonicalization kernel paths (registry.CANONICAL_PATHS) --
+
+
+def test_lint_covers_canonical_paths_when_spec_declared():
+    """An encoding with a ``DeviceRewriteSpec`` is traced on all
+    three canonicalization invocations (row-major, transposed [W, N],
+    shard_map) — and one without a spec traces NONE of them: the
+    audit gates on the same capability probe the engines use
+    (encoding.device_rewrite_spec), so a newly symmetric encoding is
+    audited the moment the engines would canonicalize it."""
+    from stateright_tpu.analysis import get_encoding_spec
+    from stateright_tpu.analysis.registry import CANONICAL_PATHS
+
+    _, stats = lint_encoding(
+        get_encoding_spec("hand-2pc-rm4"), engines=("single",)
+    )
+    covered = {s["path"] for s in stats}
+    assert set(CANONICAL_PATHS) <= covered, covered
+    # and clean: the shipped kernel is gather-free by construction
+    findings, _ = lint_encoding(
+        get_encoding_spec("hand-2pc-rm4"), engines=("single",)
+    )
+    assert not [
+        f for f in _errors(findings) if f.path in CANONICAL_PATHS
+    ]
+
+    _, stats = lint_encoding(
+        get_encoding_spec("hand-paxos-2c3s"), engines=("single",)
+    )
+    assert not (set(CANONICAL_PATHS)
+                & {s["path"] for s in stats})
+
+
+def test_lint_catches_canonical_gather_regression():
+    """The TEETH for the canon paths: the obvious alternative
+    canonicalizer — extract per-member keys, ``argsort``, permute the
+    members back with ``take_along_axis`` — is gather-based, which is
+    exactly the priced artifact the shipped kernel avoids (rank via
+    comparison counts + one-hot select-sums, ops/canonical.py). Run
+    under the canon-path TraceCtx it must be caught by the NAMED
+    no-mask-gather rule with a source-attributed finding."""
+    enc = TwoPhaseSysEncoded(4)
+    spec = enc.device_rewrite_spec()
+    f0 = spec.fields[0]
+    n = 64
+
+    def gather_canon(states_t):
+        lane = states_t[f0.lane]
+        fmask = jnp.uint32((1 << f0.width) - 1)
+        keys = jnp.stack([
+            (lane >> jnp.uint32(f0.shift + m * f0.stride)) & fmask
+            for m in range(spec.n_members)
+        ])  # [M, N]
+        order = jnp.argsort(keys, axis=0)
+        skeys = jnp.take_along_axis(keys, order, axis=0)
+        out = lane & ~jnp.uint32(
+            ((1 << (f0.width * spec.n_members)) - 1) << f0.shift
+        )
+        for m in range(spec.n_members):
+            out = out | (
+                skeys[m] << jnp.uint32(f0.shift + m * f0.stride)
+            )
+        return states_t.at[f0.lane].set(out)
+
+    closed = jax.make_jaxpr(gather_canon)(
+        jnp.zeros((enc.width, n), jnp.uint32)
+    )
+    ctx = TraceCtx(
+        path="canon[t]", encoding="gather-canon-fixture", n=n,
+        k=enc.max_actions, sparse=True, allow_gathers=0,
+        check_lane_alu=True,
+    )
+    hits = [
+        f for f in _errors(run_rules(ctx, closed))
+        if f.rule == "no-mask-gather"
+    ]
+    assert hits, run_rules(ctx, closed)
+    assert all(f.source for f in hits)

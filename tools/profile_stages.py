@@ -331,6 +331,35 @@ def stage_profile(kind, n, caps, target):
         lambda fr: eval_block(fr, pidx, live, pslot)
     )(frontier_f)
 
+    # -- stage: symmetry canonicalization over the successor block ------
+    # (only when the encoding declares a device rewrite spec; the
+    # engine runs this between step and fingerprint when --symmetry is
+    # armed, so its cost rides the same [W, B] transposed layout)
+    from stateright_tpu.encoding import device_rewrite_spec
+
+    sym_spec = device_rewrite_spec(enc)
+    if sym_spec is not None:
+        from stateright_tpu.ops.canonical import canonicalize_t
+
+        Bcn = Bc if chunked else Ba
+        succ_t_d = jax.jit(
+            lambda fr: step_cols(
+                pair_states(fr, pidx[:Bcn] // jnp.uint32(EV)),
+                pslot[:Bcn],
+            )[0]
+        )(frontier_f)
+
+        def s_canon(i, a):
+            st, acc = a
+            st = st.at[0, 0].set(st[0, 0] ^ (i.astype(jnp.uint32) & 1))
+            ct = canonicalize_t(sym_spec, st, jnp)
+            acc = acc.at[0].add(_fold(ct))
+            return st, acc
+
+        results[f"canonicalize ({Bcn} succ)"] = _timed(
+            s_canon, (succ_t_d, acc0)
+        )
+
     v_lo_full, v_hi_full = carry["vkeys"][0], carry["vkeys"][1]
     M = V_v + Ba
 

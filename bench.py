@@ -709,6 +709,18 @@ def main():
             f"serve ref: {serve_ref['artifact']} "
             f"sessions={serve_ref['sessions']}"
         )
+    # SOUND cross-reference (the soundness-analyzer round, same
+    # best-effort contract): the newest reduction soundness
+    # certificate — whether every declared spec/mask the (sym) lanes
+    # run was certified at the referenced SHA (analysis/soundness.py).
+    from stateright_tpu.artifacts import latest_soundness_summary
+
+    sound_ref = latest_soundness_summary()
+    if sound_ref is not None:
+        _stderr(
+            f"sound ref: {sound_ref['artifact']} "
+            f"clean={sound_ref['clean']}"
+        )
 
     # Compile-cache ledger (round 14, checkers/tpu.py): per-lane
     # DELTAS of the process-cumulative compile-or-fetch counters, so
@@ -849,9 +861,27 @@ def main():
                 "canonical_unique": unique,
                 "reduction_ratio": round(raw / unique, 2),
             }
+            # certificate provenance (analysis/soundness.py): the
+            # pending BENCH_r06 chip outing must carry proof that
+            # the reductions it prices were certified — re-run the
+            # analyzer uncached so the wall-time is real, not a
+            # memo hit from the spawn gate.
+            from stateright_tpu.analysis.soundness import (
+                certify_encoding,
+            )
+
+            cert = certify_encoding(checker.encoded, use_cache=False)
+            detail[name]["symmetry"]["soundness_certified"] = (
+                cert.certified
+            )
+            detail[name]["symmetry"]["soundness_analyzer_sec"] = (
+                round(cert.analyzer_sec, 4)
+            )
             _stderr(
                 f"     symmetry: {raw:,} raw -> {unique:,} canonical "
-                f"(x{raw / unique:.1f} reduction)"
+                f"(x{raw / unique:.1f} reduction); soundness "
+                f"{'certified' if cert.certified else 'REFUSED'} "
+                f"in {cert.analyzer_sec:.2f}s"
             )
             if rm == 5:
                 o_unique, o_sec = bench_sym_host_oracle(rm)
@@ -998,6 +1028,8 @@ def main():
                            if ckpt_ref is not None else {}),
                         **({"serve": serve_ref}
                            if serve_ref is not None else {}),
+                        **({"soundness": sound_ref}
+                           if sound_ref is not None else {}),
                     }
                 ),
                 "detail": detail,

@@ -123,6 +123,12 @@ def _hand_2pc():
     return TwoPhaseSysEncoded(4)
 
 
+def _hand_register():
+    from ..models.nclient_register_tpu import NClientRegEncoded
+
+    return NClientRegEncoded(4)
+
+
 def _compiled_abd_ordered():
     from ..actor import Network
     from ..models.linearizable_register import AbdModelCfg, abd_model
@@ -196,6 +202,12 @@ ENCODINGS: tuple = (
         max_step_gathers=0,
     ),
     EncodingSpec(
+        name="hand-register-n4",
+        kind="hand",
+        factory=_hand_register,
+        max_step_gathers=0,
+    ),
+    EncodingSpec(
         name="compiled-abd-ordered-2c2s",
         kind="compiled",
         factory=_compiled_abd_ordered,
@@ -219,6 +231,28 @@ ENCODINGS: tuple = (
         factory=_compiled_2pc_actors,
         max_step_gathers=4,
     ),
+)
+
+
+def _soundness_2pc(count):
+    from ..models.two_phase_commit_tpu import TwoPhaseSysEncoded
+
+    return TwoPhaseSysEncoded(count if count is not None else 4)
+
+
+def _soundness_register(count):
+    from ..models.nclient_register_tpu import NClientRegEncoded
+
+    return NClientRegEncoded(count if count is not None else 4)
+
+
+#: the ``analyze soundness`` targets: every reduction-declaring
+#: encoding the soundness analyzer certifies into ``SOUND_r*.json``
+#: (analysis/soundness.py). Each factory takes the optional CLI
+#: member count (rm_count / n_clients; None = the registry default).
+SOUNDNESS_TARGETS: tuple = (
+    ("2pc", _soundness_2pc),
+    ("register", _soundness_register),
 )
 
 

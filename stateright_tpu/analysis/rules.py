@@ -851,6 +851,99 @@ COMMS_RULES: tuple = (
 )
 
 
+def _soundness_rule(obligation: str):
+    """Lazy delegate for the soundness rule family: these rules run
+    over ENCODINGS (the declared reduction specs), not traced paths —
+    the analyzer drives them through ``certify_encoding``
+    (analysis/soundness.py) and this registry entry filters its
+    Finding stream to one obligation, so ``run_rules``-style drivers
+    and ``analyze soundness`` report through the same Rule names."""
+
+    def run(ctx, sites):
+        enc = getattr(ctx, "encoded", None)
+        if enc is None:
+            return []
+        from .soundness import certify_encoding
+
+        return [
+            f for f in certify_encoding(enc).obligations
+            if f.rule == obligation
+        ]
+
+    return run
+
+
+#: the reduction soundness obligation family (analysis/soundness.py,
+#: certificate SOUND_r*.json): per-encoding STATIC proofs the engine
+#: gates consult before trusting a declared DeviceRewriteSpec or
+#: ample mask. Registered here so the obligation names and
+#: descriptions live in the same registry as the codegen rules — the
+#: refusal messages (checkers/common.soundness_refusal) and the
+#: fixture tests key on these names.
+SOUNDNESS_RULES: tuple = (
+    Rule(
+        name="group-closure",
+        description=(
+            "the rewrite set is a permutation-group action on the "
+            "limb layout: structural bounds plus cross-field member "
+            "bit disjointness (bijective relabeling)"
+        ),
+        run=_soundness_rule("group-closure"),
+    ),
+    Rule(
+        name="orbit-structure",
+        description=(
+            "canonicalization is idempotent, member-permuting "
+            "(tuple multiset preserved, non-group bits untouched), "
+            "and keyed on the FULL per-member tuple"
+        ),
+        run=_soundness_rule("orbit-structure"),
+    ),
+    Rule(
+        name="fingerprint-invariance",
+        description=(
+            "the canonical form — hence the fingerprint fold — is "
+            "invariant under every generator transposition"
+        ),
+        run=_soundness_rule("fingerprint-invariance"),
+    ),
+    Rule(
+        name="property-invariance",
+        description=(
+            "every registered Property predicate is group-invariant "
+            "(member-uniform static bit footprint + semantic "
+            "battery agreement)"
+        ),
+        run=_soundness_rule("property-invariance"),
+    ),
+    Rule(
+        name="transition-equivariance",
+        description=(
+            "the successor set commutes with the group: "
+            "multiset{tau.succ(v)} == multiset{succ(tau.v)}"
+        ),
+        run=_soundness_rule("transition-equivariance"),
+    ),
+    Rule(
+        name="ample-enabledness",
+        description=(
+            "enabledness preservation: a dropped slot's guard "
+            "implies some kept slot's guard over the footprint cone"
+        ),
+        run=_soundness_rule("ample-enabledness"),
+    ),
+    Rule(
+        name="ample-non-suppression",
+        description=(
+            "no property-relevant dropped transition lacks a "
+            "symmetric kept image (guard and successor agree under "
+            "a group element)"
+        ),
+        run=_soundness_rule("ample-non-suppression"),
+    ),
+)
+
+
 #: the registry — ``tools/lint_kernels.py`` and ``pytest -m lint``
 #: both run exactly this list.
 RULES: tuple = (

@@ -33,6 +33,12 @@ tools/serve_report.py over a service trace — stateright_tpu/serve.py)
 follows the same derived-from-a-TRACE pattern: own sequence
 (``SERVE_r01`` first), cross-referenced BY bench provenance via
 :func:`latest_serve_summary`.
+``SOUND_r*.json`` (reduction soundness certificates,
+``stateright_tpu analyze soundness`` — analysis/soundness.py)
+follows COMM's own-sequence pattern: the certificate is the static
+proof state of every declared reduction spec at one commit,
+consulted at spawn by the engine gates and cross-referenced BY bench
+``(sym)`` lane detail via :func:`latest_soundness_summary`.
 """
 
 from __future__ import annotations
@@ -240,6 +246,52 @@ def latest_comms_summary(root: str | None = None) -> dict | None:
             else None
         ),
         "fixtures": dict(sorted(fixtures.items())),
+    }
+
+
+def latest_soundness_summary(root: str | None = None) -> dict | None:
+    """Cross-reference block for the newest ``SOUND_r*.json``
+    (reduction soundness certificates, analysis/soundness.py):
+    artifact name, clean flag (every checked spec certified), the
+    producing SHA, and the per-spec status map. Best effort with the
+    :func:`latest_lint_summary` guarantees: a missing, hand-edited,
+    or truncated artifact degrades to None, never aborts the
+    caller."""
+    path = latest_artifact("SOUND", root)
+    if path is None:
+        return None
+    try:
+        with open(path) as fh:
+            report = json.load(fh)
+        specs_block = report.get("specs")
+        if not isinstance(specs_block, dict) or not specs_block:
+            return None
+        specs = {
+            str(name): str(s["status"])
+            for name, s in specs_block.items()
+            if isinstance(s, dict) and "status" in s
+        }
+        prov = report.get("provenance")
+        sound_sha = (prov.get("git_sha")
+                     if isinstance(prov, dict) else None)
+    except (OSError, ValueError, TypeError, AttributeError, KeyError):
+        return None
+    if not specs:
+        return None
+    repo = repo_root() if root is None else root
+    head = _git_sha(repo)
+    dirty = _git_dirty(repo)
+    return {
+        "artifact": os.path.basename(path),
+        "clean": bool(report.get("clean")),
+        "git_sha": sound_sha,
+        "sha_matches_head": (
+            sound_sha == head
+            if sound_sha is not None and head is not None
+            and dirty is False
+            else None
+        ),
+        "specs": dict(sorted(specs.items())),
     }
 
 

@@ -290,6 +290,9 @@ def batch_eligible(checker) -> tuple:
     # spaces in one visited set. The fused engine is the hash wave
     # engine, which has no canonicalization pass at all — refuse both
     # modes outright rather than minting a class nobody can serve.
+    # (Any checker that reaches here with sym_spec set already passed
+    # the soundness-certificate gate at spawn, analysis/soundness.py —
+    # batching never has to re-litigate reduction soundness.)
     if getattr(checker, "sym_spec", None) is not None:
         return None, "symmetry-reduced sessions cannot fuse (canonical" \
             " keys are a different compatibility class)"

@@ -51,6 +51,16 @@ class CheckerBuilder:
         self._target_max_depth: Optional[int] = None
         self._threads: int = 1
         self._visitor: Optional[CheckerVisitor] = None
+        self._unsound_ok: bool = False
+
+    def unsound_ok(self) -> "CheckerBuilder":
+        """Waive the reduction soundness-certificate gates
+        (analysis/soundness.py): a declared ``DeviceRewriteSpec`` or
+        ample mask that FAILS its obligations runs anyway instead of
+        refusing at spawn. Research escape hatch (``--unsound-ok`` on
+        the CLI) — the run's counts carry no soundness guarantee."""
+        self._unsound_ok = True
+        return self
 
     def symmetry(self) -> "CheckerBuilder":
         """Enable symmetry reduction via the state's own ``representative``

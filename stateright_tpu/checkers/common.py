@@ -13,6 +13,20 @@ from typing import Optional
 from ..path import Path
 
 
+def reduction_refusal(reduction: str, engine: str,
+                      parts: list[str]) -> ValueError:
+    """The shared formatter behind EVERY reduction refusal.
+
+    Both the round-20 capability refusals (:func:`symmetry_refusal`)
+    and the soundness-certificate refusals
+    (:func:`soundness_refusal`) format through this one function, so
+    serve-mode sessions and CLI runs print identical text: a
+    ``"{reduction} reduction: {engine} cannot honor it"`` head
+    followed by the caller's detail parts, joined by ``"; "``."""
+    head = [f"{reduction} reduction: {engine} cannot honor it"]
+    return ValueError("; ".join(head + list(parts)))
+
+
 def symmetry_refusal(engine: str,
                      missing: Optional[str] = None) -> ValueError:
     """The ONE symmetry-refusal error every checker raises.
@@ -24,7 +38,7 @@ def symmetry_refusal(engine: str,
     the same channel. ``engine`` names the refusing spawn;
     ``missing`` names the absent capability, if the engine could
     otherwise honor the reduction."""
-    parts = [f"symmetry reduction: {engine} cannot honor it"]
+    parts = []
     if missing:
         parts.append(f"missing capability: {missing}")
     parts.append(
@@ -33,7 +47,27 @@ def symmetry_refusal(engine: str,
         "the TPU sort-merge engines when the encoding declares "
         "device_rewrite_spec() (stateright_tpu/ops/canonical.py)"
     )
-    return ValueError("; ".join(parts))
+    return reduction_refusal("symmetry", engine, parts)
+
+
+def soundness_refusal(engine: str, reduction: str, obligation: str,
+                      detail: str) -> ValueError:
+    """The certificate refusal: a declared spec/mask FAILED a
+    soundness obligation (stateright_tpu/analysis/soundness.py), so
+    the engine refuses to trust it.
+
+    Unlike :func:`symmetry_refusal` (a capability gap), this names
+    the exact obligation that could not be proven — the user's spec
+    is the problem, not the engine. ``reduction`` is ``"symmetry"``
+    or ``"ample-set"``; ``obligation`` is the analyzer rule name."""
+    parts = [
+        f"soundness certificate refused: obligation {obligation!r} "
+        f"failed — {detail}",
+        "pass --unsound-ok (CheckerBuilder.unsound_ok()) to run the "
+        "uncertified reduction anyway "
+        "(stateright_tpu/analysis/soundness.py)",
+    ]
+    return reduction_refusal(reduction, engine, parts)
 
 
 class ParentTraceMixin:

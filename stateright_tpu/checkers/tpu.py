@@ -480,6 +480,10 @@ class TpuBfsChecker(Checker):
         #: canonical fingerprints while the frontier keeps concrete
         #: states. None = no reduction.
         self.sym_spec = None
+        #: waive the soundness-certificate gates (--unsound-ok /
+        #: CheckerBuilder.unsound_ok()): an UNCERTIFIED spec or mask
+        #: runs anyway — research escape hatch, never the default.
+        self.unsound_ok = bool(getattr(builder, "_unsound_ok", False))
         if builder._symmetry is not None:
             from ..encoding import device_rewrite_spec
 
@@ -496,6 +500,13 @@ class TpuBfsChecker(Checker):
                         "layout of the interchangeable limb group"
                     ),
                 )
+            # the certificate gate (analysis/soundness.py): a declared
+            # spec only runs once its soundness obligations are
+            # discharged — uncertifiable specs refuse here, at spawn,
+            # with the failed obligation, unless explicitly waived.
+            from ..analysis.soundness import gate_symmetry
+
+            gate_symmetry(encoded, self._engine_name, self.unsound_ok)
             self.sym_spec = spec
         self.capacity = capacity
         #: summed across shards in sharded variants (occupancy metric).

@@ -1543,6 +1543,13 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                 f"max_actions={enc.max_actions} needs "
                 f"{mask_words(enc.max_actions)}"
             )
+        # the certificate gate (analysis/soundness.py): the declared
+        # mask only filters once enabledness-preservation and
+        # non-suppression are discharged — an uncertifiable mask
+        # refuses with the failed obligation unless --unsound-ok.
+        from ..analysis.soundness import gate_ample
+
+        gate_ample(enc, self._engine_name, self.unsound_ok)
         return np.asarray(aw, np.uint32)
 
     def _cache_extras(self) -> tuple:
@@ -1606,6 +1613,15 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
             symmetry=self.sym_spec is not None,
             ample_set=self.ample_set,
         )
+        if self.sym_spec is not None or self.ample_set:
+            # certificate provenance rides the lane config (and hence
+            # every trace's run_begin record): True/False when the
+            # analyzer ran, absent when no reduction is on.
+            from ..analysis.soundness import soundness_status
+
+            lane.update(
+                soundness_certified=soundness_status(self.encoded)
+            )
         return lane
 
     def _maybe_warn_occupancy(self, occupancy: float) -> None:

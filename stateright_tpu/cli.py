@@ -90,16 +90,21 @@ _RUNTIME = _ThreadLocalRuntime(
     checkpoint_every=None, checkpoint_path=None, resume=False,
     resume_any_sha=False, waves_per_sync=None, tier_hot_rows=None,
     degrade_on_fault=False, watchdog=None, straggler_factor=None,
-    symmetry=False, ample_set=False,
+    symmetry=False, ample_set=False, unsound_ok=False,
 )
 
 
 def _maybe_symmetry(builder):
     """``--symmetry``: arm the builder's symmetry reduction BEFORE the
     spawn (the capability refusal fires in the engine constructor,
-    checkers/common.symmetry_refusal) — device engines canonicalize
-    candidate fingerprints through the encoding's DeviceRewriteSpec
-    (ops/canonical.py)."""
+    checkers/common.symmetry_refusal, and the soundness-certificate
+    gate right after it, analysis/soundness.gate_symmetry) — device
+    engines canonicalize candidate fingerprints through the encoding's
+    DeviceRewriteSpec (ops/canonical.py). ``--unsound-ok`` is armed
+    here too: the waiver must reach the builder before the spawn-time
+    gate fires."""
+    if _RUNTIME["unsound_ok"]:
+        builder = builder.unsound_ok()
     if _RUNTIME["symmetry"]:
         return builder.symmetry()
     return builder
@@ -130,6 +135,11 @@ def _apply_runtime(checker) -> None:
                 "sparse enabled bitmap, checkers/tpu_sortmerge.py)"
             )
         checker.ample_set = True
+    if cfg["unsound_ok"] and hasattr(checker, "unsound_ok"):
+        # the ample certificate gate fires at program-build time
+        # (_resolve_ample_words), after this seam — the waiver must
+        # land on the checker, not just the builder
+        checker.unsound_ok = True
     if not (cfg["checkpoint_every"] or cfg["resume"]
             or cfg["waves_per_sync"] or cfg["tier_hot_rows"]
             or cfg["degrade_on_fault"] or cfg["watchdog"]
@@ -540,8 +550,52 @@ def _panic(sub: str, args: list[str]) -> None:
         _usage("panic")
 
 
+def _register(sub: str, args: list[str]) -> None:
+    from .models.nclient_register import NClientRegSys
+
+    n_clients = _opt(args, 0, 3)
+    sys_model = NClientRegSys(n_clients=n_clients)
+    if sub == "check":
+        print(
+            f"Checking the write-once register with {n_clients} clients."
+        )
+        _report(sys_model.checker().spawn_dfs())
+    elif sub == "check-sym":
+        print(
+            f"Checking the write-once register with {n_clients} clients "
+            "using symmetry reduction."
+        )
+        _report(sys_model.checker().symmetry().spawn_dfs())
+    elif sub == "check-tpu":
+        print(
+            f"Checking the write-once register with {n_clients} clients "
+            "on the TPU wave engine."
+        )
+        # raw space is 1 + 2n*3^(n-1) states (models/nclient_register)
+        # — tiny; snug pow-2 capacity over the closed form
+        raw = 1 + 2 * n_clients * 3 ** max(0, n_clients - 1)
+        capacity = max(1 << 10, 1 << (raw - 1).bit_length())
+        _report(
+            _maybe_symmetry(sys_model.checker()).spawn_tpu_sortmerge(
+                capacity=capacity,
+                frontier_capacity=max(256, capacity // 4),
+                cand_capacity="auto",
+            )
+        )
+    elif sub == "explore":
+        address = _opt(args, 1, "localhost:3000", parse=str)
+        print(
+            f"Exploring state space for the write-once register with "
+            f"{n_clients} clients on {address}."
+        )
+        sys_model.checker().serve(address)
+    else:
+        _usage("register")
+
+
 _MODELS = {
     "2pc": (_2pc, ["check", "check-sym", "check-tpu", "explore"]),
+    "register": (_register, ["check", "check-sym", "check-tpu", "explore"]),
     "paxos": (_paxos, ["check", "check-tpu", "explore", "spawn"]),
     "increment": (_increment, ["check", "check-sym", "check-tpu", "explore"]),
     "increment-lock": (_increment_lock, ["check", "check-sym", "check-tpu", "explore"]),
@@ -617,12 +671,22 @@ def _usage(model: str | None = None) -> None:
         "classifier)"
     )
     print(
-        "       --symmetry on 2pc check-tpu runs the device symmetry "
-        "reduction (canonical-form fingerprints before dedup, "
-        "ops/canonical.py; 2pc rm=5: 8,832 -> 314 states); "
+        "       --symmetry on 2pc/register check-tpu runs the device "
+        "symmetry reduction (canonical-form fingerprints before "
+        "dedup, ops/canonical.py; 2pc rm=5: 8,832 -> 314 states); "
         "--ample-set on sort-merge check-tpu lanes ANDs the "
         "encoding's partial-order ample mask into the sparse "
-        "enabled-bits pass (fewer interleavings, same verdicts)"
+        "enabled-bits pass (fewer interleavings, same verdicts). "
+        "Both consult the reduction soundness certificate "
+        "(analysis/soundness.py): uncertifiable specs refuse at "
+        "spawn with the failed obligation; --unsound-ok waives the "
+        "gate (no soundness guarantee)"
+    )
+    print(
+        "       `analyze soundness [MODEL] [COUNT] [--no-artifact]` "
+        "runs the reduction soundness analyzer over the registered "
+        "targets (2pc, register) and writes the SOUND_r*.json "
+        "certificate the engine gates consult"
     )
     print(
         "       `serve` runs the resident multi-tenant checking "
@@ -743,6 +807,11 @@ def _pop_runtime_flags(argv: list[str]) -> list[str]:
             # partial-order-reduction enabled-bits filter: AND the
             # encoding's ample mask into the sparse bitmap pass
             _RUNTIME["ample_set"] = True
+        elif a == "--unsound-ok":
+            # waive the reduction soundness-certificate gates
+            # (analysis/soundness.py): an UNCERTIFIED spec or mask
+            # runs anyway — the counts carry no soundness guarantee
+            _RUNTIME["unsound_ok"] = True
         elif a.startswith("--straggler-factor="):
             val = a.split("=", 1)[1]
             f = float(val)
@@ -768,6 +837,7 @@ def main(argv: list[str] | None = None) -> None:
         resume_any_sha=False, waves_per_sync=None,
         tier_hot_rows=None, degrade_on_fault=False, watchdog=None,
         straggler_factor=None, symmetry=False, ample_set=False,
+        unsound_ok=False,
     )
     # resident-service lanes (ROADMAP direction 4, serve.py): the
     # daemon, and the client mode that ships a lane to one
@@ -780,6 +850,12 @@ def main(argv: list[str] | None = None) -> None:
         from . import serve
 
         raise SystemExit(serve.client_main(connect, argv))
+    # the static-analysis lanes: `analyze soundness [MODEL]` runs the
+    # reduction soundness analyzer and writes SOUND_r*.json
+    if argv and argv[0] == "analyze":
+        from .analysis.soundness import analyze_main
+
+        raise SystemExit(analyze_main(argv[1:]))
     trace_level, argv = _pop_trace_flag(argv)
     argv = _pop_runtime_flags(argv)
     if not argv or argv[0] not in _MODELS:

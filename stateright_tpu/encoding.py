@@ -158,7 +158,11 @@ class SymmetricEncodedModel(Protocol):
     The spec MUST be a perfect canonicalizer (constant on orbits):
     sort on the FULL per-member tuple, not a subset — see the
     symmetry.py module docstring for why a partial sort key makes the
-    visited count search-order-dependent."""
+    visited count search-order-dependent. A declared spec is not
+    taken on faith: the reduction soundness analyzer
+    (analysis/soundness.py) proves its obligations at spawn and the
+    engines refuse an uncertifiable spec with the failed obligation
+    (``--unsound-ok`` waives)."""
 
     def device_rewrite_spec(self):
         """``DeviceRewriteSpec`` for this encoding's interchangeable
@@ -179,8 +183,13 @@ def ample_mask_host(enc):
     (``uint32[ceil(max_actions/32)]``, ops/bitmask.py word layout), or
     None when it declares none. The sparse engines AND the words into
     every row's enabled bits — a static partial-order-reduction
-    filter; the encoding owns the soundness argument for the slots it
-    drops (see models/two_phase_commit_tpu.py)."""
+    filter. Since round 21 the soundness argument for the dropped
+    slots is CHECKED, not trusted: the analyzer
+    (analysis/soundness.py) proves enabledness-preservation and
+    non-suppression per mask, and the engines refuse an
+    uncertifiable mask at program-build time (see
+    models/two_phase_commit_tpu.py for the prose version the
+    analyzer replaced)."""
     fn = getattr(enc, "ample_mask_host", None)
     if not callable(fn):
         return None

@@ -1,0 +1,245 @@
+"""Vectorized N-client write-once register.
+
+Encodes :class:`~stateright_tpu.models.nclient_register.NClientRegSys`
+as packed fixed-width uint32 vectors, the second encoding to declare a
+``DeviceRewriteSpec`` — and the first whose symmetry/reduction
+soundness is established by the static analyzer
+(stateright_tpu/analysis/soundness.py) instead of a hand argument.
+
+Packed layout (``width = 2`` for up to 8 clients):
+  lane 0: bit 0 — the write-once register
+  lane 1: per-client 4-bit block at shift ``4c``:
+          bits 0-1 pc (0=idle 1=wrote 2=done), bit 2 won, bit 3 rv
+
+Actions (``max_actions = 2n``): slot ``2c`` = write(c), slot
+``2c + 1`` = read(c). Every slot guard is a 2-bit field compare, so
+the sparse dispatch path assembles the packed enabled words from
+``2n`` condition-gated host class masks — scalar extracts only, no
+gather, no dense ``bool[K]`` (the 2pc idiom, ops/bitmask.py).
+
+The client blocks are uniformly strided with every bit in the sort
+key, so ``device_rewrite_spec()`` is a full-tuple (perfect)
+canonicalizer; the host oracle is
+``NClientRegState.representative_full``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..encoding import EncodedModelBase
+from .nclient_register import NClientRegState, NClientRegSys
+
+_IDLE, _WROTE, _DONE = 0, 1, 2
+
+
+class NClientRegEncoded(EncodedModelBase):
+    def __init__(self, n_clients: int):
+        if n_clients > 8:
+            raise ValueError(
+                "packed register encoding supports up to 8 clients "
+                f"(got {n_clients})"
+            )
+        self.n_clients = n_clients
+        self.width = 2
+        self.max_actions = 2 * n_clients
+        self.host_model = NClientRegSys(n_clients=n_clients)
+        #: each client enables at most ONE of its two slots (write
+        #: xor read, by pc), so a row peaks at n enabled slots.
+        self.pair_width_hint = max(1, n_clients)
+
+    def cache_key(self):
+        """Compiled-wave sharing identity (see checkers/tpu.py)."""
+        return self.n_clients
+
+    # -- device symmetry -------------------------------------------------
+
+    def device_rewrite_spec(self):
+        """Client permutation symmetry: one strided 4-bit field on
+        lane 1 holding the FULL per-client tuple (pc, won, rv), all
+        of it in the sort key — a perfect canonicalizer, certified by
+        ``stateright_tpu analyze soundness register`` (SOUND_r*)."""
+        if self.n_clients < 2:
+            return None
+        from ..ops.canonical import DeviceRewriteSpec, MemberField
+
+        return DeviceRewriteSpec(
+            n_members=self.n_clients,
+            fields=(
+                MemberField(
+                    lane=1, shift=0, stride=4, width=4, sort_key=True
+                ),
+            ),
+        )
+
+    # -- host side -------------------------------------------------------
+
+    def encode(self, state: NClientRegState) -> np.ndarray:
+        lane1 = 0
+        for c, (pc, won, rv) in enumerate(state.clients):
+            lane1 |= (pc | (won << 2) | (rv << 3)) << (4 * c)
+        return np.array([state.reg, lane1], dtype=np.uint32)
+
+    def decode(self, vec: np.ndarray) -> NClientRegState:
+        vec = np.asarray(vec)
+        lane0, lane1 = int(vec[0]), int(vec[1])
+        clients = []
+        for c in range(self.n_clients):
+            block = (lane1 >> (4 * c)) & 0xF
+            clients.append((block & 3, (block >> 2) & 1, (block >> 3) & 1))
+        return NClientRegState(clients=tuple(clients), reg=lane0 & 1)
+
+    def init_vecs(self) -> np.ndarray:
+        return np.stack(
+            [self.encode(s) for s in self.host_model.init_states()]
+        )
+
+    # -- device side -----------------------------------------------------
+
+    def step_vec(self, vec):
+        """uint32[2] -> (uint32[K, 2], bool[K]); branchless bitfield
+        updates mirroring NClientRegSys.next_state()."""
+        import jax.numpy as jnp
+
+        lane0, lane1 = vec[0], vec[1]
+        reg = lane0 & jnp.uint32(1)
+        won_new = (jnp.uint32(1) - reg) & jnp.uint32(1)
+
+        succs = []
+        valids = []
+        for c in range(self.n_clients):
+            sh = 4 * c
+            block = (lane1 >> jnp.uint32(sh)) & jnp.uint32(0xF)
+            pc = block & jnp.uint32(3)
+            clear = lane1 & ~jnp.uint32(0xF << sh)
+
+            # write(c): pc 0→1, won := (reg was 0), register set.
+            wr_block = (
+                (block & ~jnp.uint32(0x7))
+                | jnp.uint32(_WROTE)
+                | (won_new << jnp.uint32(2))
+            )
+            succs.append(
+                jnp.stack([lane0 | jnp.uint32(1),
+                           clear | (wr_block << jnp.uint32(sh))])
+            )
+            valids.append(pc == _IDLE)
+
+            # read(c): pc 1→2, rv := reg, won kept.
+            rd_block = (
+                (block & ~jnp.uint32(0xB))
+                | jnp.uint32(_DONE)
+                | (reg << jnp.uint32(3))
+            )
+            succs.append(
+                jnp.stack([lane0,
+                           clear | (rd_block << jnp.uint32(sh))])
+            )
+            valids.append(pc == _WROTE)
+
+        return jnp.stack(succs), jnp.stack(valids)
+
+    # -- sparse action dispatch (SparseEncodedModel) ----------------------
+
+    def _bits_word_tables(self) -> dict:
+        """Host-constant per-slot masks (the 2pc idiom): slot ``2c``
+        gated on pc==idle, slot ``2c+1`` on pc==wrote."""
+        if hasattr(self, "_bw"):
+            return self._bw
+        from ..ops.bitmask import slot_mask_host
+
+        K = self.max_actions
+        self._bw = dict(
+            write={
+                c: slot_mask_host(K, [2 * c])
+                for c in range(self.n_clients)
+            },
+            read={
+                c: slot_mask_host(K, [2 * c + 1])
+                for c in range(self.n_clients)
+            },
+        )
+        return self._bw
+
+    def enabled_bits_vec(self, vec):
+        """``uint32[ceil(K/32)]`` packed enabled mask from ``2n``
+        condition-gated host class masks — scalar extracts + [L]-word
+        selects, gather-free."""
+        import jax.numpy as jnp
+
+        from ..ops.bitmask import mask_words, or_class_words
+
+        t = self._bits_word_tables()
+        lane1 = vec[1]
+        classes = []
+        for c in range(self.n_clients):
+            pc = (lane1 >> jnp.uint32(4 * c)) & jnp.uint32(3)
+            classes.append((pc == _IDLE, t["write"][c]))
+            classes.append((pc == _WROTE, t["read"][c]))
+        return or_class_words(
+            jnp, classes, mask_words(self.max_actions)
+        )
+
+    def enabled_mask_vec(self, vec):
+        """bool[K]: the dense view of :meth:`enabled_bits_vec` (the
+        words are the source of truth, so the two cannot drift)."""
+        import jax.numpy as jnp
+
+        from ..ops.bitmask import words_to_mask
+
+        return words_to_mask(
+            jnp, self.enabled_bits_vec(vec), self.max_actions
+        )
+
+    def step_slot_vec(self, vec, slot):
+        """Successor for one enabled (state, slot) pair — branchless
+        selects over the slot arithmetic (``c = slot >> 1``, action
+        kind ``slot & 1``), 1-D lane ops only, zero gathers."""
+        import jax.numpy as jnp
+
+        lane0, lane1 = vec[0], vec[1]
+        slot = slot.astype(jnp.uint32)
+        c = slot >> jnp.uint32(1)
+        j = slot & jnp.uint32(1)
+        sh = jnp.uint32(4) * c
+
+        reg = lane0 & jnp.uint32(1)
+        won_new = (jnp.uint32(1) - reg) & jnp.uint32(1)
+        block = (lane1 >> sh) & jnp.uint32(0xF)
+        clear = lane1 & ~(jnp.uint32(0xF) << sh)
+
+        wr_block = (
+            (block & ~jnp.uint32(0x7))
+            | jnp.uint32(_WROTE)
+            | (won_new << jnp.uint32(2))
+        )
+        rd_block = (
+            (block & ~jnp.uint32(0xB))
+            | jnp.uint32(_DONE)
+            | (reg << jnp.uint32(3))
+        )
+        nb = jnp.where(j == 0, wr_block, rd_block)
+        l0 = jnp.where(j == 0, lane0 | jnp.uint32(1), lane0)
+        l1 = clear | (nb << sh)
+        return jnp.stack([l0, l1])
+
+    def property_conditions_vec(self, vec):
+        """[sometimes all done, sometimes lost write, always at most
+        one winner, always reads see the write] — order matches
+        NClientRegSys.properties(). Every predicate is a reduction
+        over the uniformly extracted per-client blocks, so the
+        soundness analyzer proves group invariance statically."""
+        import jax.numpy as jnp
+
+        n = self.n_clients
+        blocks = (
+            vec[1] >> (4 * jnp.arange(n, dtype=jnp.uint32))
+        ) & jnp.uint32(0xF)
+        pc = blocks & jnp.uint32(3)
+        won = (blocks >> jnp.uint32(2)) & jnp.uint32(1)
+        rv = (blocks >> jnp.uint32(3)) & jnp.uint32(1)
+        all_done = jnp.all(pc == _DONE)
+        lost = jnp.any((pc != _IDLE) & (won == 0))
+        at_most_one = jnp.sum(won) <= jnp.uint32(1)
+        reads_ok = jnp.all((pc != _DONE) | (rv == 1))
+        return jnp.stack([all_done, lost, at_most_one, reads_ok])

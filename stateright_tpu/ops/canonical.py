@@ -30,6 +30,15 @@ ops/fingerprint.py — host path reconstruction canonicalizes encoded
 rows with BIT-IDENTICAL math before fingerprinting, so the parent-log
 keys the device wrote and the keys the host replay computes can never
 drift.
+
+:func:`validate_spec` checks the spec's STRUCTURAL invariants (field
+bounds, key-bit budget). The SEMANTIC soundness of a declared spec —
+that the rewrite set really is a group action, that properties and
+the fingerprint are invariant under it — is the reduction soundness
+analyzer's job (stateright_tpu/analysis/soundness.py): the engines
+run it at spawn and refuse uncertifiable specs, so ``validate_spec``
+passing is necessary but deliberately NOT sufficient to arm the
+reduction.
 """
 
 from __future__ import annotations

@@ -117,7 +117,11 @@ class ShardedSortMergeTpuBfsChecker(SortMergeTpuBfsChecker):
     wave engine over a ``jax.sharding.Mesh``. Inherits the result /
     reconstruction surface (including the clamped host fingerprints)
     from the single-chip sort-merge engine; the device programs and the
-    parent-log layout differ."""
+    parent-log layout differ. It also inherits both reduction
+    soundness-certificate gates (analysis/soundness.py): the symmetry
+    gate fires in the base ``TpuBfsChecker.__init__`` and the ample
+    gate in the base ``_resolve_ample_words``, so a sharded run can
+    only arm ``--symmetry``/``--ample-set`` against a certified spec."""
 
     _engine_name = "spawn_tpu_sharded_sortmerge"
 

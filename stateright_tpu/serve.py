@@ -87,6 +87,13 @@ class AdmissionRefused(RuntimeError):
 #: tracer the per-session tracers replace, --checkpoint/--resume
 #: would race the warm-start retention on the same engine seams —
 #: so telemetry and durability are the SERVICE's job, refused loudly.
+#: (--symmetry/--ample-set/--unsound-ok are runtime flags too, so a
+#: session can never smuggle an uncertified reduction past the
+#: soundness-certificate gate, analysis/soundness.py: any reduction a
+#: service checker runs was armed in-process through CheckerBuilder,
+#: where the spawn gate fires — both refusal families format through
+#: checkers/common.reduction_refusal, so service sessions and CLI
+#: runs print identical text.)
 _FLAG_REFUSAL = (
     "service sessions take plain lane argv (e.g. ['paxos', "
     "'check-tpu', '2']); runtime flags are process-global and are "

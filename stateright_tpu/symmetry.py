@@ -31,6 +31,13 @@ visits 508). The device spec therefore sorts on the FULL per-member
 tuple, a perfect canonicalizer whose count (314 for 2pc rm=5) is
 order-independent and agrees between the wave BFS and a host DFS
 given the matching ``representative_full`` oracle.
+
+Since round 21 the full-tuple requirement is not prose: the reduction
+soundness analyzer (stateright_tpu/analysis/soundness.py) proves it
+statically per declared spec — a partial sort key fails its
+``orbit-structure`` obligation and the engines refuse the spec at
+spawn (the certificate gate), so the 665-style order-dependence
+cannot re-enter through a new encoding.
 """
 
 from __future__ import annotations

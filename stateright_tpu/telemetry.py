@@ -1953,6 +1953,20 @@ def diff_traces(
                    else run_b)
 
     divergences = []
+    # Certificate-status flip (analysis/soundness.py): the run_begin
+    # lane config carries soundness_certified on reduction runs. A
+    # certified↔refused flip between two traces of one workload means
+    # the reductions being compared do NOT carry the same soundness
+    # guarantee — that is a divergence, not a timing delta.
+    cert_a = ((va["begin"] or {}).get("lane")
+              or {}).get("soundness_certified")
+    cert_b = ((vb["begin"] or {}).get("lane")
+              or {}).get("soundness_certified")
+    if cert_a is not None and cert_b is not None and cert_a != cert_b:
+        divergences.append(
+            dict(wave=None, field="soundness_certified",
+                 a=cert_a, b=cert_b)
+        )
     wa = {w["wave"]: w for w in va["waves"]}
     wb = {w["wave"]: w for w in vb["waves"]}
     # Resume-aware alignment (the durability layer): a RESUMED run's

@@ -49,6 +49,14 @@ artifact and this tool is the comparison —
   A side with no tier events skips the block: a forced-spill run
   diffs against the all-resident baseline on the wave counters
   alone — which is exactly the tiered-dedup exactness proof.
+* **certificate alignment** (round 21) — reduction runs carry
+  ``soundness_certified`` in the run_begin lane config (the
+  soundness analyzer's verdict, analysis/soundness.py). A
+  certified ↔ refused flip between the two traces is a DIVERGENCE
+  (field ``soundness_certified``, wave ``null``): the compared
+  reductions do not carry the same soundness guarantee, so the runs
+  are not an A/B of one workload. Sides without the field (no
+  reduction on) skip the block.
 * **regression threshold** — exit nonzero when any phase at least
   ``--min-sec`` long on the A side grew by more than ``--threshold``
   (relative), or on any wave divergence.

@@ -709,6 +709,19 @@ def main():
             f"serve ref: {serve_ref['artifact']} "
             f"sessions={serve_ref['sessions']}"
         )
+    # SLO cross-reference (the live-metrics round, same best-effort
+    # contract): the newest service-level-objective gate evaluation —
+    # whether the sustained-load p50/p99/refusal/queue-wait/cache-hit
+    # objectives held at the referenced SHA (tools/slo_report.py,
+    # stateright_tpu/metrics.py).
+    from stateright_tpu.artifacts import latest_slo_summary
+
+    slo_ref = latest_slo_summary()
+    if slo_ref is not None:
+        _stderr(
+            f"slo ref: {slo_ref['artifact']} "
+            f"ok={slo_ref['ok']}"
+        )
     # SOUND cross-reference (the soundness-analyzer round, same
     # best-effort contract): the newest reduction soundness
     # certificate — whether every declared spec/mask the (sym) lanes
@@ -1028,6 +1041,8 @@ def main():
                            if ckpt_ref is not None else {}),
                         **({"serve": serve_ref}
                            if serve_ref is not None else {}),
+                        **({"slo": slo_ref}
+                           if slo_ref is not None else {}),
                         **({"soundness": sound_ref}
                            if sound_ref is not None else {}),
                     }

@@ -39,6 +39,12 @@ follows COMM's own-sequence pattern: the certificate is the static
 proof state of every declared reduction spec at one commit,
 consulted at spawn by the engine gates and cross-referenced BY bench
 ``(sym)`` lane detail via :func:`latest_soundness_summary`.
+``SLO_r*.json`` (service-level-objective gate evaluations,
+stateright_tpu/metrics.py ``write_slo_artifact`` via
+tools/slo_report.py or the sustained tools/serve_loadtest.py run)
+follows the same own-sequence pattern: one declarative-spec
+evaluation over a load test or rollup, cross-referenced BY bench
+provenance via :func:`latest_slo_summary`.
 """
 
 from __future__ import annotations
@@ -412,6 +418,52 @@ def latest_serve_summary(root: str | None = None) -> dict | None:
         "sessions": len(sessions),
         "warm_vs_cold": warm_block,
         "batching": batch_block,
+    }
+
+
+def latest_slo_summary(root: str | None = None) -> dict | None:
+    """Cross-reference block for the newest ``SLO_r*.json`` (the
+    declarative service-level-objective gate evaluation,
+    stateright_tpu/metrics.py evaluate_slo via tools/slo_report.py or
+    the sustained serve_loadtest): artifact name, the producing SHA,
+    the overall verdict, and per-objective status — the direction-2(c)
+    signal-plane evidence, embedded in bench provenance beside the
+    LINT/COMM/CKPT/SERVE blocks. Best effort with the same
+    guarantees: a missing, hand-edited, or truncated artifact degrades
+    to None, never aborts the caller."""
+    path = latest_artifact("SLO", root)
+    if path is None:
+        return None
+    try:
+        with open(path) as fh:
+            report = json.load(fh)
+        evaluation = report.get("evaluation")
+        if not isinstance(evaluation, dict):
+            return None
+        objectives = {
+            o["objective"]: o["status"]
+            for o in evaluation.get("objectives") or []
+            if isinstance(o, dict)
+        }
+        prov = report.get("provenance")
+        slo_sha = (prov.get("git_sha")
+                   if isinstance(prov, dict) else None)
+    except (OSError, ValueError, TypeError, AttributeError, KeyError):
+        return None
+    repo = repo_root() if root is None else root
+    head = _git_sha(repo)
+    dirty = _git_dirty(repo)
+    return {
+        "artifact": os.path.basename(path),
+        "git_sha": slo_sha,
+        "sha_matches_head": (
+            slo_sha == head
+            if slo_sha is not None and head is not None
+            and dirty is False
+            else None
+        ),
+        "ok": bool(evaluation.get("ok")),
+        "objectives": dict(sorted(objectives.items())),
     }
 
 

@@ -727,7 +727,12 @@ class BatchGroup:
         """Replay this member's lane view of the fused run into the
         member checker — on the member's OWN thread, under the
         member's own thread-scoped tracer, so the session trace holds
-        only this session's events (zero cross-session bleed)."""
+        only this session's events (zero cross-session bleed). The
+        ``batch`` event emitted here is also what the tracer→metrics
+        bridge (stateright_tpu/metrics.py ``bridge_events``) folds
+        into ``stpu_batched_sessions_total`` and the
+        ``stpu_batch_occupancy`` histogram — the live fused-group-size
+        signal on ``GET /.metrics``."""
         from . import telemetry
 
         fused = self.fused

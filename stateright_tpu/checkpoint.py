@@ -315,7 +315,9 @@ def write_snapshot(checker, carry, path: str, *, chunk: int,
     at the existing per-chunk sync (checkers/tpu.py) — the stats
     readback already blocked, so the carry download adds transfer, not
     a sync point. Returns the manifest; emits a ``checkpoint``
-    telemetry event.
+    telemetry event (which the tracer→metrics bridge folds into
+    ``stpu_checkpoints_total`` / ``stpu_checkpoint_bytes_total`` —
+    snapshot cadence and size are live signals on ``GET /.metrics``).
 
     ``tier`` (tiered-visited-set runs, stateright_tpu/tier.py) is the
     engine's :class:`~stateright_tpu.tier.ColdStore`: its sorted

@@ -631,7 +631,8 @@ def _usage(model: str | None = None) -> None:
             "[--explore=MODEL[,COUNT]] [--program-budget-bytes=N] "
             "[--device-budget-bytes=N] [--no-warm-start] "
             "[--batch-sessions[=N]] [--batch-window-sec=S] "
-            "[--snapshot-budget-bytes=N]"
+            "[--snapshot-budget-bytes=N] [--metrics-interval=N "
+            "[--metrics-path=FILE]]"
         )
     print(f"NETWORK: {' | '.join(Network.names())}")
     print(
@@ -703,6 +704,18 @@ def _usage(model: str | None = None) -> None:
         "--snapshot-budget-bytes=N caps the warm-start snapshot spool "
         "with byte-budget LRU eviction (snapshot_evict events)"
     )
+    print(
+        "       --metrics-interval=N [--metrics-path=FILE] on any "
+        "check lane (and on `serve`) appends one cumulative "
+        "metrics_rollup JSONL line every N seconds — the live "
+        "metrics plane (stateright_tpu/metrics.py: counters/gauges/"
+        "log-bucket histograms, bridge-derived from telemetry) for "
+        "headless runs; the serve daemon also answers GET /.metrics "
+        "in Prometheus text format, and tools/slo_report.py "
+        "exit-code-gates a rollup or live endpoint against a "
+        "declarative SLO spec (p50/p99 time-to-verdict, refusal "
+        "rate, queue wait, cache-hit rate)"
+    )
 
 
 def _pop_connect_flag(argv: list[str]) -> tuple[str | None, list[str]]:
@@ -737,6 +750,45 @@ def _pop_trace_flag(argv: list[str]) -> tuple[str | None, list[str]]:
         else:
             rest.append(a)
     return level, rest
+
+
+def _pop_metrics_flags(
+    argv: list[str],
+) -> tuple[float | None, str | None, list[str]]:
+    """Strip ``--metrics-interval=N`` / ``--metrics-path=FILE`` from
+    anywhere in argv: the headless metrics export
+    (stateright_tpu/metrics.py) — a tracer runs for the lane (even
+    without ``--trace``) and every N seconds its events are folded
+    through the tracer→metrics bridge into one cumulative
+    ``metrics_rollup`` JSONL line (default path
+    ``stateright_tpu.metrics.jsonl``), plus a final line at exit.
+    TRACE artifacts are still only written when ``--trace`` asked
+    for them."""
+    interval = None
+    path = None
+    rest = []
+    for a in argv:
+        if a.startswith("--metrics-interval="):
+            val = a.split("=", 1)[1]
+            interval = float(val)
+            if interval <= 0:
+                raise SystemExit(
+                    f"--metrics-interval={val}: must be > 0 seconds"
+                )
+        elif a == "--metrics-interval":
+            raise SystemExit(
+                "--metrics-interval needs a cadence: "
+                "--metrics-interval=N (seconds)"
+            )
+        elif a.startswith("--metrics-path="):
+            path = a.split("=", 1)[1]
+        else:
+            rest.append(a)
+    if interval is None and path is not None:
+        raise SystemExit(
+            "--metrics-path requires --metrics-interval=N"
+        )
+    return interval, path, rest
 
 
 def _pop_runtime_flags(argv: list[str]) -> list[str]:
@@ -857,6 +909,7 @@ def main(argv: list[str] | None = None) -> None:
 
         raise SystemExit(analyze_main(argv[1:]))
     trace_level, argv = _pop_trace_flag(argv)
+    metrics_interval, metrics_path, argv = _pop_metrics_flags(argv)
     argv = _pop_runtime_flags(argv)
     if not argv or argv[0] not in _MODELS:
         _usage()
@@ -866,23 +919,43 @@ def main(argv: list[str] | None = None) -> None:
     if not rest or rest[0] not in subs:
         _usage(model)
         return
-    if trace_level is None:
+    if trace_level is None and metrics_interval is None:
         handler(rest[0], rest[1:])
         return
-    if trace_level not in ("default", "deep"):
+    if trace_level not in (None, "default", "deep"):
         raise SystemExit(
             f"--trace={trace_level}: unknown level "
             "(use --trace or --trace=deep)"
         )
     from .telemetry import RunTracer, write_artifacts
 
-    tracer = RunTracer(level=trace_level)
+    # --metrics-interval implies a tracer (the rollup is bridge-
+    # derived from telemetry events) but NOT trace artifacts: those
+    # stay --trace's call
+    tracer = RunTracer(level=trace_level or "default")
+    rollup = None
+    if metrics_interval is not None:
+        from .metrics import Rollup, bridge_events
+
+        def _registry_now(tracer=tracer):
+            with tracer._lock:
+                events = list(tracer.events)
+            return bridge_events(events)
+
+        rollup = Rollup(
+            metrics_path or "stateright_tpu.metrics.jsonl",
+            metrics_interval, source=_registry_now,
+        ).start()
     try:
         with tracer.activate():
             handler(rest[0], rest[1:])
     finally:
+        if rollup is not None:
+            # the final rollup: even a run shorter than one interval
+            # leaves the cumulative totals line
+            rollup.stop()
         # A failed/interrupted run's partial trace is the one you
         # need for diagnosis — write whatever was collected.
-        if tracer.events:
+        if trace_level is not None and tracer.events:
             jsonl, chrome = write_artifacts(tracer)
             print(f"trace: wrote {jsonl} + {chrome}", file=sys.stderr)

@@ -346,6 +346,27 @@ def make_server(checker, snapshot, host: str, port: int,
                 if registry is not None:
                     view["service"] = registry.status_block()
                 self._json(view)
+            elif self.path == "/.metrics":
+                # plain Explorer servers (no service registry — the
+                # registry's own /.metrics is tried first in
+                # _dispatch): render the PROCESS-active registry
+                # (stateright_tpu/metrics.py activate()), or an empty
+                # exposition — a scraper sees 200 either way, the
+                # same lock-free answer-while-busy rule as /.status
+                meta["kind"], meta["cache_hit"] = "metrics", True
+                from ..metrics import active_registry
+
+                reg = active_registry()
+                body = (reg.render_prometheus() if reg is not None
+                        else "").encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             elif self.path.startswith("/.states"):
                 meta["kind"] = "states"
                 # ``_unique_states`` is a live attribute (no run

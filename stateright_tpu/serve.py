@@ -51,6 +51,15 @@ portable-caching / warm-start framing of arXiv:2603.09555:
   (the ``stateright_tpu --connect`` client mode), ``GET
   /.serve/sessions`` lists sessions, ``POST /.serve/trace`` exports
   the merged trace.
+* **Live metrics** (stateright_tpu/metrics.py): the service owns a
+  :class:`~stateright_tpu.metrics.MetricsRegistry` — the serve seams
+  the tracer never sees (admission accept/refuse with priced bytes,
+  FIFO queue depth + queue wait, dispatch-gate hold, active sessions,
+  warm/cold split, LRU/spool evictions) are metered directly, and
+  every session's schema-validated telemetry feeds the registry
+  through the tracer→metrics bridge at settle. ``GET /.metrics``
+  serves Prometheus text, ``--metrics-interval=N`` appends JSONL
+  rollups, and ``/.status`` carries a compact metrics block.
 * **Reporting**: :meth:`CheckService.write_trace` merges every
   session's events into one TRACE artifact (one run index per
   session, ``session_begin``/``session_end``/``program_evict``
@@ -75,6 +84,7 @@ from contextlib import nullcontext
 from typing import Optional
 
 from . import checkpoint, memplan, telemetry
+from .metrics import MetricsRegistry, Rollup, bridge_events
 
 
 class AdmissionRefused(RuntimeError):
@@ -150,20 +160,35 @@ class _GateHandle:
     session's queue wait (the latency-per-query lane serve_report
     prints), releasing hands the device to the next queued session."""
 
-    __slots__ = ("_gate", "_session")
+    __slots__ = ("_gate", "_session", "_m", "_t_acq")
 
-    def __init__(self, gate: FifoLock, session: "Session"):
+    def __init__(self, gate: FifoLock, session: "Session",
+                 m: Optional[dict] = None):
         self._gate = gate
         self._session = session
+        self._m = m
+        self._t_acq = 0.0
 
     def __enter__(self):
+        m = self._m
+        if m is not None:
+            m["queue_depth"].inc()
         t0 = time.monotonic()
         self._gate.acquire()
-        self._session.gate_wait_sec += time.monotonic() - t0
+        t1 = time.monotonic()
+        self._session.gate_wait_sec += t1 - t0
+        if m is not None:
+            m["queue_depth"].dec()
+            m["queue_wait"].observe(t1 - t0)
+            self._t_acq = t1
         return self
 
     def __exit__(self, *exc):
         self._gate.release()
+        if self._m is not None:
+            self._m["gate_hold"].observe(
+                time.monotonic() - self._t_acq
+            )
         return False
 
 
@@ -173,22 +198,37 @@ class _FusedGateHandle:
     attributed to every member session as a 1/N share — the same
     amortization the latency profile applies to the sync floor."""
 
-    __slots__ = ("_gate", "_sessions")
+    __slots__ = ("_gate", "_sessions", "_m", "_t_acq")
 
-    def __init__(self, gate: FifoLock, sessions: list):
+    def __init__(self, gate: FifoLock, sessions: list,
+                 m: Optional[dict] = None):
         self._gate = gate
         self._sessions = sessions
+        self._m = m
+        self._t_acq = 0.0
 
     def __enter__(self):
+        m = self._m
+        if m is not None:
+            m["queue_depth"].inc()
         t0 = time.monotonic()
         self._gate.acquire()
-        share = (time.monotonic() - t0) / max(1, len(self._sessions))
+        t1 = time.monotonic()
+        share = (t1 - t0) / max(1, len(self._sessions))
         for s in self._sessions:
             s.gate_wait_sec += share
+        if m is not None:
+            m["queue_depth"].dec()
+            m["queue_wait"].observe(t1 - t0)
+            self._t_acq = t1
         return self
 
     def __exit__(self, *exc):
         self._gate.release()
+        if self._m is not None:
+            self._m["gate_hold"].observe(
+                time.monotonic() - self._t_acq
+            )
         return False
 
 
@@ -378,6 +418,77 @@ class CheckService:
         #: build-or-fetch on a worker thread at admission
         self._fp_registry: set = set()
         self._explorer = None  # (checker, snapshot, session)
+        #: the live metrics plane (stateright_tpu/metrics.py): engine
+        #: signals arrive through the tracer->metrics bridge at each
+        #: session settle (zero engine code metered), the serve-only
+        #: seams the tracer never sees — admission, FIFO queue
+        #: depth/wait, gate hold, warm/cold split, evictions — are
+        #: instrumented directly below. ``GET /.metrics`` renders
+        #: this registry in Prometheus text format.
+        self.metrics = MetricsRegistry()
+        m = self.metrics
+        self._m = dict(
+            requests=m.counter(
+                "stpu_serve_requests_total",
+                "check sessions submitted to the service",
+            ),
+            active=m.gauge(
+                "stpu_serve_active_sessions",
+                "check sessions currently in flight",
+            ),
+            queue_depth=m.gauge(
+                "stpu_serve_queue_depth",
+                "sessions waiting on the FIFO device gate",
+            ),
+            queue_wait=m.histogram(
+                "stpu_serve_queue_wait_seconds",
+                "per-acquire FIFO device-gate wait",
+            ),
+            gate_hold=m.histogram(
+                "stpu_serve_gate_hold_seconds",
+                "device-gate hold per chunk dispatch+sync",
+            ),
+            sessions=m.counter(
+                "stpu_serve_sessions_total",
+                "settled sessions by final state",
+            ),
+            admission=m.counter(
+                "stpu_serve_admission_total",
+                "admission decisions (accepted/refused)",
+            ),
+            admission_bytes=m.counter(
+                "stpu_serve_admission_bytes_total",
+                "priced resident bytes by admission decision",
+            ),
+            warm=m.counter(
+                "stpu_serve_warm_hits_total",
+                "device sessions by warm/cold start",
+            ),
+            batch_fallbacks=m.counter(
+                "stpu_serve_batch_fallbacks_total",
+                "fused groups refused admission (fell back solo)",
+            ),
+            prog_evict=m.counter(
+                "stpu_serve_program_evictions_total",
+                "compiled-program LRU evictions",
+            ),
+            prog_evict_bytes=m.counter(
+                "stpu_serve_program_evicted_bytes_total",
+                "compiled-program bytes evicted",
+            ),
+            snap_evict=m.counter(
+                "stpu_serve_snapshot_evictions_total",
+                "warm-start snapshot spool evictions",
+            ),
+            snap_evict_bytes=m.counter(
+                "stpu_serve_snapshot_evicted_bytes_total",
+                "warm-start snapshot bytes evicted",
+            ),
+        )
+        # pre-touch the unlabeled gauges so a fresh /.metrics scrape
+        # shows the families at zero instead of omitting them
+        self._m["active"].set(0)
+        self._m["queue_depth"].set(0)
 
     # -- check sessions ---------------------------------------------------
 
@@ -410,6 +521,8 @@ class CheckService:
         session.tracer = telemetry.RunTracer()
         with self._lock:
             self._sessions.append(session)
+        self._m["requests"].inc()
+        self._m["active"].inc()
         proxy = _stdout_proxy()
         buf = io.StringIO()
         proxy.push(buf)
@@ -445,6 +558,16 @@ class CheckService:
             session.output = buf.getvalue()
             session.t_end = time.monotonic()
             session.running = False
+            self._m["active"].dec()
+            self._m["sessions"].inc(state=session.state)
+            # the tracer->metrics bridge: every schema-validated event
+            # this session emitted (chunk walls, build tiers, verdict
+            # timeline, spills, checkpoints, ...) feeds the live
+            # registry — zero engine code metered, each session's
+            # stream folded exactly once, at settle
+            with session.tracer._lock:
+                settled = list(session.tracer.events)
+            bridge_events(settled, self.metrics)
             self._trim_sessions()
         return session
 
@@ -531,7 +654,9 @@ class CheckService:
                     # correctness never rides the cache
                     session.warm_start = False
         checker.keep_final_carry = True
-        checker.dispatch_gate = _GateHandle(self._gate, session)
+        checker.dispatch_gate = _GateHandle(
+            self._gate, session, self._m
+        )
 
     # -- admission-time program pre-warm ----------------------------------
 
@@ -635,6 +760,7 @@ class CheckService:
                     lambda g=group: _FusedGateHandle(
                         self._gate,
                         [m.session for m in g.members],
+                        self._m,
                     )
                 )
                 self._groups[key] = group
@@ -679,6 +805,7 @@ class CheckService:
             budget = self.device_budget_bytes
             if (budget is not None
                     and plan["total_bytes"] + in_flight > budget):
+                self._m["batch_fallbacks"].inc()
                 return (
                     f"batch: fused plan of {len(members)} session(s) "
                     f"projects {plan['total_bytes']:,} resident "
@@ -692,6 +819,10 @@ class CheckService:
                 s.running = True
                 if s.batch is not None:
                     s.batch["size"] = len(members)
+                self._m["admission"].inc(decision="accepted")
+                self._m["admission_bytes"].inc(
+                    plan["per_session_bytes"], decision="accepted"
+                )
             self._batches.append(dict(
                 group=group.group_id,
                 size=len(members),
@@ -732,10 +863,18 @@ class CheckService:
                     f"{budget:,} — shrink the lane's capacity or "
                     "raise the service budget"
                 )
+                self._m["admission"].inc(decision="refused")
+                self._m["admission_bytes"].inc(
+                    est["total_bytes"], decision="refused"
+                )
                 raise AdmissionRefused(session.error)
             session.admitted_bytes = est["total_bytes"]
             session.t_admit = time.monotonic()
             session.running = True
+            self._m["admission"].inc(decision="accepted")
+            self._m["admission_bytes"].inc(
+                est["total_bytes"], decision="accepted"
+            )
 
     def _finish(self, session: Session) -> None:
         checker = session.checker
@@ -745,6 +884,9 @@ class CheckService:
         session.total = getattr(checker, "_total_states", None)
         if not session.device or session.state != "done":
             return
+        self._m["warm"].inc(
+            result="warm" if session.warm_start else "cold"
+        )
         session.program_key = getattr(
             checker, "_program_key_hash", None
         )
@@ -803,6 +945,8 @@ class CheckService:
                 session.snapshot_evictions.append(
                     (entry["key"], entry["bytes"])
                 )
+                self._m["snap_evict"].inc()
+                self._m["snap_evict_bytes"].inc(entry["bytes"])
         for entry in evicted:
             try:
                 os.remove(entry["path"])
@@ -856,6 +1000,8 @@ class CheckService:
                 session.evictions.append(
                     (old_hash, entry["bytes"])
                 )
+                self._m["prog_evict"].inc()
+                self._m["prog_evict_bytes"].inc(entry["bytes"])
 
     def lru_bytes(self) -> int:
         with self._lock:
@@ -908,7 +1054,22 @@ class CheckService:
         /.check`` runs a session from JSON ``{"argv": [...]}`` (the
         ``--connect`` client's endpoint), ``GET /.serve/sessions``
         lists sessions, ``POST /.serve/trace`` exports the merged
-        TRACE artifact pair. Returns True when handled."""
+        TRACE artifact pair, ``GET /.metrics`` renders the live
+        registry in Prometheus text format (beside ``/.status``, same
+        snapshot discipline: the registry lock is only ever held for
+        dict reads, never across device work, so a scrape answers
+        while a session is mid-chunk). Returns True when handled."""
+        if method == "GET" and path == "/.metrics":
+            body = self.metrics.render_prometheus().encode()
+            handler.send_response(200)
+            handler.send_header(
+                "Content-Type",
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+            handler.send_header("Content-Length", str(len(body)))
+            handler.end_headers()
+            handler.wfile.write(body)
+            return True
         if method == "POST" and path == "/.check":
             try:
                 length = int(handler.headers.get("Content-Length") or 0)
@@ -973,6 +1134,19 @@ class CheckService:
                 batch_sessions=self.batch_sessions,
                 window_sec=self.batch_window_sec,
                 groups_dispatched=n_batches,
+            ),
+            # the compact live-metrics block (ISSUE 19): progress
+            # polls answer the load question without scraping
+            # /.metrics — registry reads only, never device waits
+            metrics=dict(
+                active_sessions=int(self._m["active"].value()),
+                queue_depth=int(self._m["queue_depth"].value()),
+                refusals=int(
+                    self._m["admission"].value(decision="refused")
+                ),
+                ttv_p99_sec=self.metrics.histogram_quantile(
+                    "stpu_time_to_verdict_seconds", 0.99
+                ),
             ),
         )
 
@@ -1262,10 +1436,14 @@ def _warm_vs_cold(sessions: list) -> list:
 
 
 def write_serve_artifact(summary: dict,
-                         root: Optional[str] = None) -> str:
+                         root: Optional[str] = None,
+                         metrics: Optional[dict] = None) -> str:
     """Write one auto-numbered ``SERVE_r*.json`` (own round sequence,
     like MEM/LAT/COMM — derived from a TRACE it names in its ``trace``
-    field; numbering via stateright_tpu/artifacts.py)."""
+    field; numbering via stateright_tpu/artifacts.py). ``metrics``
+    embeds a registry families snapshot
+    (:meth:`~stateright_tpu.metrics.MetricsRegistry.snapshot`) beside
+    the summary — the live-plane view of the same run."""
     from .artifacts import artifact_path, next_round, provenance, \
         repo_root
 
@@ -1275,6 +1453,8 @@ def write_serve_artifact(summary: dict,
         round=next_round(root, stems=("SERVE",)),
     )
     doc = dict(summary)
+    if metrics is not None:
+        doc["metrics"] = metrics
     doc.setdefault("provenance", provenance())
     with open(path, "w") as fh:
         json.dump(doc, fh, indent=1, sort_keys=True)
@@ -1328,20 +1508,31 @@ def daemon_main(argv: list) -> int:
     """``python -m stateright_tpu serve [HOST:PORT] [--explore=MODEL
     [,COUNT]] [--program-budget-bytes=N] [--device-budget-bytes=N]
     [--batch-sessions[=N]] [--batch-window-sec=S]
-    [--snapshot-budget-bytes=N] [--no-warm-start]`` — run the
+    [--snapshot-budget-bytes=N] [--no-warm-start]
+    [--metrics-interval=N [--metrics-path=FILE]]`` — run the
     resident service until interrupted. Clients reach it with
     ``--connect=HOST:PORT`` on any check lane, a browser at ``/``
     when an Explorer model is mounted. ``--batch-sessions`` fuses up
     to N (default 4) concurrent compatible check sessions into one
-    device dispatch (stateright_tpu/batch.py)."""
+    device dispatch (stateright_tpu/batch.py). ``--metrics-interval``
+    appends one ``metrics_rollup`` JSONL line (the live registry,
+    cumulative) every N seconds — the headless export for mesh runs
+    with no scraper; ``GET /.metrics`` serves the same registry in
+    Prometheus text format either way."""
     addr = "localhost:3000"
     explore = None
+    metrics_interval = None
+    metrics_path = None
     kw: dict = {}
     for a in argv:
         if a.startswith("--explore="):
             spec = a.split("=", 1)[1]
             name, _, count = spec.partition(",")
             explore = (name, int(count) if count else None)
+        elif a.startswith("--metrics-interval="):
+            metrics_interval = float(a.split("=", 1)[1])
+        elif a.startswith("--metrics-path="):
+            metrics_path = a.split("=", 1)[1]
         elif a.startswith("--program-budget-bytes="):
             kw["program_budget_bytes"] = int(a.split("=", 1)[1])
         elif a.startswith("--device-budget-bytes="):
@@ -1368,9 +1559,22 @@ def daemon_main(argv: list) -> int:
     host, _, port = addr.partition(":")
     server = service.http_server(host or "localhost",
                                  int(port or 3000))
+    rollup = None
+    if metrics_interval is not None:
+        if metrics_path is None:
+            metrics_path = "stateright_tpu.metrics.jsonl"
+        rollup = Rollup(
+            metrics_path, metrics_interval,
+            source=lambda: service.metrics,
+        ).start()
+    elif metrics_path is not None:
+        raise SystemExit(
+            "serve: --metrics-path requires --metrics-interval=N"
+        )
     print(
         f"Resident checking service on http://{addr} "
-        f"(POST /.check, GET /.serve/sessions, POST /.serve/trace"
+        f"(POST /.check, GET /.serve/sessions, POST /.serve/trace, "
+        f"GET /.metrics"
         + (", Explorer UI at /" if explore is not None else "")
         + "). Connect check lanes with --connect=" + addr
     )
@@ -1378,6 +1582,9 @@ def daemon_main(argv: list) -> int:
         server.serve_forever()
     except KeyboardInterrupt:
         pass
+    finally:
+        if rollup is not None:
+            rollup.stop()
     return 0
 
 

@@ -862,6 +862,13 @@ _REQUIRED = {
     # of that fingerprint runs cold, counts unaffected).
     "batch": ("run", "group", "size", "index", "t"),
     "snapshot_evict": ("run", "key", "bytes", "t"),
+    # The live metrics plane (stateright_tpu/metrics.py): one
+    # cumulative registry snapshot per ``--metrics-interval`` tick —
+    # the headless JSONL export (Rollup). ``families`` is the full
+    # JSON-able family dump (counters/gauges/histogram buckets); the
+    # file loads and validates exactly like a TRACE artifact, which is
+    # what lets tools/slo_report.py gate on it.
+    "metrics_rollup": ("t", "families"),
 }
 
 

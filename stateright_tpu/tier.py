@@ -209,7 +209,11 @@ class ColdStore:
 
     def summary(self) -> dict:
         """The accounting block tier_spill events, the memory
-        watermark, and checkpoint manifests embed."""
+        watermark, and checkpoint manifests embed. The tracer→metrics
+        bridge counts the emitted ``tier_spill`` events into
+        ``stpu_tier_spills_total`` (stateright_tpu/metrics.py), so a
+        resident service's spill pressure reads live on
+        ``GET /.metrics``."""
         return dict(
             n_shards=self.n_shards,
             spills=int(self.spills),

@@ -1,0 +1,560 @@
+"""Live service metrics + SLO layer (ISSUE 19, ROADMAP direction
+2(c) signal plane): the streaming metrics registry
+(stateright_tpu/metrics.py), the tracer->metrics bridge, the
+Prometheus exposition round-trip, rollup JSONL validation through
+telemetry's validator, the ONE shared quantile implementation pinned
+exact-vs-bucket, bridge reconciliation against the committed
+TRACE_r30/r31 service traces, the lock-free ``/.status`` metrics
+block under concurrent scrape, the null-path (inactive-registry)
+no-allocation regression, tools/slo_report.py exit codes, and the
+sustained ramp->spike->drain loadtest smoke on the pinned 2pc lane.
+
+Rides tier-1 (``pytest -m metrics`` runs it standalone)."""
+
+import importlib.util
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from stateright_tpu import metrics as M
+from stateright_tpu.metrics import (
+    BRIDGE_FAMILIES,
+    SECONDS_BUCKETS,
+    MetricsRegistry,
+    Rollup,
+    bridge_events,
+    bucket_quantile,
+    evaluate_slo,
+    load_rollup,
+    parse_prometheus,
+    quantile,
+    slo_observed,
+)
+
+pytestmark = pytest.mark.metrics
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    """Import a tools/ script in-process (the subprocess-free idiom:
+    the tools return exit codes from main() instead of exiting)."""
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO_ROOT, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- registry semantics ----------------------------------------------------
+
+
+def test_registry_counter_gauge_semantics():
+    r = MetricsRegistry()
+    c = r.counter("stpu_things_total", "things")
+    c.inc()
+    c.inc(2.5, lane="a")
+    c.inc(lane="a")
+    assert r.counter_value("stpu_things_total") == 1.0
+    assert r.counter_value("stpu_things_total", lane="a") == 3.5
+    assert c.total() == 4.5
+    # get-or-create: the same family object comes back, help kept
+    assert r.counter("stpu_things_total") is c
+    g = r.gauge("stpu_depth")
+    g.set(3)
+    g.inc()
+    g.dec(2)
+    assert r.gauge_value("stpu_depth") == 2.0
+    # unobserved label set reads 0/None, never raises
+    assert r.counter_value("stpu_things_total", lane="ghost") == 0.0
+    assert r.gauge_value("stpu_missing") == 0.0
+
+
+def test_registry_kind_conflict_raises():
+    r = MetricsRegistry()
+    r.counter("stpu_x")
+    with pytest.raises(ValueError):
+        r.gauge("stpu_x")
+    with pytest.raises(ValueError):
+        r.histogram("stpu_x")
+
+
+def test_histogram_bucket_edges_sub_ms_and_tail():
+    """The fixed log-bucket layout covers the sub-ms dispatch floor
+    AND the >60s long-model tail; the overflow bucket catches
+    beyond-layout observations without error."""
+    assert SECONDS_BUCKETS[0] <= 0.0001
+    assert SECONDS_BUCKETS[-1] >= 120.0
+    r = MetricsRegistry()
+    h = r.histogram("stpu_t_seconds", "t")
+    for v in (0.00005, 0.0002, 70.0, 400.0):
+        h.observe(v)
+    h.observe(None)          # skipped, not an error
+    h.observe(float("nan"))  # skipped
+    snap = r.snapshot()["stpu_t_seconds"]["values"][0]
+    assert snap["count"] == 4
+    counts = snap["counts"]
+    # one per edge plus the +Inf overflow slot
+    assert len(counts) == len(SECONDS_BUCKETS) + 1
+    assert counts[0] == 1                      # 0.00005 <= 1e-4
+    assert counts[1] == 1                      # 0.0002 <= 2.5e-4
+    assert counts[SECONDS_BUCKETS.index(120.0)] == 1   # 70 <= 120
+    assert counts[-1] == 1                     # 400 overflows
+    assert snap["min"] == pytest.approx(0.00005)
+    assert snap["max"] == pytest.approx(400.0)
+
+
+# -- the ONE shared quantile implementation --------------------------------
+
+
+def test_quantile_exact_small_n():
+    assert quantile([], 0.5) is None
+    assert quantile([7.0], 0.99) == 7.0
+    assert quantile([1.0, 2.0, 3.0, 4.0], 0.5) == 2.5
+    assert quantile([4.0, 1.0, 3.0, 2.0], 0.0) == 1.0
+    assert quantile([4.0, 1.0, 3.0, 2.0], 1.0) == 4.0
+
+
+def test_quantile_pin_exact_vs_bucket_interpolated():
+    """The satellite pin: the exact path (serve_report /
+    serve_loadtest aggregate rows) and the streaming
+    bucket-interpolated path (the live histogram quantile behind
+    /.status and the SLO gate) agree on the SAME sample to within one
+    bucket's width — the two report paths cannot drift apart."""
+    sample = [0.0008, 0.0012, 0.003, 0.004, 0.0041, 0.009, 0.02,
+              0.024, 0.09, 0.4]
+    r = MetricsRegistry()
+    h = r.histogram("stpu_pin_seconds", "pin")
+    for v in sample:
+        h.observe(v)
+    for q in (0.50, 0.90, 0.99):
+        exact = quantile(sample, q)
+        streamed = h.quantile(q)
+        # the streaming answer lands in the same bucket as the exact
+        # one: bounded by that bucket's edges
+        edges = (0.0,) + SECONDS_BUCKETS
+        lo = max(e for e in edges if e <= exact)
+        hi = min(e for e in SECONDS_BUCKETS if e >= exact)
+        assert lo <= streamed <= hi, (q, exact, streamed)
+    # bucket_quantile honors the observed min/max clamp
+    counts = r.snapshot()["stpu_pin_seconds"]["values"][0]["counts"]
+    assert bucket_quantile(SECONDS_BUCKETS, counts, 0.0,
+                           vmin=min(sample), vmax=max(sample)) \
+        == pytest.approx(min(sample))
+    assert bucket_quantile(SECONDS_BUCKETS, counts, 1.0,
+                           vmin=min(sample), vmax=max(sample)) \
+        == pytest.approx(max(sample))
+
+
+# -- Prometheus exposition --------------------------------------------------
+
+
+def test_prometheus_escaping_and_roundtrip():
+    r = MetricsRegistry()
+    c = r.counter("stpu_esc_total", 'help with "quotes" and \\slash')
+    c.inc(3, lane='say "hi"\nback\\slash')
+    h = r.histogram("stpu_esc_seconds", "hist")
+    h.observe(0.002, lane="a")
+    h.observe(7.0, lane="a")
+    text = r.render_prometheus()
+    # label escaping: \ -> \\, " -> \", newline -> \n
+    assert 'lane="say \\"hi\\"\\nback\\\\slash"' in text
+    # histogram exposition: cumulative _bucket series + +Inf + sum/count
+    assert 'le="+Inf"' in text
+    assert "stpu_esc_seconds_sum" in text
+    assert "stpu_esc_seconds_count" in text
+    back = parse_prometheus(text)
+    assert back["stpu_esc_total"]["values"][0]["value"] == 3.0
+    assert (back["stpu_esc_total"]["values"][0]["labels"]["lane"]
+            == 'say "hi"\nback\\slash')
+    hv = back["stpu_esc_seconds"]["values"][0]
+    snap = r.snapshot()["stpu_esc_seconds"]["values"][0]
+    # de-cumulated per-bucket counts match the registry snapshot
+    assert hv["counts"] == snap["counts"]
+    assert hv["count"] == 2
+    assert hv["sum"] == pytest.approx(7.002)
+
+
+# -- the null path (inactive registry) -------------------------------------
+
+
+def test_null_path_is_one_shared_singleton():
+    """The unmetered fast path allocates NO per-call Python objects:
+    with no registry active the module-level hooks hand back the ONE
+    slot-less no-op singleton, every method swallows args and returns
+    None — the engine's hot loops see a constant, not a constructor.
+    This is the structural regression behind the PERF.md §metrics
+    overhead bar."""
+    assert M.active_registry() is None
+    assert M.counter("stpu_anything_total") is M._NULL
+    assert M.gauge("stpu_anything") is M._NULL
+    assert M.histogram("stpu_anything_seconds") is M._NULL
+    # same singleton for every name: no per-family allocation either
+    assert M.counter("stpu_other_total") is M._NULL
+    assert type(M._NULL).__slots__ == ()
+    assert M._NULL.inc(1.0, lane="x") is None
+    assert M._NULL.observe(0.5) is None
+    assert M._NULL.set(1) is None
+    assert M._NULL.value() == 0.0
+    assert M._NULL.quantile(0.99) is None
+
+
+def test_activate_scopes_the_module_hooks():
+    r = MetricsRegistry()
+    with M.activate(r):
+        assert M.active_registry() is r
+        M.counter("stpu_live_total").inc()
+        with pytest.raises(RuntimeError):
+            with M.activate(MetricsRegistry()):
+                pass
+    assert M.active_registry() is None
+    assert r.counter_value("stpu_live_total") == 1.0
+
+
+# -- rollup JSONL rides the telemetry validator ----------------------------
+
+
+def test_rollup_jsonl_validates_and_loads(tmp_path):
+    from stateright_tpu.telemetry import load_trace, validate_events
+
+    r = MetricsRegistry()
+    r.counter("stpu_ticks_total").inc(5)
+    r.histogram("stpu_tick_seconds").observe(0.01)
+    path = str(tmp_path / "metrics.jsonl")
+    roll = Rollup(path, 0.05, source=lambda: r).start()
+    time.sleep(0.18)
+    roll.stop()
+    events = load_trace(path)
+    validate_events(events)  # metrics_rollup is a schema'd event
+    assert all(ev["ev"] == "metrics_rollup" for ev in events)
+    assert len(events) >= 2  # ticks plus the final stop() flush
+    last = load_rollup(path)
+    assert (last["families"]["stpu_ticks_total"]["values"][0]["value"]
+            == 5.0)
+    # monotone tick stamps
+    ts = [ev["t"] for ev in events]
+    assert ts == sorted(ts)
+
+
+def test_rollup_rejects_nonpositive_interval(tmp_path):
+    with pytest.raises(ValueError):
+        Rollup(str(tmp_path / "m.jsonl"), 0.0,
+               source=MetricsRegistry)
+
+
+def test_load_rollup_requires_a_rollup_event(tmp_path):
+    p = tmp_path / "t.jsonl"
+    p.write_text(json.dumps(
+        dict(ev="run_begin", run=0, t=0.0, lane={}, schema=1)
+    ) + "\n")
+    with pytest.raises(ValueError):
+        load_rollup(str(p))
+
+
+# -- the tracer->metrics bridge reconciles with the report tools -----------
+
+
+@pytest.mark.parametrize("trace", ["TRACE_r30.jsonl",
+                                   "TRACE_r31.jsonl"])
+def test_bridge_reconciles_with_serve_and_latency_reports(trace):
+    """Replay a committed service trace through the bridge and assert
+    EXACT agreement with what tools/serve_report.py and
+    tools/latency_report.py derive from the same events — one stream,
+    two views, zero drift."""
+    from stateright_tpu.serve import serve_summary
+    from stateright_tpu.telemetry import (
+        latency_summary,
+        load_trace,
+        validate_events,
+    )
+
+    events = load_trace(os.path.join(REPO_ROOT, trace))
+    validate_events(events)
+    reg = bridge_events(events)
+    snap = reg.snapshot()
+    for fam in snap:
+        assert fam in BRIDGE_FAMILIES or fam.startswith("stpu_")
+
+    # raw event-count counters match the stream exactly
+    def n(ev):
+        return sum(1 for e in events if e.get("ev") == ev)
+
+    assert reg.counter("stpu_waves_total").total() == n("wave")
+    assert reg.counter("stpu_chunks_total").total() == n("chunk")
+    assert reg.counter("stpu_verdicts_total").total() == n("verdict")
+    assert (reg.counter("stpu_program_builds_total").total()
+            == n("program_build"))
+
+    summary = serve_summary(events)
+    sessions = summary["sessions"]
+    # session terminal states, one count each
+    by_state = {}
+    for s in sessions:
+        by_state[s["state"]] = by_state.get(s["state"], 0) + 1
+    for state, count in by_state.items():
+        assert reg.counter_value("stpu_sessions_total",
+                                 state=state) == count
+
+    # time-to-verdict: the bridge's histogram saw EXACTLY the ttv the
+    # serve report prints per session (same max-verdict-wall rule)
+    ttvs = sorted(s["time_to_verdict_sec"] for s in sessions
+                  if s.get("time_to_verdict_sec") is not None)
+    fam = snap.get("stpu_time_to_verdict_seconds")
+    cell = fam["values"][0]
+    assert cell["count"] == len(ttvs)
+    assert cell["sum"] == pytest.approx(sum(ttvs), abs=1e-6)
+    assert cell["min"] == pytest.approx(ttvs[0], abs=1e-9)
+    assert cell["max"] == pytest.approx(ttvs[-1], abs=1e-9)
+
+    # queue wait: bridge sum == the serve report's per-session column
+    qw = [s.get("queue_wait_sec") or 0.0 for s in sessions]
+    qcell = snap["stpu_queue_wait_seconds"]["values"][0]
+    assert qcell["count"] == len(sessions)
+    assert qcell["sum"] == pytest.approx(sum(qw), abs=1e-6)
+
+    # compile tiers: bridge labels == the union of the report's
+    # per-session builds.tiers
+    tiers = {}
+    for s in sessions:
+        for t, c in ((s.get("builds") or {}).get("tiers")
+                     or {}).items():
+            tiers[t] = tiers.get(t, 0) + c
+    for t, c in tiers.items():
+        assert reg.counter_value("stpu_program_builds_total",
+                                 tier=t) == c
+
+    # latency view: the last run's verdict count agrees too
+    lat = latency_summary(events)
+    assert lat is not None
+    assert len(lat["verdicts"]) <= reg.counter(
+        "stpu_verdicts_total"
+    ).total()
+
+
+# -- SLO spec evaluation ----------------------------------------------------
+
+
+def _rollup_families():
+    r = MetricsRegistry()
+    h = r.histogram("stpu_time_to_verdict_seconds")
+    for v in (0.5, 1.0, 2.0):
+        h.observe(v)
+    q = r.histogram("stpu_queue_wait_seconds")
+    q.observe(0.01)
+    adm = r.counter("stpu_serve_admission_total")
+    adm.inc(9, decision="accepted")
+    adm.inc(1, decision="refused")
+    warm = r.counter("stpu_serve_warm_hits_total")
+    warm.inc(3, result="warm")
+    warm.inc(1, result="cold")
+    return r.snapshot(), r
+
+
+def test_slo_observed_and_evaluate():
+    families, _ = _rollup_families()
+    obs = slo_observed(families)
+    assert obs["refusal_rate"] == pytest.approx(0.1)
+    assert obs["cache_hit_rate"] == pytest.approx(0.75)
+    assert obs["ttv_p99_sec"] is not None
+    ev = evaluate_slo(
+        dict(max_ttv_p99_sec=60.0, max_refusal_rate=0.2,
+             min_cache_hit_rate=0.5),
+        obs,
+    )
+    assert ev["ok"] is True
+    assert all(o["status"] == "ok" for o in ev["objectives"])
+    bad = evaluate_slo(dict(max_refusal_rate=0.05), obs)
+    assert bad["ok"] is False
+    assert bad["objectives"][0]["status"] == "violated"
+    # an unmeasured objective FAILS the gate: silence is never
+    # compliance
+    unmeasured = evaluate_slo(
+        dict(max_queue_wait_p99_sec=1.0),
+        slo_observed({}),
+    )
+    assert unmeasured["ok"] is False
+    assert unmeasured["objectives"][0]["status"] == "unmeasured"
+    with pytest.raises(ValueError):
+        evaluate_slo(dict(max_bogus=1.0), obs)
+
+
+def test_slo_report_exit_codes(tmp_path, capsys):
+    """0 = objectives met, 1 = violated or unmeasured, 2 = bad input
+    — the exit code IS the gate."""
+    slo_report = _load_tool("slo_report")
+    families, reg = _rollup_families()
+    rollup = str(tmp_path / "m.jsonl")
+    roll = Rollup(rollup, 3600.0, source=lambda: reg).start()
+    roll.stop()  # the final flush writes one rollup line
+
+    def run(argv):
+        old = sys.argv
+        sys.argv = ["slo_report.py"] + argv
+        try:
+            return slo_report.main()
+        finally:
+            sys.argv = old
+
+    assert run(["--rollup", rollup, "--max-ttv-p99", "60",
+                "--max-refusal-rate", "0.2"]) == 0
+    assert run(["--rollup", rollup,
+                "--max-refusal-rate", "0.01"]) == 1
+    # unmeasured -> 1 as well (the families carry no serve queue hist
+    # but DO carry the engine queue fallback; use an absent signal)
+    empty = MetricsRegistry()
+    empty_rollup = str(tmp_path / "empty.jsonl")
+    r2 = Rollup(empty_rollup, 3600.0, source=lambda: empty).start()
+    r2.stop()
+    assert run(["--rollup", empty_rollup,
+                "--max-ttv-p99", "60"]) == 1
+    # bad inputs -> 2
+    assert run(["--rollup", rollup]) == 2            # empty spec
+    assert run(["--rollup", str(tmp_path / "nope.jsonl"),
+                "--max-ttv-p99", "60"]) == 2         # unreadable
+    spec = tmp_path / "spec.json"
+    spec.write_text(json.dumps({"max_bogus": 1.0}))
+    assert run(["--rollup", rollup, "--spec", str(spec)]) == 2
+    # artifact write: SLO_r* in its own round sequence + provenance
+    assert run(["--rollup", rollup, "--max-ttv-p99", "60",
+                "--json", "--root", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "SLO_r01.json" in out
+    doc = json.loads((tmp_path / "SLO_r01.json").read_text())
+    assert doc["evaluation"]["ok"] is True
+    assert "provenance" in doc
+
+    from stateright_tpu.artifacts import latest_slo_summary
+
+    ref = latest_slo_summary(root=str(tmp_path))
+    assert ref is not None
+    assert ref["artifact"] == "SLO_r01.json"
+    assert ref["ok"] is True
+    assert ref["objectives"] == {"max_ttv_p99_sec": "ok"}
+
+
+# -- the lock-free /.status + /.metrics surface ----------------------------
+
+
+def test_status_metrics_block_answers_concurrently(tmp_path):
+    """The compact /.status metrics block and the /.metrics scrape
+    keep answering while the dispatch gate is HELD — the same
+    answer-while-busy rule the Explorer status poll pins. 8
+    concurrent scrapers, zero errors, every response carries the
+    block."""
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+    from stateright_tpu.serve import CheckService
+
+    service = CheckService(spool_dir=str(tmp_path))
+    service.mount_explorer(TwoPhaseSys(rm_count=2).checker(), "2pc")
+    server = service.http_server("127.0.0.1", 0)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    service._gate.acquire()  # a session "holds the device"
+    try:
+        results = []
+
+        def scrape(i):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/.status", timeout=10
+            ) as r:
+                status = json.loads(r.read())
+            assert status["model"] == "TwoPhaseSys"
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/.metrics", timeout=10
+            ) as r:
+                text = r.read().decode()
+            results.append((status, text))
+
+        threads = [threading.Thread(target=scrape, args=(i,))
+                   for i in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+    finally:
+        service._gate.release()
+        server.shutdown()
+    assert len(results) == 8
+    for status, text in results:
+        block = status["service"]["metrics"]
+        assert block["active_sessions"] == 0
+        assert block["queue_depth"] == 0
+        assert block["refusals"] == 0
+        assert block["ttv_p99_sec"] is None
+        # the gauges are pre-registered: a fresh scrape already
+        # carries the family names, not an empty exposition
+        assert "stpu_serve_queue_depth" in text
+        assert "stpu_serve_active_sessions" in text
+        families = parse_prometheus(text)
+        assert (families["stpu_serve_queue_depth"]["values"][0]
+                ["value"] == 0.0)
+
+
+# -- CLI flags --------------------------------------------------------------
+
+
+def test_cli_pop_metrics_flags():
+    from stateright_tpu.cli import _pop_metrics_flags
+
+    interval, path, rest = _pop_metrics_flags(
+        ["2pc", "check", "3", "--metrics-interval=2.5",
+         "--metrics-path=m.jsonl"]
+    )
+    assert interval == 2.5
+    assert path == "m.jsonl"
+    assert rest == ["2pc", "check", "3"]
+    assert _pop_metrics_flags(["2pc", "check", "3"]) \
+        == (None, None, ["2pc", "check", "3"])
+    with pytest.raises(SystemExit):
+        _pop_metrics_flags(["--metrics-interval"])
+    with pytest.raises(SystemExit):
+        _pop_metrics_flags(["--metrics-interval=0"])
+    with pytest.raises(SystemExit):
+        _pop_metrics_flags(["x", "--metrics-path=m.jsonl"])
+
+
+# -- the sustained loadtest (the SLO evidence path), smoke-sized ----------
+
+
+def test_sustained_loadtest_smoke(tmp_path, capsys):
+    """ramp(1) -> spike(2) -> drain(1) of the pinned 2pc rm=3 lane
+    (288 states) against ONE live service over HTTP: the mid-spike
+    /.metrics scrape serves the named families, every served count is
+    bit-identical to the solo baseline, the per-phase quantiles come
+    out both ways, the SLO gate evaluates, and the SERVE/SLO/TRACE
+    artifacts land."""
+    loadtest = _load_tool("serve_loadtest")
+    code, doc = loadtest.run_sustained(
+        ["2pc", "check-tpu", "3"],
+        [("ramp", 1), ("spike", 2), ("drain", 1)],
+        dict(max_ttv_p99_sec=600.0, max_refusal_rate=0.0),
+        json_out=True,
+        root=str(tmp_path),
+    )
+    assert code == 0
+    assert doc["solo_unique"] == 288
+    assert doc["evaluation"]["ok"] is True
+    phases = {p["phase"]: p for p in doc["phases"]}
+    assert set(phases) == {"ramp", "spike", "drain"}
+    for p in doc["phases"]:
+        assert p["sessions"] == p["clients"]
+        assert p["ttv_p50_sec"] is not None
+        assert p["ttv_p50_bucket_sec"] is not None
+    # the /.status block was captured mid-spike
+    assert doc["status_metrics"] is not None
+    # artifacts: TRACE pair + SERVE with the registry snapshot
+    # embedded + the SLO gate doc
+    serve = json.loads((tmp_path / "SERVE_r01.json").read_text())
+    assert serve["sustained"]["solo_unique"] == 288
+    assert "stpu_serve_admission_total" in serve["metrics"]
+    slo = json.loads((tmp_path / "SLO_r01.json").read_text())
+    assert slo["evaluation"]["ok"] is True
+    assert slo["serve_artifact"] == "SERVE_r01.json"
+    assert (tmp_path / slo["trace"]).exists()

@@ -1,9 +1,10 @@
 #!/usr/bin/env python
-"""Serve load test: the wave-batching A/B — N concurrent clients,
-batched fused dispatch vs the FIFO-serial baseline.
+"""Serve load test: the wave-batching A/B and the sustained
+ramp→spike→drain SLO run against the resident checking service.
 
-Runs the SAME small-model fleet twice against two resident services
-(stateright_tpu/serve.py):
+**A/B mode (default)** — N concurrent clients, batched fused dispatch
+vs the FIFO-serial baseline. Runs the SAME small-model fleet twice
+against two resident services (stateright_tpu/serve.py):
 
 * **batched** — ``batch_sessions=N``: the fleet rendezvouses in one
   compatibility class and rides ONE fused wave dispatch
@@ -20,42 +21,63 @@ compile already subtracted, so the delta is attributed to the fused
 dispatch and not to compile amortization — plus p50/p99
 time-to-verdict for both arms.
 
-``--json`` exports the batched service's TRACE_r* pair and writes an
-auto-numbered ``SERVE_r*.json`` whose summary embeds the
-``fifo_baseline`` block, the ``latency_quantiles``, and the
-``loadtest`` headline (clients, lane, amortization_x) that bench
-provenance surfaces via ``artifacts.latest_serve_summary``.
+**Sustained mode (``--sustained``)** — the live-metrics/SLO evidence
+run (ISSUE 19, ROADMAP direction 2(c)): ONE resident service behind
+its real HTTP server (the same ``make_server`` surface ``python -m
+stateright_tpu serve`` runs), driven through ``POST /.check`` across
+three traffic phases — **ramp** (light), **spike** (concurrent
+fleet), **drain** (light again). Mid-spike the tool scrapes ``GET
+/.metrics`` and asserts the live registry serves the named families
+(queue depth/wait, admission decisions, the time-to-verdict
+histogram, compile-tier hits, eviction counters) plus the compact
+``/.status`` metrics block. Afterward it reports per-phase p50/p99
+time-to-verdict BOTH ways — exact (``metrics.quantile`` over the
+sample) and streaming (bucket-interpolated over a
+``metrics.Histogram``) — evaluates the declarative SLO spec
+(``metrics.evaluate_slo`` over ``slo_observed``), and asserts every
+served session's count is bit-identical to a solo run of the same
+lane on a fresh service. ``--json`` exports the TRACE pair, a
+``SERVE_r*.json`` with the sustained block and the registry snapshot
+embedded, and the ``SLO_r*.json`` gate evaluation bench provenance
+surfaces via ``artifacts.latest_slo_summary``.
 
 Usage:
   JAX_PLATFORMS=cpu python tools/serve_loadtest.py
   JAX_PLATFORMS=cpu python tools/serve_loadtest.py --clients=4 \\
       --lane="2pc check-tpu 4" --json
+  JAX_PLATFORMS=cpu python tools/serve_loadtest.py --sustained \\
+      --lane="2pc check-tpu 4" --ramp=2 --spike=4 --drain=2 \\
+      --slo-ttv-p99=120 --json
 
-Exit status: 0 on success (amortization printed), 1 when any session
-errors or counts diverge between the arms.
+Exit status: 0 on success, 1 when any session errors, counts diverge
+from the solo baseline, a named metrics family is missing from the
+live scrape, or the SLO gate fails.
 """
 
 import argparse
+import json as _json
 import os
 import sys
 import tempfile
 import threading
+import time
+import urllib.request
 
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 
-
-def _quantile(values, q):
-    """Linear-interpolated quantile of a small sample (no numpy
-    dependency for the report path)."""
-    if not values:
-        return None
-    xs = sorted(values)
-    pos = q * (len(xs) - 1)
-    lo = int(pos)
-    hi = min(lo + 1, len(xs) - 1)
-    return round(xs[lo] + (xs[hi] - xs[lo]) * (pos - lo), 6)
+#: the families the acceptance scrape asserts a live /.metrics serves
+#: under sustained load (ISSUE 19)
+REQUIRED_FAMILIES = (
+    "stpu_serve_queue_depth",
+    "stpu_serve_queue_wait_seconds",
+    "stpu_serve_admission_total",
+    "stpu_time_to_verdict_seconds",
+    "stpu_program_builds_total",
+    "stpu_serve_program_evictions_total",
+    "stpu_serve_snapshot_evictions_total",
+)
 
 
 def _run_fleet(service, lane_argv, n):
@@ -79,7 +101,10 @@ def _run_fleet(service, lane_argv, n):
 def _arm_stats(summary):
     """Per-session overhead rows + the arm's aggregate: the latency
     ledger's dispatch_net+fetch (compile subtracted) and the ttv
-    quantiles."""
+    quantiles (the SHARED exact implementation,
+    stateright_tpu/metrics.py quantile)."""
+    from stateright_tpu.metrics import quantile
+
     rows = []
     for s in summary["sessions"]:
         overhead = ((s.get("dispatch_net_sec") or 0.0)
@@ -103,15 +128,259 @@ def _arm_stats(summary):
         per_query_overhead_sec=(
             round(sum(ov) / len(ov), 6) if ov else None
         ),
-        ttv_p50_sec=_quantile(ttvs, 0.50),
-        ttv_p99_sec=_quantile(ttvs, 0.99),
+        ttv_p50_sec=quantile(ttvs, 0.50),
+        ttv_p99_sec=quantile(ttvs, 0.99),
     )
+
+
+# -- sustained ramp -> spike -> drain (the SLO evidence run) --------------
+
+
+def _post_check(port, lane_argv):
+    """One client query through the live HTTP surface (the
+    ``--connect`` endpoint): returns the response dict."""
+    body = _json.dumps({"argv": list(lane_argv)}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/.check", data=body,
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req) as r:
+        return _json.loads(r.read())
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}"
+    ) as r:
+        return r.read().decode()
+
+
+def _phase_fleet(port, lane_argv, n):
+    """N concurrent HTTP clients; returns their response dicts in
+    submission order."""
+    results = {}
+
+    def run(i):
+        results[i] = _post_check(port, lane_argv)
+
+    threads = [
+        threading.Thread(target=run, args=(i,)) for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return [results[i] for i in range(n)]
+
+
+def run_sustained(lane, phases, slo_spec, json_out=False, root=None):
+    """The sustained-load SLO run (importable: the metrics smoke test
+    drives it in-process). ``phases`` is ``[(name, clients), ...]``;
+    returns ``(exit_code, doc)`` where ``doc`` is the sustained
+    summary block (also written into SERVE_r*/SLO_r* when
+    ``json_out``)."""
+    from stateright_tpu.metrics import (
+        Histogram,
+        evaluate_slo,
+        quantile,
+        slo_observed,
+        write_slo_artifact,
+    )
+    from stateright_tpu.serve import (
+        CheckService,
+        serve_summary,
+        write_serve_artifact,
+    )
+
+    failures = []
+    with tempfile.TemporaryDirectory() as spool:
+        # the solo baseline FIRST: one session, fresh service, no
+        # concurrency — the count every served lane must reproduce
+        # bit-identically
+        for arm in ("solo", "serve"):
+            os.makedirs(os.path.join(spool, arm), exist_ok=True)
+        solo_svc = CheckService(
+            spool_dir=os.path.join(spool, "solo"), warm_start=False
+        )
+        solo = solo_svc.check(list(lane))
+        if solo.state != "done":
+            print(f"solo baseline failed: {solo.error}",
+                  file=sys.stderr)
+            return 1, None
+        baseline_unique = solo.unique
+
+        service = CheckService(
+            spool_dir=os.path.join(spool, "serve")
+        )
+        server = service.http_server("127.0.0.1", 0)
+        port = server.server_address[1]
+        server_thread = threading.Thread(
+            target=server.serve_forever, daemon=True
+        )
+        server_thread.start()
+        scrape = None
+        status_metrics = None
+        try:
+            phase_of = {}
+            responses = []
+            for name, clients in phases:
+                print(f"  phase {name}: {clients} client(s) x "
+                      f"'{' '.join(lane)}'")
+                if name == "spike":
+                    # mid-spike acceptance scrape: launch the fleet,
+                    # read /.metrics + /.status while it runs
+                    results = {}
+
+                    def run(i):
+                        results[i] = _post_check(port, lane)
+
+                    threads = [
+                        threading.Thread(target=run, args=(i,))
+                        for i in range(clients)
+                    ]
+                    for t in threads:
+                        t.start()
+                    time.sleep(0.2)
+                    scrape = _get(port, "/.metrics")
+                    # status_block() — what /.status embeds as
+                    # "service" when an Explorer is mounted
+                    status_metrics = _json.loads(
+                        _get(port, "/.serve/sessions")
+                    ).get("metrics")
+                    for t in threads:
+                        t.join()
+                    batch = [results[i] for i in range(clients)]
+                else:
+                    batch = _phase_fleet(port, lane, clients)
+                for resp in batch:
+                    responses.append(resp)
+                    sid = (resp.get("session") or {}).get("session")
+                    phase_of[sid] = name
+        finally:
+            server.shutdown()
+
+        # -- every served lane bit-identical to the solo run --------
+        for resp in responses:
+            sess = resp.get("session") or {}
+            if not resp.get("ok"):
+                failures.append(
+                    f"session {sess.get('session')} failed: "
+                    f"{sess.get('error')}"
+                )
+            elif sess.get("unique") != baseline_unique:
+                failures.append(
+                    f"count divergence: session "
+                    f"{sess.get('session')} unique="
+                    f"{sess.get('unique')} vs solo "
+                    f"{baseline_unique}"
+                )
+        if not failures:
+            print(f"  counts: unique={baseline_unique:,} on every "
+                  f"served session == the solo baseline")
+
+        # -- the live scrape must serve the named families -----------
+        missing = [f for f in REQUIRED_FAMILIES
+                   if scrape is None or f not in scrape]
+        if missing:
+            failures.append(
+                f"/.metrics scrape missing families: {missing}"
+            )
+        else:
+            print(f"  /.metrics scrape: all "
+                  f"{len(REQUIRED_FAMILIES)} required families live")
+        if not isinstance(status_metrics, dict) or not {
+            "active_sessions", "queue_depth", "refusals",
+            "ttv_p99_sec",
+        } <= set(status_metrics):
+            failures.append(
+                f"/.status metrics block incomplete: "
+                f"{status_metrics}"
+            )
+
+        # -- per-phase percentiles, exact AND bucket-interpolated ----
+        summary = serve_summary(service.events())
+        ttv_of = {
+            s["session"]: s.get("time_to_verdict_sec")
+            for s in summary["sessions"]
+        }
+        phase_rows = []
+        for name, clients in phases:
+            ttvs = [v for sid, v in sorted(ttv_of.items())
+                    if phase_of.get(sid) == name and v is not None]
+            hist = Histogram("phase_ttv", "", threading.Lock())
+            for v in ttvs:
+                hist.observe(v)
+            phase_rows.append(dict(
+                phase=name,
+                clients=clients,
+                sessions=len(ttvs),
+                ttv_p50_sec=quantile(ttvs, 0.50),
+                ttv_p99_sec=quantile(ttvs, 0.99),
+                ttv_p50_bucket_sec=hist.quantile(0.50),
+                ttv_p99_bucket_sec=hist.quantile(0.99),
+            ))
+        print(f"  {'phase':<8s} {'n':>3s} {'p50':>10s} {'p99':>10s} "
+              f"{'p50(bkt)':>10s} {'p99(bkt)':>10s}")
+        for row in phase_rows:
+            print(
+                f"  {row['phase']:<8s} {row['sessions']:>3d} "
+                f"{row['ttv_p50_sec']:>10.4f} "
+                f"{row['ttv_p99_sec']:>10.4f} "
+                f"{row['ttv_p50_bucket_sec']:>10.4f} "
+                f"{row['ttv_p99_bucket_sec']:>10.4f}"
+            )
+
+        # -- the SLO gate -------------------------------------------
+        families = service.metrics.snapshot()
+        observed = slo_observed(families)
+        evaluation = evaluate_slo(slo_spec, observed)
+        for o in evaluation["objectives"]:
+            print(
+                f"  slo {o['objective']}: observed "
+                f"{o['observed']}{o['unit']} {o['op']} "
+                f"{o['threshold']}{o['unit']} -> {o['status']}"
+            )
+        print(f"  slo gate: {'OK' if evaluation['ok'] else 'FAILED'}")
+        if not evaluation["ok"]:
+            failures.append("SLO gate failed")
+
+        doc = dict(
+            lane=" ".join(lane),
+            phases=phase_rows,
+            solo_unique=baseline_unique,
+            spec=slo_spec,
+            observed=observed,
+            evaluation=evaluation,
+            status_metrics=status_metrics,
+        )
+        if json_out:
+            jsonl, _chrome = service.write_trace(root=root)
+            summary = dict(
+                summary,
+                trace=os.path.basename(jsonl),
+                sustained=doc,
+            )
+            serve_path = write_serve_artifact(
+                summary, root=root, metrics=families
+            )
+            slo_path = write_slo_artifact(
+                dict(doc, serve_artifact=os.path.basename(serve_path),
+                     trace=os.path.basename(jsonl)),
+                root=root,
+            )
+            print(f"\nwrote {jsonl}\nwrote {serve_path}"
+                  f"\nwrote {slo_path}")
+
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    return (1 if failures else 0), doc
 
 
 def main():
     ap = argparse.ArgumentParser(
-        description="N-client wave-batching A/B against the "
-        "resident checking service"
+        description="wave-batching A/B or sustained "
+        "ramp->spike->drain SLO run against the resident checking "
+        "service"
     )
     ap.add_argument("--clients", type=int, default=4)
     ap.add_argument(
@@ -119,9 +388,33 @@ def main():
         help='lane argv, e.g. "2pc check-tpu 4" (default)',
     )
     ap.add_argument(
+        "--sustained", action="store_true",
+        help="ramp->spike->drain against ONE live service over HTTP "
+        "with the mid-spike /.metrics scrape and the SLO gate",
+    )
+    ap.add_argument("--ramp", type=int, default=2,
+                    help="ramp-phase clients (sustained mode)")
+    ap.add_argument("--spike", type=int, default=None,
+                    help="spike-phase clients (default: --clients)")
+    ap.add_argument("--drain", type=int, default=2,
+                    help="drain-phase clients (sustained mode)")
+    ap.add_argument("--slo-ttv-p50", type=float, default=None,
+                    help="SLO: max p50 time-to-verdict (seconds)")
+    ap.add_argument("--slo-ttv-p99", type=float, default=600.0,
+                    help="SLO: max p99 time-to-verdict (seconds)")
+    ap.add_argument("--slo-max-refusal-rate", type=float,
+                    default=0.0, help="SLO: max admission refusal "
+                    "rate (0..1)")
+    ap.add_argument("--slo-max-queue-wait-p99", type=float,
+                    default=600.0,
+                    help="SLO: max p99 device-queue wait (seconds)")
+    ap.add_argument("--slo-min-cache-hit-rate", type=float,
+                    default=None,
+                    help="SLO: min warm-start cache-hit rate (0..1)")
+    ap.add_argument(
         "--json", action="store_true",
-        help="export the batched TRACE_r* pair and write an "
-        "auto-numbered SERVE_r*.json with the A/B embedded",
+        help="export the TRACE_r* pair and write auto-numbered "
+        "SERVE_r*.json (+ SLO_r*.json in sustained mode)",
     )
     ap.add_argument(
         "--root", default=None,
@@ -129,6 +422,30 @@ def main():
     )
     args = ap.parse_args()
     lane = args.lane.split()
+
+    if args.sustained:
+        spec = dict(
+            max_ttv_p50_sec=args.slo_ttv_p50,
+            max_ttv_p99_sec=args.slo_ttv_p99,
+            max_refusal_rate=args.slo_max_refusal_rate,
+            max_queue_wait_p99_sec=args.slo_max_queue_wait_p99,
+            min_cache_hit_rate=args.slo_min_cache_hit_rate,
+        )
+        spec = {k: v for k, v in spec.items() if v is not None}
+        phases = [
+            ("ramp", args.ramp),
+            ("spike", args.spike or args.clients),
+            ("drain", args.drain),
+        ]
+        print(
+            f"serve loadtest (sustained): "
+            f"{'/'.join(str(c) for _, c in phases)} clients "
+            f"ramp/spike/drain x '{args.lane}'"
+        )
+        code, _doc = run_sustained(
+            lane, phases, spec, json_out=args.json, root=args.root
+        )
+        return code
 
     from stateright_tpu.serve import (
         CheckService,
@@ -213,7 +530,10 @@ def main():
         if args.json:
             jsonl, _chrome = batched_svc.write_trace(root=args.root)
             summary["trace"] = os.path.basename(jsonl)
-            path = write_serve_artifact(summary, root=args.root)
+            path = write_serve_artifact(
+                summary, root=args.root,
+                metrics=batched_svc.metrics.snapshot(),
+            )
             print(f"\nwrote {jsonl}\nwrote {path}")
     return 0
 

@@ -94,6 +94,22 @@ def format_report(summary: dict) -> str:
                 "      explorer: 0 requests"
             )
 
+    # the aggregate quantiles ride the ONE shared implementation
+    # (stateright_tpu/metrics.py quantile — the same function
+    # serve_loadtest.py and the SLO gate use), so the report and the
+    # gate cannot disagree on what "p99" means
+    from stateright_tpu.metrics import quantile
+
+    ttvs = [s.get("time_to_verdict_sec") for s in sessions
+            if s.get("time_to_verdict_sec") is not None]
+    if len(ttvs) >= 2:
+        lines.append("")
+        lines.append(
+            f"  time-to-verdict: p50 {_sec(quantile(ttvs, 0.50))} / "
+            f"p99 {_sec(quantile(ttvs, 0.99))} "
+            f"across {len(ttvs)} session(s)"
+        )
+
     wvc = summary.get("warm_vs_cold") or []
     if wvc:
         lines.append("")

@@ -230,6 +230,8 @@ def compile_actor_model(
     closure_max_states: int = 1 << 21,
     device_rewrite_spec=None,
     ample_mask=None,
+    optimize: bool = True,
+    pair_width_hint: Optional[int] = None,
 ) -> "CompiledActorEncoding":
     """Compile ``model`` into a TPU :class:`EncodedModel`.
 
@@ -275,6 +277,33 @@ def compile_actor_model(
     ``ample_mask`` is a packed slot-word tuple (ops/bitmask.py layout)
     for the static ample-set filter; the caller owns its soundness
     argument (see encoding.SymmetricEncodedModel / ample_mask_host).
+
+    ``optimize`` (default True) runs the post-``_build_tables`` codegen
+    optimizer (round 23, PERF.md §compiled-parity): effect-class fusion
+    (the deliver/timeout switch ladder collapses when the transition
+    tables subsume it), flat-table interning + constant-column pruning
+    (duplicate effect blocks share storage; host-constant table columns
+    become immediates instead of gather lanes), history/crash gather
+    elision, and word-level enabled-mask assembly from condition-gated
+    host class masks (ops/bitmask.py builders — the hand encodings'
+    predicate idiom). Semantics are identical either way (the
+    differential tests run both); ``optimize=False`` keeps the naive
+    emission for A/B ablation. The applied rewrites are reported in
+    ``encoding.codegen_opt``.
+
+    ``pair_width_hint`` declares a static bound on simultaneously
+    enabled action slots per state — the EV the sparse engines size
+    their per-row peel from. Unhinted UNORDERED compilations default
+    to EV = K (every envelope slot), which makes the pair
+    mask+peel+compact stage pay for slots that can never co-occur
+    (PERF.md §compiled-parity: the production 2pc peel at EV=27 vs
+    the hand encoding's declared 15). The caller owns the bound's
+    argument (e.g. a bijection with a hand encoding's reasoning); an
+    under-declared bound fails LOUDLY, not wrongly — the engines
+    detect peel overflow, warn, and resize-and-retry from the
+    measured peak (a recompile, never dropped pairs). Reachable-mode
+    compilations measure the exact peak over the harvested space
+    automatically; the declaration overrides even that.
     """
     return CompiledActorEncoding(
         model,
@@ -288,6 +317,8 @@ def compile_actor_model(
         closure_queue_bound=closure_queue_bound,
         device_rewrite_spec=device_rewrite_spec,
         ample_mask=ample_mask,
+        optimize=optimize,
+        pair_width_hint=pair_width_hint,
     )
 
 
@@ -305,9 +336,19 @@ class CompiledActorEncoding(EncodedModelBase):
         closure_queue_bound=None,
         device_rewrite_spec=None,
         ample_mask=None,
+        optimize: bool = True,
+        pair_width_hint: Optional[int] = None,
     ):
         if closure_mode not in ("overapprox", "reachable"):
             raise ValueError(f"unknown closure mode {closure_mode!r}")
+        if pair_width_hint is not None and pair_width_hint < 1:
+            raise ValueError(
+                f"pair_width_hint must be >= 1, got {pair_width_hint}"
+            )
+        self._pair_width_decl = pair_width_hint
+        #: reachable-mode measured enabled-slot peak (None until the
+        #: harvest runs; stays None in overapprox mode)
+        self._pair_width_auto: Optional[int] = None
         self.ordered = isinstance(model._init_network, Ordered)
         self._queue_bound_decl = closure_queue_bound
         if self.ordered:
@@ -355,6 +396,10 @@ class CompiledActorEncoding(EncodedModelBase):
         self._close()
         self._build_layout()
         self._build_tables()
+        self._opt = None
+        self.codegen_opt = None
+        if optimize:
+            self._optimize_codegen()
         self._spec = device_rewrite_spec
         self._ample_mask = ample_mask
         if device_rewrite_spec is not None:
@@ -427,6 +472,17 @@ class CompiledActorEncoding(EncodedModelBase):
             )
             if self.ordered
             else None,
+            # The resolved EV bound shapes the engines' pair buffers
+            # and peel loop — two compilations with different hints
+            # must not share a chunk program (the engine's program key
+            # reads the encoding's cache_key, not pair_width_hint).
+            self.pair_width_hint,
+            # The optimizer changes the traced emission (table shapes,
+            # gather columns, mask assembly); optimized and naive
+            # compilations of the same model must not share a chunk
+            # program. The plan itself is a deterministic function of
+            # the tables (already keyed above), so a flag suffices.
+            "codegen-opt-v1" if self._opt is not None else "naive",
         )
 
     # -- closure ---------------------------------------------------------
@@ -677,11 +733,20 @@ class CompiledActorEncoding(EncodedModelBase):
         queue = deque([init])
         #: ordered only: per-channel max observed queue length
         self._q_bound: dict = {}
+        # Enabled-slot peak over the harvested space: the harvest IS
+        # the device space in reachable mode, so the observed peak is
+        # an exact EV bound for the sparse engines' per-row peel
+        # (pair_width_hint). Counted conservatively — drops over ALL
+        # present envelopes, timers/crashes without liveness gating —
+        # so it can only over-approximate the bitmap popcount; the
+        # engines' peel-overflow guard resize-retries loudly anyway.
+        peak_enabled = 0
         while queue:
             st = queue.popleft()
             for i, s in enumerate(st.actor_states):
                 add_actor_state(i, s)
-            for env in set(st.network.iter_all()):
+            present = set(st.network.iter_all())
+            for env in present:
                 add_envelope(env)
             if self.ordered:
                 for ch, flow in st.network.flows.items():
@@ -692,6 +757,11 @@ class CompiledActorEncoding(EncodedModelBase):
                 for t in timers:
                     add_timer(i, t)
             add_history(st.history)
+            n_enabled = (len(present) if self.lossy else 0) + sum(
+                len(t) for t in st.timers_set
+            )
+            if self.max_crashes and sum(st.crashed) < self.max_crashes:
+                n_enabled += self.n - sum(st.crashed)
             prev_channel = None
             for env in st.network.iter_deliverable():
                 i = int(env.dst)
@@ -705,6 +775,7 @@ class CompiledActorEncoding(EncodedModelBase):
                     if prev_channel == channel:
                         continue
                     prev_channel = channel
+                n_enabled += 1
                 run_msg(i, st.actor_states[i], env)
                 tr = self._msg_tr[(i, st.actor_states[i], env)]
                 if self.ordered or not tr[1]:
@@ -715,6 +786,7 @@ class CompiledActorEncoding(EncodedModelBase):
                     tr = self._tmo_tr[(i, st.actor_states[i], t)]
                     if not tr[1]:
                         run_history(st.history, None, tr[2])
+            peak_enabled = max(peak_enabled, n_enabled)
             for action in model.actions(st):
                 ns = model.next_state(st, action)
                 if ns is None or not model.within_boundary(ns):
@@ -729,6 +801,7 @@ class CompiledActorEncoding(EncodedModelBase):
                         )
                     seen.add(ns)
                     queue.append(ns)
+        self._pair_width_auto = max(1, peak_enabled)
 
     def _declared_queue_bound(self, ch) -> int:
         """Resolve ``closure_queue_bound`` for channel ``ch`` =
@@ -1194,6 +1267,646 @@ class CompiledActorEncoding(EncodedModelBase):
             mask_slots.append(("crash", i))
         self._mask_slots = mask_slots
 
+    # -- codegen optimizer (round 23) -------------------------------------
+
+    def _optimize_codegen(self) -> None:
+        """Post-``_build_tables`` table/emission rewrite (PERF.md
+        §compiled-parity): computes a host-side plan that the optimized
+        ``enabled_bits_vec`` / ``step_slot_vec`` emissions trace from,
+        leaving the naive tables (and the dense ``step_vec``) intact as
+        the differential baseline.
+
+        * **Effect-class fusion** — deliver and timeout collapse into
+          ONE table class: timeout rows carry all-zero channel/envelope
+          params, so the deliver formula (nondup decrement, ordered
+          head pop) degenerates to the identity on them and the kind
+          switch disappears. The drop and crash branches are emitted
+          only when such slots exist; with neither, the 4-way select
+          ladder and the kind column vanish entirely.
+        * **Table interning + constant-column pruning** — duplicate
+          per-state effect blocks share one flat base (host interning
+          by block bytes); table columns that are constant over every
+          row become immediates, shrinking the two row gathers to the
+          columns that actually vary. A params/flat gather whose every
+          read column is constant is dropped altogether.
+        * **History / crash elision** — a single-valued, fully
+          harvested history domain drops the packed history gather,
+          the history field write, and the hard-truncation flag; a
+          crash-free model drops the crash AND-mask gather and the
+          per-actor crashed gating on deliver guards.
+        * **Word-level mask plan** — the enabled mask is rebuilt from
+          condition-gated host class masks (ops/bitmask.py:
+          ``slot_mask_host`` / ``or_class_words`` /
+          ``select_words_host`` — the PR-2 hand-encoding lever) with
+          single-bit presence extracts coalesced into word runs
+          (``bit_run_plan``), instead of per-slot lane predicates.
+        """
+        from ..ops.bitmask import bit_run_plan, mask_words, slot_mask_host
+
+        W = self.width
+        A = self.max_actions
+        real = [
+            a for a in range(A)
+            if self._sp_params[a, 0] != self._SK_PAD
+        ]
+        table_slots = [
+            a for a in real
+            if self._sp_params[a, 0]
+            in (self._SK_DELIVER, self._SK_TIMEOUT)
+        ]
+        if not table_slots:
+            return  # degenerate encoding: nothing to rewrite
+        hist = self._sp_hist_flat
+        trivial_history = (
+            len(self.H) == 1
+            and not (hist >> np.uint32(31)).any()
+            and not (hist & np.uint32(0x7FFFFFFF)).any()
+        )
+        has_drop = any(
+            self._sp_params[a, 0] == self._SK_DROP for a in real
+        )
+        has_crash = any(
+            self._sp_params[a, 0] == self._SK_CRASH for a in real
+        )
+
+        # (a) flat-block interning: duplicate effect blocks share one
+        # base row (paxos-style identical-effect envelopes); a dead
+        # history-class column is zeroed first so it can't defeat
+        # sharing.
+        params = self._sp_params.copy()
+        fw = self._sp_flat.shape[1]
+        blocks: dict = {}
+        new_rows: list = []
+        for a in table_slots:
+            i = int(params[a, 1])
+            ns = len(self.S[i])
+            base = int(params[a, 2])
+            blk = self._sp_flat[base : base + ns].copy()
+            if trivial_history:
+                blk[:, 2] = 0
+            key = blk.tobytes()
+            if key not in blocks:
+                blocks[key] = len(new_rows)
+                new_rows.extend(blk)
+            params[a, 2] = blocks[key]
+        flat2 = (
+            np.stack(new_rows).astype(np.uint32)
+            if new_rows
+            else np.zeros((1, fw), np.uint32)
+        )
+
+        # Constant-column pruning over the columns the emission READS
+        # (the noop column is never read by the sparse step; send
+        # columns only exist for ordered networks). Pad params rows are
+        # rewritten to copies of a real row first — they are never
+        # enabled, never stepped, and must not defeat constancy.
+        for a in range(A):
+            if self._sp_params[a, 0] == self._SK_PAD:
+                params[a] = params[real[0]]
+
+        read_f = [0]
+        if not trivial_history:
+            read_f.append(2)
+        read_f += [3 + j for j in range(3 * W)]
+        if self.ordered:
+            read_f += [3 + 3 * W + j for j in range(2 * self._smax)]
+        keep_f: list = []
+        fcol: dict = {}
+        for c in read_f:
+            col = flat2[:, c]
+            if (col == col[0]).all():
+                fcol[c] = ("c", int(col[0]))
+            else:
+                fcol[c] = ("v", len(keep_f))
+                keep_f.append(c)
+        flat_opt = flat2[:, keep_f] if keep_f else None
+
+        read_p = [2, 3, 4, 5]
+        if has_drop or has_crash:
+            read_p.insert(0, 0)
+        if has_crash:
+            read_p += [1, 9, 10]
+        if self.ordered:
+            read_p += [6, 7, 8, 11]
+        elif (not self.dup) or has_drop:
+            read_p += [6, 7, 8]
+        if flat_opt is None:
+            read_p.remove(2)  # no flat gather left to base-index
+        keep_p: list = []
+        pcol: dict = {}
+        for c in sorted(read_p):
+            col = params[:, c]
+            if (col == col[0]).all():
+                pcol[c] = ("c", int(col[0]))
+            else:
+                pcol[c] = ("v", len(keep_p))
+                keep_p.append(c)
+        params_opt = params[:, keep_p] if keep_p else None
+
+        # (b) the word-level mask plan: guard groups keyed by (actor,
+        # packed not-noop table, crash gating) — every slot of a group
+        # shares ONE traced condition; single-bit presence sources
+        # (dup envelope bits, timer armed bits) coalesce into runs.
+        L = mask_words(A)
+        groups: dict = {}
+        run_sources: list = []
+        slot_pres: list = []
+        pres_const_slots: list = []
+        guardless: list = []
+        crash_conds: list = []
+        gate = self.max_crashes > 0
+        for a, spec in enumerate(self._mask_slots):
+            kind = spec[0]
+            if kind == "deliver":
+                _, i, k, nn = spec
+                groups.setdefault((i, nn, gate), []).append(a)
+                if self.ordered:
+                    slot_pres.append((a, ("ord", k)))
+                elif self.dup and self.f_net[k].bits == 1:
+                    f = self.f_net[k]
+                    run_sources.append((a, f.lane, f.shift))
+                else:
+                    slot_pres.append((a, ("net", k)))
+            elif kind == "timeout":
+                _, i, j, nn = spec
+                groups.setdefault((i, nn, False), []).append(a)
+                ft = self.f_timer[i][j]
+                run_sources.append((a, ft.lane, ft.shift))
+            elif kind == "drop":
+                k = spec[1]
+                guardless.append(a)
+                if self.dup and self.f_net[k].bits == 1:
+                    f = self.f_net[k]
+                    run_sources.append((a, f.lane, f.shift))
+                else:
+                    slot_pres.append((a, ("net", k)))
+            else:  # crash
+                i = spec[1]
+                pres_const_slots.append(a)
+                crash_conds.append((i, slot_mask_host(A, [a])))
+
+        # Small-domain actors with several ungated guard groups fold
+        # into ONE select_words_host row table (one where-chain over
+        # the domain replaces all that actor's bit_selects); everyone
+        # else stays a bit_select-gated class.
+        by_actor: dict = {}
+        for (i, nn, g), slots in sorted(groups.items()):
+            by_actor.setdefault(i, []).append((nn, g, slots))
+        sel_actors: dict = {}
+        bitsel: list = []
+        for i, gs in sorted(by_actor.items()):
+            ns = len(self.S[i])
+            if ns <= 16 and len(gs) >= 2 and not any(g for _, g, _ in gs):
+                rows = []
+                for v in range(ns):
+                    w = [0] * L
+                    for nn, _, slots in gs:
+                        if (nn[v // 32] >> (v % 32)) & 1:
+                            sw = slot_mask_host(A, slots)
+                            for x in range(L):
+                                w[x] |= sw[x]
+                    rows.append(tuple(w))
+                sel_actors[i] = rows
+            else:
+                for nn, g, slots in gs:
+                    bitsel.append((i, nn, g, slot_mask_host(A, slots)))
+
+        runs = bit_run_plan(A, run_sources)
+        self._opt = dict(
+            trivial_history=trivial_history,
+            has_drop=has_drop,
+            has_crash=has_crash,
+            params=params_opt,
+            pcol=pcol,
+            flat=flat_opt,
+            fcol=fcol,
+            mask=dict(
+                runs=runs,
+                slot_pres=slot_pres,
+                pres_const=slot_mask_host(A, pres_const_slots),
+                guardless=slot_mask_host(A, guardless),
+                sel_actors=sel_actors,
+                bitsel=bitsel,
+                crash_conds=crash_conds,
+            ),
+        )
+        self.codegen_opt = {
+            "fused_switch": not (has_drop or has_crash),
+            "history_gather_elided": trivial_history,
+            "crash_gather_elided": not has_crash,
+            "flat_rows": [int(self._sp_flat.shape[0]),
+                          int(flat2.shape[0])],
+            "flat_cols": [int(fw), len(keep_f)],
+            "params_cols": [14, len(keep_p)],
+            "step_gathers": (
+                int(params_opt is not None)
+                + int(flat_opt is not None)
+                + int(not trivial_history)
+                + int(has_crash)
+            ),
+            "mask_guard_selects": len(sel_actors),
+            "mask_guard_classes": len(bitsel),
+            "mask_bit_runs": len(runs),
+            "mask_per_slot": len(slot_pres),
+            "k": int(A),
+        }
+
+    def _enabled_bits_opt(self, vec):
+        """Optimized mask emission: presence words (coalesced bit runs
+        + per-slot leftovers + crash constants) AND guard words (per
+        small-domain-actor row selects | condition-gated classes) —
+        O(L x classes) lane ops, zero gathers, no dense bool."""
+        import jax.numpy as jnp
+
+        from ..ops.bitmask import (
+            bit_select,
+            const_words,
+            mask_words,
+            or_bit_runs,
+            or_class_words,
+            select_words_host,
+        )
+
+        u32 = jnp.uint32
+        mp = self._opt["mask"]
+        L = mask_words(self.max_actions)
+
+        need_idx = set(mp["sel_actors"]) | {c[0] for c in mp["bitsel"]}
+        s_idx = {
+            i: self._get_actor_idx(vec, i, jnp)
+            for i in sorted(need_idx)
+        }
+        need_cr = {c[0] for c in mp["bitsel"] if c[2]} | {
+            i for i, _ in mp["crash_conds"]
+        }
+        crashed = {
+            i: self._get_field(vec, self.f_crashed[i], jnp) != 0
+            for i in sorted(need_cr)
+        }
+        if mp["crash_conds"]:
+            allc = [
+                self._get_field(vec, self.f_crashed[i], jnp) != 0
+                for i in range(self.n)
+            ]
+            ncr = allc[0].astype(u32)
+            for c in allc[1:]:
+                ncr = ncr + c.astype(u32)
+            can_crash = ncr < u32(self.max_crashes)
+
+        pres = or_bit_runs(jnp, vec, mp["runs"], L)
+
+        def fx(f):
+            return (vec[f.lane] >> u32(f.shift)) & u32(
+                (1 << f.bits) - 1
+            )
+
+        for a, spec in mp["slot_pres"]:
+            if spec[0] == "ord":
+                env = self.E[spec[1]]
+                ch = (env.src, env.dst)
+                b = (
+                    fx(self.f_ch[self.chidx[ch]])
+                    % u32(self.ch_base[ch])
+                ) == u32(self.ch_code[ch][env.msg])
+            else:
+                b = fx(self.f_net[spec[1]]) != 0
+            w, p = a // 32, a % 32
+            t = b.astype(u32)
+            if p:
+                t = t << u32(p)
+            pres[w] = t if pres[w] is None else pres[w] | t
+        for w in range(L):
+            cw = mp["pres_const"][w]
+            if cw:
+                pres[w] = (
+                    u32(cw) if pres[w] is None else pres[w] | u32(cw)
+                )
+
+        guard = None
+        for i, rows in sorted(mp["sel_actors"].items()):
+            term = select_words_host(jnp, rows, s_idx[i])
+            guard = term if guard is None else guard | term
+        classes = []
+        for i, nn, g, words in mp["bitsel"]:
+            cond = bit_select(jnp, nn, s_idx[i]) != 0
+            if g:
+                cond = cond & ~crashed[i]
+            classes.append((cond, words))
+        for i, words in mp["crash_conds"]:
+            classes.append((~crashed[i] & can_crash, words))
+        if classes:
+            cls = or_class_words(jnp, classes, L)
+            if L == 1 and cls.ndim == 1:
+                # or_class_words restores the [L] row contract at its
+                # end; at L=1 the guard chain must stay SCALAR — a
+                # [1]-shaped `or` is real compute at 128x lane
+                # padding (the no-lane-padded-alu rule). Static index
+                # = slice+squeeze, not a gather.
+                cls = cls[0]
+            guard = cls if guard is None else guard | cls
+        if any(mp["guardless"]):
+            gw = const_words(jnp, mp["guardless"])
+            guard = gw if guard is None else guard | gw
+
+        # Per-word scalar AND before the single update-slice per word:
+        # vmapped math stays [N]-shaped (no [N, 1] ALU; the same
+        # discipline as the naive emission and the hand encodings).
+        # At L=1 `guard` is a scalar (every builder degenerates to
+        # scalar words there); at L>1 it is a [L] row indexed
+        # statically per word.
+        out = jnp.zeros(L, u32)
+        for w in range(L):
+            if pres[w] is None:
+                continue
+            word = pres[w]
+            if guard is not None:
+                word = word & (guard if L == 1 else guard[w])
+            out = out.at[w].set(word)
+        return out
+
+    def _step_slot_opt(self, vec, slot):
+        """Optimized step emission traced from the ``_opt`` plan: the
+        surviving row gathers (pruned params/flat columns), fused
+        deliver/timeout table path, branch ladder only over the effect
+        classes that exist, and lane writes only on lanes some effect
+        can touch."""
+        import jax.numpy as jnp
+
+        xp = jnp
+        W = self.width
+        u32 = xp.uint32
+        plan = self._opt
+        slot = slot.astype(u32)
+        prow = (
+            xp.asarray(plan["params"])[slot]
+            if plan["params"] is not None
+            else None
+        )
+
+        def pc(c):
+            tag, v = plan["pcol"][c]
+            return v if tag == "c" else prow[v]
+
+        def tr(x):
+            return u32(x) if isinstance(x, int) else x
+
+        lanes = [vec[j] for j in range(W)]
+
+        def lane_sel(vals, idx):
+            if isinstance(idx, int):
+                return vals[idx]
+            v = vals[0]
+            for j in range(1, W):
+                v = xp.where(idx == j, vals[j], v)
+            return v
+
+        al, ash, am = pc(3), pc(4), pc(5)
+        s_idx = (lane_sel(lanes, al) >> tr(ash)) & tr(am)
+        if plan["flat"] is not None:
+            F = plan["flat"]
+            frow_i = xp.minimum(
+                tr(pc(2)) + s_idx, u32(F.shape[0] - 1)
+            )
+            frow = xp.asarray(F)[frow_i]
+
+        def fc(c):
+            tag, v = plan["fcol"][c]
+            return v if tag == "c" else frow[v]
+
+        def fconst(c):
+            tag, v = plan["fcol"][c]
+            return v if tag == "c" else None
+
+        nxt = fc(0)
+        trivial_h = plan["trivial_history"]
+        if not trivial_h:
+            h_idx = self._get_field(vec, self.f_history, xp)
+            hg = xp.asarray(self._sp_hist_flat)[
+                h_idx * u32(self.n_cls) + tr(fc(2))
+            ]
+            h2 = hg & u32(0x7FFFFFFF)
+            h_missing = (hg >> 31) != 0
+        hf = self.f_history
+        if isinstance(am, int) and isinstance(ash, int):
+            amask = u32((am << ash) & 0xFFFFFFFF)
+        else:
+            amask = tr(am) << tr(ash)
+        aval = (tr(nxt) & tr(am)) << tr(ash)
+
+        app = []
+        for j in range(W):
+            v = lanes[j]
+            if isinstance(al, int):
+                if al == j:
+                    v = (v & ~amask) | aval
+            else:
+                v = xp.where(al == j, (v & ~amask) | aval, v)
+            if fconst(3 + j) != 0:
+                d = tr(fc(3 + j))
+                v = (v | d) if self.dup else (v + d)
+            if not (
+                fconst(3 + W + j) == 0xFFFFFFFF
+                and fconst(3 + 2 * W + j) == 0
+            ):
+                v = (v & tr(fc(3 + W + j))) | tr(fc(3 + 2 * W + j))
+            if not trivial_h and j == hf.lane:
+                v = (v & ~u32(hf.mask)) | (
+                    (h2 & u32((1 << hf.bits) - 1)) << u32(hf.shift)
+                )
+            app.append(v)
+
+        ord_over = xp.bool_(False)
+        if self.ordered:
+            # FUSED deliver/timeout: the pop is no longer kind-gated —
+            # timeout rows carry zero channel params, so pop_amt is
+            # zero there by table construction.
+            base = xp.maximum(tr(pc(11)), u32(1))
+            nl, nsh, nm = pc(6), pc(7), pc(8)
+            qv = (lane_sel(app, nl) >> tr(nsh)) & tr(nm)
+            pop_amt = (qv - qv // base) << tr(nsh)
+            if isinstance(nl, int):
+                s_table = list(app)
+                s_table[nl] = app[nl] - pop_amt
+            else:
+                s_table = [
+                    app[j] - xp.where(nl == j, pop_amt, u32(0))
+                    for j in range(W)
+                ]
+            for j in range(self._smax):
+                if fconst(3 + 3 * W + self._smax + j) == 0:
+                    continue  # no row ever sends in this emission slot
+                chj = fc(3 + 3 * W + j)
+                cdj = tr(fc(3 + 3 * W + self._smax + j))
+                do = cdj > 0
+                adds: dict = {}
+                for cc in range(len(self.channels)):
+                    if isinstance(chj, int) and chj != cc:
+                        continue
+                    cch = self.channels[cc]
+                    cbase = self.ch_base[cch]
+                    Q = self.ch_q[cch]
+                    f = self.f_ch[cc]
+                    fmask = u32((1 << f.bits) - 1)
+                    q = (s_table[f.lane] >> u32(f.shift)) & fmask
+                    ln = sum(
+                        (q >= u32(cbase**p)).astype(u32)
+                        for p in range(Q)
+                    )
+                    powv = u32(0)
+                    for pp in range(Q):
+                        powv = xp.where(
+                            ln == pp, u32(cbase**pp), powv
+                        )
+                    sel = (
+                        do
+                        if isinstance(chj, int)
+                        else do & (chj == cc)
+                    )
+                    full = ln >= Q
+                    adds[f.lane] = adds.get(f.lane, u32(0)) + (
+                        xp.where(sel & ~full, cdj * powv, u32(0))
+                        << u32(f.shift)
+                    )
+                    ord_over = ord_over | (sel & full)
+                for lj, add in adds.items():
+                    s_table[lj] = s_table[lj] + add
+            s_drop = lanes
+        elif self.dup:
+            s_table = app
+            if plan["has_drop"]:
+                nl, nsh, nm = pc(6), pc(7), pc(8)
+                if isinstance(nm, int) and isinstance(nsh, int):
+                    nmask = u32((nm << nsh) & 0xFFFFFFFF)
+                else:
+                    nmask = tr(nm) << tr(nsh)
+                if isinstance(nl, int):
+                    s_drop = list(lanes)
+                    s_drop[nl] = lanes[nl] & ~nmask
+                else:
+                    s_drop = [
+                        xp.where(
+                            nl == j, lanes[j] & ~nmask, lanes[j]
+                        )
+                        for j in range(W)
+                    ]
+        else:
+            # FUSED deliver/timeout: timeout rows carry zero envelope
+            # params, so the post-delta decrement is the identity on
+            # them and the kind switch disappears.
+            nl, nsh, nm = pc(6), pc(7), pc(8)
+            if isinstance(nm, int) and isinstance(nsh, int):
+                nmask = u32((nm << nsh) & 0xFFFFFFFF)
+            else:
+                nmask = tr(nm) << tr(nsh)
+            ac = (lane_sel(app, nl) >> tr(nsh)) & tr(nm)
+            dec = ((ac - u32(1)) & tr(nm)) << tr(nsh)
+            if isinstance(nl, int):
+                s_table = list(app)
+                s_table[nl] = (app[nl] & ~nmask) | dec
+            else:
+                s_table = [
+                    xp.where(
+                        nl == j, (app[j] & ~nmask) | dec, app[j]
+                    )
+                    for j in range(W)
+                ]
+            if plan["has_drop"]:
+                vc = (lane_sel(lanes, nl) >> tr(nsh)) & tr(nm)
+                dc = ((vc - u32(1)) & tr(nm)) << tr(nsh)
+                if isinstance(nl, int):
+                    s_drop = list(lanes)
+                    s_drop[nl] = (lanes[nl] & ~nmask) | dc
+                else:
+                    s_drop = [
+                        xp.where(
+                            nl == j,
+                            (lanes[j] & ~nmask) | dc,
+                            lanes[j],
+                        )
+                        for j in range(W)
+                    ]
+
+        if plan["has_crash"]:
+            ai = xp.minimum(tr(pc(1)), u32(max(0, self.n - 1)))
+            crow = xp.asarray(self._sp_crash_and)[ai]
+            cl, csh = pc(9), pc(10)
+            if isinstance(cl, int):
+                s_crash = [lanes[j] & crow[j] for j in range(W)]
+                s_crash[cl] = (
+                    lanes[cl] | (u32(1) << tr(csh))
+                ) & crow[cl]
+            else:
+                s_crash = [
+                    xp.where(
+                        cl == j,
+                        lanes[j] | (u32(1) << tr(csh)),
+                        lanes[j],
+                    )
+                    & crow[j]
+                    for j in range(W)
+                ]
+
+        succ_lanes = list(s_table)
+        table_gate = None
+        if plan["has_drop"] or plan["has_crash"]:
+            kind = tr(pc(0))
+            if plan["has_drop"]:
+                is_drop = kind == u32(self._SK_DROP)
+                succ_lanes = [
+                    succ_lanes[j]
+                    if (
+                        s_drop[j] is lanes[j]
+                        and succ_lanes[j] is lanes[j]
+                    )
+                    else xp.where(is_drop, s_drop[j], succ_lanes[j])
+                    for j in range(W)
+                ]
+                table_gate = ~is_drop
+            if plan["has_crash"]:
+                is_crash = kind == u32(self._SK_CRASH)
+                succ_lanes = [
+                    xp.where(is_crash, s_crash[j], succ_lanes[j])
+                    for j in range(W)
+                ]
+                table_gate = (
+                    ~is_crash
+                    if table_gate is None
+                    else table_gate & ~is_crash
+                )
+
+        # Class-local writes: a lane no effect class can touch keeps
+        # its input row (no update-slice emitted for it).
+        succ = vec
+        for j in range(W):
+            if succ_lanes[j] is lanes[j]:
+                continue
+            succ = succ.at[j].set(succ_lanes[j])
+
+        if self.ordered:
+            trunc = (
+                ord_over
+                if table_gate is None
+                else table_gate & ord_over
+            )
+        elif self.dup:
+            trunc = xp.bool_(False)
+        else:
+            top = xp.bool_(False)
+            for j in range(W):
+                m = int(self._net_top_mask[j])
+                if m:
+                    top = top | ((succ_lanes[j] & u32(m)) != 0)
+            trunc = top if table_gate is None else table_gate & top
+        if trivial_h:
+            hard = xp.bool_(False)
+        else:
+            hard = (
+                h_missing
+                if table_gate is None
+                else table_gate & h_missing
+            )
+        return succ, trunc, hard
+
     @property
     def trivial_boundary(self) -> bool:
         """Lets the sparse engine skip the per-pair boundary pass and
@@ -1203,13 +1916,27 @@ class CompiledActorEncoding(EncodedModelBase):
     @property
     def pair_width_hint(self):
         """Static bound on enabled slots per state for the sparse
-        engine's per-row peel. Ordered networks have a tight one: only
-        each channel's HEAD is deliverable (one deliver slot per
-        channel), plus armed timers and crash slots — far below the
-        K = |E| deliver-slot universe (ABD 2c/3s: 16 vs K=110; the
-        unhinted EV=K sizing OOMed the engine's pair buffers).
-        Unordered networks have no useful static bound (any present
-        envelope is deliverable): None defers to the engine default."""
+        engine's per-row peel, resolved in priority order:
+
+        1. the DECLARED ``compile_actor_model(pair_width_hint=...)``
+           (the caller owns the bound's argument; the engines' peel
+           overflow guard warns and resize-retries if it ever
+           breaks — a recompile, never dropped pairs),
+        2. the reachable-mode harvested peak (exact for that mode:
+           the harvest explores the same space the device does),
+        3. ordered structure: only each channel's HEAD is deliverable
+           (one deliver slot per channel), plus armed timers and
+           crash slots — far below the K = |E| deliver-slot universe
+           (ABD 2c/3s: 16 vs K=110; the unhinted EV=K sizing OOMed
+           the engine's pair buffers).
+
+        Unhinted unordered overapprox compilations have no useful
+        static bound (any present envelope is deliverable): None
+        defers to the engine default EV = K."""
+        if self._pair_width_decl is not None:
+            return min(self._pair_width_decl, self.max_actions)
+        if self._pair_width_auto is not None:
+            return min(self._pair_width_auto, self.max_actions)
         if not self.ordered:
             return None
         return max(
@@ -1234,7 +1961,15 @@ class CompiledActorEncoding(EncodedModelBase):
         Semantics are the dense ``step_vec`` validity EXCEPT the
         count-bound poison, which ``step_slot_vec`` reports as its
         truncation flag (the engine excludes those pairs and raises
-        when in-boundary)."""
+        when in-boundary).
+
+        With the codegen optimizer active (compile_actor_model's
+        ``optimize``, the default) the emission is
+        :meth:`_enabled_bits_opt` — word-level assembly from
+        condition-gated class masks; this naive per-slot form is the
+        ``optimize=False`` ablation baseline."""
+        if self._opt is not None:
+            return self._enabled_bits_opt(vec)
         import jax.numpy as jnp
 
         from ..ops.bitmask import bit_select, mask_words
@@ -1322,7 +2057,13 @@ class CompiledActorEncoding(EncodedModelBase):
         under vmap, and the successor is assembled with static-lane
         selects — no stack-of-scalars concats, whose ``[N, 1]``
         operands pay the full 128-lane tile-padding tax on TPU
-        (PERF.md §ordered: ~470ms/run at abd-ordered shapes)."""
+        (PERF.md §ordered: ~470ms/run at abd-ordered shapes).
+
+        With the codegen optimizer active the emission is
+        :meth:`_step_slot_opt` (fused classes, pruned gather columns);
+        this form is the ``optimize=False`` ablation baseline."""
+        if self._opt is not None:
+            return self._step_slot_opt(vec, slot)
         import jax.numpy as jnp
 
         xp = jnp

@@ -604,6 +604,16 @@ class SortMergeTpuBfsChecker(TpuBfsChecker):
                                 if self._use_sparse() else K)
                 )
 
+    @property
+    def codegen_opt(self):
+        """Codegen-optimizer summary of the encoding this engine is
+        executing (actor/compile.py ``CompiledActorEncoding.codegen_opt``
+        — fused switch / elided gathers / table widths), or ``None``
+        for hand encodings and ``optimize=False`` compiles. One seam
+        for bench detail + provenance on both sort-merge engines (the
+        sharded engine inherits it)."""
+        return getattr(self.encoded, "codegen_opt", None)
+
     # -- auto budget (VERDICT r4 item 7) -----------------------------------
 
     def _budget_store(self):

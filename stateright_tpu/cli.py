@@ -8,7 +8,9 @@ dispatches the same surface for every bundled workload:
     python -m stateright_tpu 2pc check 3
     python -m stateright_tpu 2pc check-sym 5
     python -m stateright_tpu 2pc check-tpu 6          (wave engine)
+    python -m stateright_tpu 2pc-actors check-tpu 5   (compiled encoding)
     python -m stateright_tpu paxos check 2 [network]
+    python -m stateright_tpu paxos-compiled check-tpu (compiled encoding)
     python -m stateright_tpu paxos check-tpu 4 --trace    (run telemetry)
     python -m stateright_tpu paxos explore 2 localhost:3000
     python -m stateright_tpu paxos spawn
@@ -320,6 +322,106 @@ def _paxos(sub: str, args: list[str]) -> None:
         _usage("paxos")
 
 
+def _2pc_actors(sub: str, args: list[str]) -> None:
+    """The COMPILED 2pc family (round 23): the count-comparable
+    system actor model (models/two_phase_commit_actors.py
+    two_phase_sys_actor_model — host-parity pinned at the TwoPhaseSys
+    counts, 8,832 @ rm=5) through the generic actor→encoding
+    compiler's optimized codegen. Routing through ``_report`` gives
+    the compiled path ``--trace`` / ``--checkpoint-every`` / resume
+    for free, same as every hand lane."""
+    from .models.two_phase_commit_actors import (
+        two_phase_sys_actor_model,
+        two_phase_sys_compiled_encoded,
+    )
+
+    rm_count = _opt(args, 0, 2)
+    model = two_phase_sys_actor_model(rm_count)
+    if sub == "check":
+        print(
+            f"Checking two phase commit (compiled actor model) with "
+            f"{rm_count} resource managers."
+        )
+        _report(model.checker().spawn_dfs())
+    elif sub == "check-tpu":
+        print(
+            f"Checking two phase commit (compiled actor model) with "
+            f"{rm_count} resource managers on the TPU wave engine."
+        )
+        # Same pinned counts as the hand `2pc` lanes (~2.53 bits/RM),
+        # same snug-capacity sizing; the encoding comes from the
+        # compiler, not models/two_phase_commit_tpu.py.
+        import math
+
+        capacity = 1 << max(10, math.ceil(2.6 * rm_count + 1.5))
+        _report(
+            model.checker().spawn_tpu_sortmerge(
+                encoded=two_phase_sys_compiled_encoded(rm_count),
+                capacity=capacity,
+                frontier_capacity=max(256, capacity // 4),
+                cand_capacity="auto",
+            )
+        )
+    elif sub == "explore":
+        address = _opt(args, 1, "localhost:3000", parse=str)
+        print(
+            f"Exploring state space for two phase commit (compiled "
+            f"actor model) with {rm_count} resource managers on "
+            f"{address}."
+        )
+        model.checker().serve(address)
+    else:
+        _usage("2pc-actors")
+
+
+def _paxos_compiled(sub: str, args: list[str]) -> None:
+    """Compiled paxos (round 23): the actor paxos model through the
+    compiler in reachable mode — the compile pays ONE host
+    exploration of the space to harvest bounds, so this lane caps at
+    2 clients (the bench's production shape, 16,668 states)."""
+    from .models.paxos import (
+        PaxosModelCfg,
+        paxos_compiled_encoded,
+        paxos_model,
+    )
+
+    client_count = _opt(args, 0, 2)
+    cfg = PaxosModelCfg(client_count=client_count, server_count=3)
+    if sub == "check":
+        print(
+            f"Model checking Single Decree Paxos (compiled) with "
+            f"{client_count} clients."
+        )
+        _report(paxos_model(cfg).checker().spawn_dfs())
+    elif sub == "check-tpu":
+        if client_count > 2:
+            raise SystemExit(
+                f"paxos-compiled check-tpu supports 1-2 clients (got "
+                f"{client_count}): reachable-mode compilation "
+                "explores the space once on the host to harvest "
+                "bounds (models/paxos.py paxos_compiled_encoded), "
+                "which is impractical beyond the 16,668-state "
+                "2-client config"
+            )
+        print(
+            f"Model checking Single Decree Paxos (compiled) with "
+            f"{client_count} clients on the TPU wave engine."
+        )
+        _report(
+            paxos_model(cfg)
+            .checker()
+            .spawn_tpu_sortmerge(
+                encoded=paxos_compiled_encoded(cfg),
+                track_paths=client_count <= 2,
+                capacity=1 << 15,
+                frontier_capacity=1 << 13,
+                cand_capacity="auto",
+            )
+        )
+    else:
+        _usage("paxos-compiled")
+
+
 def _increment(sub: str, args: list[str]) -> None:
     from .models.increment import Increment
 
@@ -595,8 +697,10 @@ def _register(sub: str, args: list[str]) -> None:
 
 _MODELS = {
     "2pc": (_2pc, ["check", "check-sym", "check-tpu", "explore"]),
+    "2pc-actors": (_2pc_actors, ["check", "check-tpu", "explore"]),
     "register": (_register, ["check", "check-sym", "check-tpu", "explore"]),
     "paxos": (_paxos, ["check", "check-tpu", "explore", "spawn"]),
+    "paxos-compiled": (_paxos_compiled, ["check", "check-tpu"]),
     "increment": (_increment, ["check", "check-sym", "check-tpu", "explore"]),
     "increment-lock": (_increment_lock, ["check", "check-sym", "check-tpu", "explore"]),
     "single-copy-register": (_single_copy, ["check", "check-tpu", "explore", "spawn"]),

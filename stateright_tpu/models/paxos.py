@@ -281,7 +281,7 @@ def paxos_device_specs() -> dict:
 
 
 def paxos_compiled_encoded(cfg: PaxosModelCfg,
-                           network: Network | None = None):
+                           network: Network | None = None, **kw):
     """The compiled paxos encoding: the actor model through the
     generic actor→encoding compiler, zero hand-written device code.
     ``closure="reachable"`` (the harvest/bootstrap mode): paxos
@@ -296,4 +296,5 @@ def paxos_compiled_encoded(cfg: PaxosModelCfg,
         paxos_model(cfg, network),
         **paxos_device_specs(),
         closure="reachable",
+        **kw,
     )

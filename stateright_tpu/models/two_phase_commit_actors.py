@@ -158,6 +158,194 @@ def two_phase_actor_model(rm_count: int) -> ActorModel:
     )
 
 
+class SysRmActor(Actor):
+    """Resource manager of the COUNT-COMPARABLE reformulation (see
+    ``two_phase_sys_actor_model``): the timers are armed exactly while
+    WORKING and every transition out of WORKING cancels both, so the
+    timer bits are a function of the RM state and add no states."""
+
+    def __init__(self, tm_id: Id, index: int):
+        self.tm_id = tm_id
+        self.index = index
+
+    def on_start(self, id: Id, out: Out) -> int:
+        out.set_timer("prepare", model_timeout())
+        out.set_timer("abort", model_timeout())
+        return RM_WORKING
+
+    def on_timeout(self, id: Id, state: Cow, timer, out: Out) -> None:
+        s = state.value
+        if timer == "prepare" and s == RM_WORKING:
+            # rm_prepare: the Prepared announcement IS the plain
+            # model's ("prepared", rm) bag entry (dup network: the
+            # envelope bit is never consumed)
+            out.send(self.tm_id, Prepared(self.index))
+            out.cancel_timer("abort")
+            state.set(RM_PREPARED)
+        elif timer == "abort" and s == RM_WORKING:
+            # rm_choose_abort: silent
+            out.cancel_timer("prepare")
+            state.set(RM_ABORTED)
+
+    def on_msg(self, id: Id, state: Cow, src: Id, msg, out: Out) -> None:
+        s = state.value
+        # rm_rcv_commit / rm_rcv_abort fire from ANY undecided state
+        # in the plain model; the self-loops at the target state are
+        # pruned no-ops there and here
+        if isinstance(msg, Commit) and s != RM_COMMITTED:
+            if s == RM_WORKING:
+                out.cancel_timer("prepare")
+                out.cancel_timer("abort")
+            state.set(RM_COMMITTED)
+        elif isinstance(msg, Abort) and s != RM_ABORTED:
+            if s == RM_WORKING:
+                out.cancel_timer("prepare")
+                out.cancel_timer("abort")
+            state.set(RM_ABORTED)
+
+
+class SysTmActor(Actor):
+    """Transaction manager of the count-comparable reformulation: the
+    ``(phase, prepared-mask)`` local state mirrors the plain model's
+    ``(tm_state, tm_prepared)`` exactly; decision timers are armed
+    exactly while INIT."""
+
+    def __init__(self, rm_ids: list[Id]):
+        self.rm_ids = rm_ids
+
+    def on_start(self, id: Id, out: Out):
+        out.set_timer("commit", model_timeout())
+        out.set_timer("abort", model_timeout())
+        return (TM_INIT, 0)
+
+    def on_msg(self, id: Id, state: Cow, src: Id, msg, out: Out) -> None:
+        tm, mask = state.value
+        if isinstance(msg, Prepared) and tm == TM_INIT:
+            # tm_rcv_prepared: unconditional set — when the bit is
+            # already up this is the plain model's self-loop (Cow.set
+            # marks owned, so the transition exists and dedups away)
+            state.set((tm, mask | (1 << msg.rm)))
+
+    def on_timeout(self, id: Id, state: Cow, timer, out: Out) -> None:
+        tm, mask = state.value
+        full = (1 << len(self.rm_ids)) - 1
+        if timer == "commit":
+            if tm == TM_INIT and mask == full:
+                # tm_commit: the atomic broadcast is the single
+                # ("commit",) bag entry — all envelope bits rise
+                # together and are never consumed, one bit of info
+                out.broadcast(self.rm_ids, Commit())
+                out.cancel_timer("abort")
+                state.set((TM_COMMITTED, mask))
+            else:
+                out.set_timer("commit", model_timeout())
+        elif timer == "abort" and tm == TM_INIT:
+            # tm_abort
+            out.broadcast(self.rm_ids, Abort())
+            out.cancel_timer("commit")
+            state.set((TM_ABORTED, mask))
+
+
+def two_phase_sys_actor_model(rm_count: int) -> ActorModel:
+    """The COUNT-COMPARABLE actor reformulation of ``TwoPhaseSys``
+    (round 23, ROADMAP direction 5): over the UNORDERED DUPLICATING
+    network the compiled state space bijects with the plain model's —
+    ``(rm_state*, tm_state, tm_prepared)`` map to the local states,
+    the append-only ``msgs`` bag maps to the never-consumed envelope
+    presence bits, and the timer bits are functions of the local
+    states — so the pinned counts (288 / 1,568 / 8,832 / 50,816 /
+    296,448 at rm=3..7) reproduce bit-identically and the hand
+    encoding serves as a differential ORACLE for the compiled path
+    (tests/test_compiled_parity.py). Property names match the plain
+    model's so verdicts compare by name."""
+    tm = Id(rm_count)
+    model = ActorModel(cfg=rm_count, init_history=None)
+    model.add_actors(SysRmActor(tm, i) for i in range(rm_count))
+    model = model.actor(SysTmActor([Id(i) for i in range(rm_count)]))
+    return (
+        model.init_network(Network.new_unordered_duplicating())
+        .property(
+            Expectation.SOMETIMES,
+            "abort agreement",
+            lambda m, s: all(
+                x == RM_ABORTED for x in s.actor_states[: m.cfg]
+            ),
+        )
+        .property(
+            Expectation.SOMETIMES,
+            "commit agreement",
+            lambda m, s: all(
+                x == RM_COMMITTED for x in s.actor_states[: m.cfg]
+            ),
+        )
+        .property(
+            Expectation.ALWAYS,
+            "consistent",
+            lambda m, s: not (
+                any(x == RM_ABORTED for x in s.actor_states[: m.cfg])
+                and any(
+                    x == RM_COMMITTED for x in s.actor_states[: m.cfg]
+                )
+            ),
+        )
+    )
+
+
+def two_phase_sys_device_specs(rm_count: int) -> dict:
+    """Device property specs for ``two_phase_sys_actor_model`` — same
+    predicates as the plain model, evaluated over the RM actor codes."""
+
+    def rm_codes(ctx, jnp):
+        return ctx.actor_values(
+            lambda i, s: s if i < rm_count else 0
+        )[:rm_count]
+
+    def abort_agreement(ctx, jnp):
+        return jnp.all(rm_codes(ctx, jnp) == RM_ABORTED)
+
+    def commit_agreement(ctx, jnp):
+        return jnp.all(rm_codes(ctx, jnp) == RM_COMMITTED)
+
+    def consistent(ctx, jnp):
+        v = rm_codes(ctx, jnp)
+        return ~(
+            jnp.any(v == RM_ABORTED) & jnp.any(v == RM_COMMITTED)
+        )
+
+    return dict(
+        properties={
+            "abort agreement": abort_agreement,
+            "commit agreement": commit_agreement,
+            "consistent": consistent,
+        }
+    )
+
+
+def two_phase_sys_compiled_encoded(rm_count: int, **kw):
+    """One-call compiled encoding of the count-comparable model
+    (overapprox closure: the tiny per-actor domains need no host
+    exploration at any rm count).
+
+    ``pair_width_hint`` defaults to the hand encoding's per-row
+    enabled peak (two_phase_commit_tpu.py): the model is a
+    state-for-state bijection with TwoPhaseSys and the compiled
+    enabled bits are a subset of the hand slots' (no-op self-loops
+    prune), so the hand bound carries over — and the sparse engines'
+    peel-overflow guard warns and resize-retries if it ever breaks.
+    Without it EV defaults to K = 2+5*rm and the pair peel pays for
+    slots that never co-occur (PERF.md §compiled-parity)."""
+    from ..actor.compile import compile_actor_model
+
+    kw.setdefault(
+        "pair_width_hint", max(3 * rm_count, 2 * rm_count + 2)
+    )
+    return compile_actor_model(
+        two_phase_sys_actor_model(rm_count),
+        **two_phase_sys_device_specs(rm_count),
+        **kw,
+    )
+
+
 def two_phase_actor_device_specs(rm_count: int) -> dict:
     """Device property specs for ``compile_actor_model`` — the exact
     counterparts of the host properties above (the compiler requires a

@@ -171,6 +171,62 @@ def select_words_host(jnp, rows, idx):
     return acc
 
 
+def bit_run_plan(k: int, sources):
+    """Host planner for the compiled-codegen mask optimizer (round 20):
+    coalesce single-bit presence extracts into word-level runs.
+
+    ``sources`` is a sequence of ``(slot, lane, shift)`` triples — slots
+    whose enabled-presence is ONE state bit (duplicating-network
+    envelope bits, timer armed bits). Wherever consecutive slots read
+    consecutive shifts of the same lane (the layout builder allocates
+    1-bit fields in slot order, so maximal runs are the common case),
+    the whole run collapses to a single ``(vec[lane] >> shift) & mask``
+    instead of per-slot extracts. Runs never cross an OUTPUT word
+    boundary (slot 32 starts a new word). Returns a list of
+    ``(dst_word, dst_pos, lane, shift, nbits)`` covering every source
+    exactly once; :func:`or_bit_runs` assembles them."""
+    runs = []
+    cur = None  # [dst_word, dst_pos, lane, shift, nbits]
+    for slot, lane, shift in sources:
+        if not 0 <= slot < k:
+            raise ValueError(f"slot {slot} outside 0..{k - 1}")
+        w, p = slot // 32, slot % 32
+        if (
+            cur is not None
+            and w == cur[0]
+            and p == cur[1] + cur[4]
+            and lane == cur[2]
+            and shift == cur[3] + cur[4]
+        ):
+            cur[4] += 1
+            continue
+        if cur is not None:
+            runs.append(tuple(cur))
+        cur = [w, p, lane, shift, 1]
+    if cur is not None:
+        runs.append(tuple(cur))
+    return runs
+
+
+def or_bit_runs(jnp, vec, runs, L: int):
+    """Traced counterpart of :func:`bit_run_plan`: OR each run's
+    ``(vec[lane] >> shift) & ((1 << nbits) - 1)`` into its destination
+    word. Returns a length-``L`` python list of per-word uint32 scalar
+    accumulators (``None`` where no run landed) so the caller can fold
+    in per-slot leftovers before materializing the ``[L]`` row — pure
+    shift-mask lane ops, no gather, no dense bool."""
+    u32 = jnp.uint32
+    acc = [None] * L
+    for dst_word, dst_pos, lane, shift, nbits in runs:
+        term = (vec[lane] >> u32(shift)) & u32((1 << nbits) - 1)
+        if dst_pos:
+            term = term << u32(dst_pos)
+        acc[dst_word] = (
+            term if acc[dst_word] is None else acc[dst_word] | term
+        )
+    return acc
+
+
 def bit_select(jnp, words, idx):
     """Gather-free bit lookup in a HOST-CONSTANT packed bit table.
 

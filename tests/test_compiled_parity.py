@@ -1,0 +1,279 @@
+"""Compiled-vs-hand parity gate (round 23): the hand encodings as
+DIFFERENTIAL ORACLES for the compiled actor path.
+
+The compiled 2pc lane runs the count-comparable system actor model
+(models/two_phase_commit_actors.py two_phase_sys_actor_model — a
+state-for-state bijection with the hand TwoPhaseSys model: dup-network
+envelope bits <-> the append-only msgs bag, timer bits a function of
+local state, atomic broadcast one bag entry), so the HAND engine lane
+and the COMPILED engine lane explore the SAME pinned spaces (1,568 @
+rm=4, 8,832 @ rm=5) and must agree on counts, verdicts, and replayable
+counterexample paths.
+
+The optimizer itself (actor/compile.py _optimize_codegen, on by
+default) is pinned two ways: a naive-vs-optimized traced A/B through
+the tools/trace_diff.py gate with ZERO per-wave counter divergence
+(same encoding semantics — every counter, including candidates, must
+match), and exhaustive emission differentials over every reachable
+state x slot. Hand-vs-compiled traces align on the
+encoding-INDEPENDENT counters (frontier rows, new states, unique
+total); `candidates` legitimately differs — the compiled path prunes
+no-op self-loops the hand encoding emits — and that asymmetry is
+pinned too.
+"""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from stateright_tpu.model import Expectation
+
+pytestmark = pytest.mark.parity
+
+#: the pinned TwoPhaseSys spaces both lanes must reproduce
+PINNED = {4: 1568, 5: 8832}
+
+
+def _hand_checker(rm, **kw):
+    from stateright_tpu.models.two_phase_commit import TwoPhaseSys
+
+    kw.setdefault("cand_capacity", "auto")
+    return TwoPhaseSys(rm_count=rm).checker().spawn_tpu_sortmerge(**kw)
+
+
+def _compiled_checker(rm, optimize=True, track_paths=False, **kw):
+    from stateright_tpu.models.two_phase_commit_actors import (
+        two_phase_sys_actor_model,
+        two_phase_sys_compiled_encoded,
+    )
+
+    kw.setdefault("cand_capacity", "auto")
+    return (
+        two_phase_sys_actor_model(rm)
+        .checker()
+        .spawn_tpu_sortmerge(
+            encoded=two_phase_sys_compiled_encoded(rm, optimize=optimize),
+            track_paths=track_paths,
+            **kw,
+        )
+    )
+
+
+@pytest.mark.parametrize("rm", [4, 5])
+def test_hand_oracle_counts_verdicts_paths(rm):
+    """The hand lane is the oracle: the compiled lane must reproduce
+    its unique count bit-identically, discover the same properties,
+    and its counterexample paths must REPLAY through the actor model's
+    host handlers with the right witness at the end."""
+    from stateright_tpu.models.two_phase_commit_actors import (
+        two_phase_sys_actor_model,
+    )
+
+    cap = dict(capacity=1 << (11 if rm == 4 else 14),
+               frontier_capacity=1 << (9 if rm == 4 else 11))
+    hand = _hand_checker(rm, track_paths=False, **cap).join()
+    assert hand.unique_state_count() == PINNED[rm]
+
+    comp = _compiled_checker(rm, track_paths=True, **cap).join()
+    assert comp.unique_state_count() == PINNED[rm]
+    assert sorted(comp.discoveries()) == sorted(
+        hand.discovered_property_names()
+    )
+
+    # Replay: materializing a Path replays the trace through the host
+    # actor handlers (the differential check that the compiled
+    # step_slot_vec agrees with actor/base.py semantics); the last
+    # state must witness the discovery.
+    model = two_phase_sys_actor_model(rm)
+    assert comp.discoveries(), "2pc always discovers its SOMETIMES"
+    for name, path in comp.discoveries().items():
+        prop = model.property_by_name(name)
+        if prop.expectation == Expectation.SOMETIMES:
+            assert prop.condition(model, path.last_state())
+        else:
+            assert not prop.condition(model, path.last_state())
+
+
+def test_traced_ab_zero_divergence(tmp_path):
+    """The optimizer A/B through the tools/trace_diff.py gate: a
+    naive-compile (optimize=False) trace vs an optimized trace of the
+    SAME encoding pipeline at rm=4 must show ZERO per-wave counter
+    divergence — frontier rows, candidates, new states, and the
+    running unique total all identical — and exit 0."""
+    from stateright_tpu.telemetry import RunTracer, diff_traces
+
+    cap = dict(capacity=1 << 11, frontier_capacity=1 << 9)
+    ta = RunTracer()
+    with ta.activate():
+        a = _compiled_checker(4, optimize=False, **cap).join()
+    tb = RunTracer()
+    with tb.activate():
+        b = _compiled_checker(4, optimize=True, **cap).join()
+    assert a.unique_state_count() == b.unique_state_count() == 1568
+
+    rep = diff_traces(ta.events, tb.events)
+    assert rep["divergences"] == []
+
+    # the same verdict through the CLI gate (artifact -> exit code)
+    pa, pb = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    pa.write_text("\n".join(json.dumps(e) for e in ta.events) + "\n")
+    pb.write_text("\n".join(json.dumps(e) for e in tb.events) + "\n")
+    proc = subprocess.run(
+        [sys.executable, "tools/trace_diff.py", str(pa), str(pb),
+         # timing is not under test here (two cold in-process runs);
+         # the exit code must be decided by the counters alone
+         "--threshold", "1000", "--min-sec", "1000"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "WAVE DIVERGENCE" not in proc.stdout
+
+
+def test_traced_hand_vs_compiled_independent_counters():
+    """Hand vs compiled traces align on the encoding-INDEPENDENT wave
+    counters — same spaces, same BFS layers: frontier rows, new
+    states, unique totals identical every wave. `candidates` may
+    differ (the compiled path prunes no-op self-loops the hand
+    encoding emits) and is pinned to differ in that direction only:
+    compiled <= hand on every wave."""
+    from stateright_tpu.telemetry import RunTracer, diff_traces
+
+    cap = dict(capacity=1 << 11, frontier_capacity=1 << 9)
+    ta = RunTracer()
+    with ta.activate():
+        a = _hand_checker(4, track_paths=False, **cap).join()
+    tb = RunTracer()
+    with tb.activate():
+        b = _compiled_checker(4, **cap).join()
+    assert a.unique_state_count() == b.unique_state_count() == 1568
+
+    rep = diff_traces(ta.events, tb.events)
+    others = [d for d in rep["divergences"]
+              if d["field"] != "candidates"]
+    assert others == []
+    for d in rep["divergences"]:
+        assert d["field"] == "candidates" and d["b"] <= d["a"]
+
+
+def test_optimizer_emission_differential_exhaustive():
+    """Exhaustive naive-vs-optimized differential at rm=3: for EVERY
+    reachable state and EVERY slot, the optimized enabled_bits_vec /
+    step_slot_vec emissions agree bit-for-bit with the naive
+    per-action codegen (bits words, dense mask view, successors on
+    enabled pairs, trunc/hard flags)."""
+    import jax
+    import jax.numpy as jnp
+
+    from stateright_tpu.actor.compile import compile_actor_model
+    from stateright_tpu.encoding import normalize_step_slot_result
+    from stateright_tpu.models.two_phase_commit_actors import (
+        two_phase_sys_actor_model,
+        two_phase_sys_device_specs,
+    )
+
+    m = two_phase_sys_actor_model(3)
+    e1 = compile_actor_model(
+        m, **two_phase_sys_device_specs(3), optimize=False
+    )
+    e2 = compile_actor_model(m, **two_phase_sys_device_specs(3))
+    assert e1.codegen_opt is None and e2.codegen_opt is not None
+
+    seen, frontier = set(), list(m.init_states())
+    for s in frontier:
+        seen.add(s)
+    while frontier:
+        nxt = []
+        for s in frontier:
+            for a in m.actions(s):
+                t = m.next_state(s, a)
+                if t is not None and t not in seen \
+                        and m.within_boundary(t):
+                    seen.add(t)
+                    nxt.append(t)
+        frontier = nxt
+    states = sorted(seen, key=repr)
+    assert len(states) == 288
+
+    vecs = np.stack([e1.encode(s) for s in states])
+    K = e1.max_actions
+    SV = jnp.asarray(np.repeat(vecs, K, axis=0))
+    SL = jnp.asarray(np.tile(np.arange(K, dtype=np.uint32),
+                             len(states)))
+
+    def run(e):
+        bits = jax.jit(jax.vmap(e.enabled_bits_vec))(jnp.asarray(vecs))
+        en = jax.jit(jax.vmap(e.enabled_mask_vec))(jnp.asarray(vecs))
+        r = jax.jit(jax.vmap(e.step_slot_vec))(SV, SL)
+        s, t, h = normalize_step_slot_result(r)
+        bt = lambda x: np.broadcast_to(  # noqa: E731
+            np.asarray(x), (len(states) * K,))
+        return (np.asarray(bits), np.asarray(en).reshape(-1),
+                np.asarray(s), bt(t), bt(h))
+
+    b1, en, s1, t1, h1 = run(e1)
+    b2, en2, s2, t2, h2 = run(e2)
+    assert (b1 == b2).all()
+    assert (en == en2).all()
+    assert (s1[en] == s2[en]).all()
+    assert (t1[en] == t2[en]).all() and (h1[en] == h2[en]).all()
+
+
+def test_optimizer_plan_pins():
+    """The optimizer's plan for the production 2pc family is pinned:
+    deliver/timeout fuse into one switch class (timeout rows carry
+    zero channel params, so the nondup decrement degenerates to
+    identity on them), the trivial history elides its gather, no
+    crash slots elide the crashed gating, and the step path holds to
+    TWO table-row gathers (params + flat). The cache key carries the
+    optimizer discriminator so naive and optimized programs never
+    collide in the compile cache."""
+    from stateright_tpu.models.two_phase_commit_actors import (
+        two_phase_sys_compiled_encoded,
+    )
+
+    enc = two_phase_sys_compiled_encoded(5)
+    plan = enc.codegen_opt
+    assert plan["fused_switch"] is True
+    assert plan["history_gather_elided"] is True
+    assert plan["crash_gather_elided"] is True
+    assert plan["step_gathers"] == 2
+    # table dedup + column pruning really happened
+    assert plan["flat_cols"][1] < plan["flat_cols"][0]
+    assert plan["params_cols"][1] < plan["params_cols"][0]
+    # every presence bit of the dup network + timers coalesced into
+    # word-level runs: zero per-slot leftovers at this shape
+    assert plan["mask_per_slot"] == 0
+    assert plan["mask_bit_runs"] >= 1
+
+    naive = two_phase_sys_compiled_encoded(5, optimize=False)
+    assert naive.codegen_opt is None
+    assert enc.cache_key() != naive.cache_key()
+    assert "codegen-opt" in repr(enc.cache_key())
+
+
+def test_registry_production_shape_entry():
+    """The production-shape compiled pipeline is registered for the
+    lint gates (analysis/registry.py): the rm=5 entry builds, caps
+    its step path at 2 gathers, and the bench parity map names lanes
+    that exist in the bench lane table."""
+    from stateright_tpu.analysis.registry import get_encoding_spec
+
+    spec = get_encoding_spec("compiled-2pc-sys-rm5")
+    assert spec.kind == "compiled"
+    assert spec.max_step_gathers == 2
+    enc = spec.factory()
+    assert enc.codegen_opt is not None
+    assert enc.codegen_opt["step_gathers"] <= 2
+
+    sys.path.insert(0, ".")
+    try:
+        from bench import COMPILED_PARITY, tpu_workloads
+    finally:
+        sys.path.pop(0)
+    lanes = {name for name, *_ in tpu_workloads(quick=True)}
+    for cname, hname in COMPILED_PARITY.items():
+        assert cname in lanes, cname
+        assert hname in lanes, hname

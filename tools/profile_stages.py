@@ -88,13 +88,37 @@ def _fold(x):
     return jnp.sum(x.reshape(-1).astype(jnp.uint32)) % jnp.uint32(2)
 
 
-def _spawn(kind, n, caps, target=None, waves_per_sync=64):
+def _spawn(kind, n, caps, target=None, waves_per_sync=64,
+           optimize=True):
+    encoded = None
     if kind == "paxos":
         from stateright_tpu.models.paxos import PaxosModelCfg, paxos_model
 
         b = paxos_model(
             PaxosModelCfg(client_count=n, server_count=3)
         ).checker()
+    elif kind == "paxos-compiled":
+        # The compiled paxos lane (round 23): same actor model, the
+        # encoding comes from the generic compiler (reachable-mode
+        # harvest — paid here, outside the profiled stages).
+        from stateright_tpu.models.paxos import (
+            PaxosModelCfg, paxos_compiled_encoded, paxos_model,
+        )
+
+        cfg = PaxosModelCfg(client_count=n, server_count=3)
+        b = paxos_model(cfg).checker()
+        encoded = paxos_compiled_encoded(cfg, optimize=optimize)
+    elif kind == "twopc-compiled":
+        # Compiled count-comparable 2pc system model; ``optimize``
+        # toggles the codegen optimizer for per-stage ablation A/Bs
+        # (the PERF.md §compiled-parity before/after rows).
+        from stateright_tpu.models.two_phase_commit_actors import (
+            two_phase_sys_actor_model,
+            two_phase_sys_compiled_encoded,
+        )
+
+        b = two_phase_sys_actor_model(n).checker()
+        encoded = two_phase_sys_compiled_encoded(n, optimize=optimize)
     else:
         from stateright_tpu.models.two_phase_commit import TwoPhaseSys
 
@@ -102,11 +126,13 @@ def _spawn(kind, n, caps, target=None, waves_per_sync=64):
     if target is not None:
         b = b.target_state_count(target)
     return b.spawn_tpu_sortmerge(
-        track_paths=False, waves_per_sync=waves_per_sync, **caps
+        track_paths=False, waves_per_sync=waves_per_sync,
+        **({"encoded": encoded} if encoded is not None else {}),
+        **caps
     )
 
 
-def stage_profile(kind, n, caps, target):
+def stage_profile(kind, n, caps, target, optimize=True):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -123,7 +149,7 @@ def stage_profile(kind, n, caps, target):
     from stateright_tpu.ops.fingerprint import fingerprint_u32v_t
 
     print(f"\n## stage profile: {kind} {n} (target={target})")
-    c = _spawn(kind, n, caps, target=target)
+    c = _spawn(kind, n, caps, target=target, optimize=optimize)
     c.keep_final_carry = True
     c.join()
     carry = c._final_carry
@@ -532,7 +558,7 @@ def stage_profile(kind, n, caps, target):
     return c, total
 
 
-def wave_wall(kind, n, caps, target):
+def wave_wall(kind, n, caps, target, optimize=True):
     """--wave-wall: the out-of-stage attribution (VERDICT r5 items
     1-2). Runs the stage profile for the in-stage sum, then re-times
     ONE full wave body on the same captured carry and attributes the
@@ -546,7 +572,7 @@ def wave_wall(kind, n, caps, target):
     print(format_report(rep, stage_sum_ms=stage_sum))
 
 
-def wave_profile(kind, n, caps):
+def wave_profile(kind, n, caps, optimize=True):
     from stateright_tpu.report import Reporter
 
     rows = []
@@ -565,9 +591,9 @@ def wave_profile(kind, n, caps):
             )
             self.last = now
 
-    _spawn(kind, n, caps).join()  # warm compile at the same shapes? (no:
+    _spawn(kind, n, caps, optimize=optimize).join()  # warm compile at the same shapes? (no:
     # waves_per_sync differs; still warms the persistent XLA cache)
-    c2 = _spawn(kind, n, caps, waves_per_sync=1)
+    c2 = _spawn(kind, n, caps, waves_per_sync=1, optimize=optimize)
     rec = Rec()
     t0 = time.monotonic()
     c2._ensure_run(rec)
@@ -676,6 +702,23 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--paxos", type=int)
     ap.add_argument("--twopc", type=int)
+    ap.add_argument(
+        "--twopc-compiled", type=int,
+        help="compiled 2pc system lane (two_phase_sys_compiled_encoded)"
+        " at rm=N — the round-23 parity lane; pair with "
+        "--no-optimize for the codegen-ablation denominator",
+    )
+    ap.add_argument(
+        "--paxos-compiled", type=int,
+        help="compiled paxos lane at N clients (reachable-mode "
+        "harvest runs once before the profiled stages)",
+    )
+    ap.add_argument(
+        "--no-optimize", action="store_true",
+        help="compile the *-compiled lanes with optimize=False (the "
+        "naive per-action codegen) — the per-stage A/B denominator "
+        "for PERF.md §compiled-parity",
+    )
     ap.add_argument("--target", type=int)
     ap.add_argument("--wave-profile", action="store_true")
     ap.add_argument("--wave-wall", action="store_true")
@@ -724,16 +767,55 @@ def main():
                     cand_capacity="auto", tile_rows=1 << 20),
         }[n]
         default_target = {8: 900_000, 9: 5_000_000}[n]
+    elif args.twopc_compiled:
+        kind, n = "twopc-compiled", args.twopc_compiled
+        # The bench parity-lane shapes (identical to the hand "2pc
+        # rm=N" lanes — the space is count-identical, so the wave
+        # peaks are too); other rm counts fall back to the same
+        # ~2.53 bits/RM growth the hand lanes follow.
+        import math
+
+        bench_caps = {
+            5: dict(capacity=1 << 14, frontier_capacity=1 << 11),
+            6: dict(capacity=1 << 16, frontier_capacity=1 << 14),
+            7: dict(capacity=1 << 19, frontier_capacity=1 << 16),
+        }
+        if n in bench_caps:
+            caps = dict(bench_caps[n], cand_capacity="auto")
+        else:
+            cap = 1 << max(10, math.ceil(2.6 * n + 1.5))
+            caps = dict(capacity=cap,
+                        frontier_capacity=max(256, cap // 4),
+                        cand_capacity="auto")
+        default_target = {5: 4_000, 6: 25_000, 7: 150_000}.get(
+            n, max(512, caps["capacity"] // 4)
+        )
+    elif args.paxos_compiled:
+        kind, n = "paxos-compiled", args.paxos_compiled
+        caps = dict(capacity=1 << 15, frontier_capacity=1 << 12,
+                    cand_capacity="auto")
+        default_target = 8_000
     else:
-        raise SystemExit("pass --paxos N or --twopc N")
+        raise SystemExit(
+            "pass --paxos N, --twopc N, --twopc-compiled N or "
+            "--paxos-compiled N"
+        )
+    spawn_kw = (
+        {"optimize": False} if args.no_optimize else {}
+    )
+    if args.no_optimize and not kind.endswith("compiled"):
+        raise SystemExit("--no-optimize only applies to the "
+                         "*-compiled lanes")
 
     def dispatch():
         if args.wave_profile:
-            wave_profile(kind, n, caps)
+            wave_profile(kind, n, caps, **spawn_kw)
         elif args.wave_wall:
-            wave_wall(kind, n, caps, args.target or default_target)
+            wave_wall(kind, n, caps, args.target or default_target,
+                      **spawn_kw)
         else:
-            stage_profile(kind, n, caps, args.target or default_target)
+            stage_profile(kind, n, caps,
+                          args.target or default_target, **spawn_kw)
 
     if args.trace is None:
         dispatch()
